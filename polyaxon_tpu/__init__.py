@@ -1,0 +1,21 @@
+"""polyaxon-tpu: a TPU-native deep-learning experimentation platform.
+
+A ground-up re-design of the capability set of Polyaxon (reference:
+``/root/reference``, v0.5.6 — a Kubernetes/Django/Celery control plane) as a
+TPU-first framework:
+
+- declarative experiment specs compile to *sharding plans* (``jax.sharding.Mesh``
+  + ``PartitionSpec`` templates: DP/FSDP/TP/PP/SP-ring/Ulysses/EP) instead of
+  TF_CONFIG / mpirun / DMLC env recipes (reference ``polyaxon/polypod/``),
+- a single-process asyncio control plane with a durable sqlite run registry
+  replaces Django + Postgres + Redis + RabbitMQ + Celery,
+- the gang spawner launches ``jax.distributed`` process gangs on TPU-VM slices
+  (local-subprocess backend for dev/test) instead of Kubernetes pods,
+- hyperparameter search (grid/random/hyperband/Bayesian) is a first-class
+  subsystem (reference ``polyaxon/hpsearch/``), gang-aware over TPU slices,
+- the runtime layer (checkpointing via orbax, per-step profiling, ring
+  attention for long context, MoE expert parallelism) is new: the reference
+  delegated all compute to user containers.
+"""
+
+from polyaxon_tpu.version import __version__  # noqa: F401

@@ -1,0 +1,3 @@
+from polyaxon_tpu.compiler.service import compile_spec
+
+__all__ = ["compile_spec"]

@@ -1,0 +1,42 @@
+"""Framework exception hierarchy.
+
+Parity: the reference scatters these across packages
+(``polyaxon/libs/exceptions.py``, DRF validation errors, schema
+``ValidationError`` from marshmallow). Here they are one hierarchy.
+"""
+
+
+class PolyaxonTPUError(Exception):
+    """Base class for all framework errors."""
+
+
+class SchemaError(PolyaxonTPUError):
+    """A spec/polyaxonfile failed validation."""
+
+
+class CompilerError(PolyaxonTPUError):
+    """A spec could not be compiled into an executable plan."""
+
+
+class LifecycleError(PolyaxonTPUError):
+    """An illegal status transition was requested."""
+
+
+class StoreError(PolyaxonTPUError):
+    """Artifact/log store operation failed."""
+
+
+class SpawnerError(PolyaxonTPUError):
+    """Gang spawn / teardown failed."""
+
+
+class RuntimeLayerError(PolyaxonTPUError):
+    """Mesh/sharding/runtime setup failed."""
+
+
+class QueryError(PolyaxonTPUError):
+    """Search/filter query DSL parse or build failed."""
+
+
+class NotFoundError(PolyaxonTPUError):
+    """Entity not found in the run registry."""

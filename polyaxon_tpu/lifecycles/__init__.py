@@ -1,0 +1,20 @@
+from polyaxon_tpu.lifecycles.machine import LifeCycle, StatusOptions
+from polyaxon_tpu.lifecycles.registry import (
+    ExperimentLifeCycle,
+    GroupLifeCycle,
+    JobLifeCycle,
+    OperationRunLifeCycle,
+    PipelineLifeCycle,
+    lifecycle_for_kind,
+)
+
+__all__ = [
+    "LifeCycle",
+    "StatusOptions",
+    "ExperimentLifeCycle",
+    "GroupLifeCycle",
+    "JobLifeCycle",
+    "PipelineLifeCycle",
+    "OperationRunLifeCycle",
+    "lifecycle_for_kind",
+]

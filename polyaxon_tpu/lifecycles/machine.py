@@ -1,0 +1,170 @@
+"""Declarative status state machines.
+
+Capability parity with the reference's per-entity lifecycle classes
+(``polyaxon/lifecycles/{statuses,experiments,jobs,experiment_groups,
+pipelines}.py`` — transition matrices gating every status write, checked by
+e.g. ``scheduler/tasks/experiments.py:72-77``). The design here is different:
+instead of hand-written transition matrices per entity, a ``LifeCycle`` is
+built from a compact *phase* taxonomy (pending → preparing → running → done)
+plus per-entity overrides, and the matrix is derived.  Statuses are plain
+strings so they serialize straight into the registry and over the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterable, Mapping, Optional, Set
+
+
+class StatusOptions:
+    """Canonical status vocabulary (shared with the reference for parity)."""
+
+    CREATED = "created"
+    RESUMING = "resuming"
+    BUILDING = "building"
+    SCHEDULED = "scheduled"
+    UNSCHEDULABLE = "unschedulable"
+    STARTING = "starting"
+    RUNNING = "running"
+    WARNING = "warning"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    UPSTREAM_FAILED = "upstream_failed"
+    STOPPING = "stopping"
+    STOPPED = "stopped"
+    SKIPPED = "skipped"
+    RETRYING = "retrying"
+    UNKNOWN = "unknown"
+    DONE = "done"
+
+
+class LifeCycle:
+    """A status state machine with transition gating.
+
+    ``can_transition(frm, to)`` is the single write-gate every status mutation
+    must pass (the registry enforces it).  The machine is derived from four
+    ordered phase sets; a transition is legal when it does not leave a done
+    state (done states are terminal except explicit resume edges) and does not
+    move "backwards" into creation.
+    """
+
+    def __init__(
+        self,
+        *,
+        pending: Iterable[str],
+        preparing: Iterable[str] = (),
+        running: Iterable[str],
+        done: Iterable[str],
+        transient: Iterable[str] = (StatusOptions.WARNING, StatusOptions.UNKNOWN),
+        failed: Iterable[str] = (StatusOptions.FAILED, StatusOptions.UPSTREAM_FAILED),
+        resumable_from: Iterable[str] = (),
+        heartbeat: Iterable[str] = (StatusOptions.RUNNING,),
+        extra_edges: Optional[Mapping[str, Iterable[str]]] = None,
+    ) -> None:
+        self.PENDING_STATUS: FrozenSet[str] = frozenset(pending)
+        self.PREPARING_STATUS: FrozenSet[str] = frozenset(preparing)
+        self.RUNNING_STATUS: FrozenSet[str] = frozenset(running)
+        self.DONE_STATUS: FrozenSet[str] = frozenset(done)
+        self.TRANSIENT_STATUS: FrozenSet[str] = frozenset(transient)
+        self.FAILED_STATUS: FrozenSet[str] = frozenset(failed) & self.DONE_STATUS
+        self.HEARTBEAT_STATUS: FrozenSet[str] = frozenset(heartbeat)
+        self.VALUES: FrozenSet[str] = (
+            self.PENDING_STATUS
+            | self.PREPARING_STATUS
+            | self.RUNNING_STATUS
+            | self.DONE_STATUS
+            | self.TRANSIENT_STATUS
+        )
+        self._matrix = self._derive_matrix(resumable_from, extra_edges or {})
+
+    # -- matrix derivation ---------------------------------------------------
+    def _derive_matrix(
+        self,
+        resumable_from: Iterable[str],
+        extra_edges: Mapping[str, Iterable[str]],
+    ) -> Dict[str, Set[str]]:
+        live = self.VALUES - self.DONE_STATUS
+        ordered_phases = [
+            self.PENDING_STATUS,
+            self.PREPARING_STATUS,
+            self.RUNNING_STATUS,
+        ]
+        matrix: Dict[str, Set[str]] = {}
+        # Entry states are only reachable at creation time (from nothing) or
+        # via an explicit resume edge.
+        for status in self.PENDING_STATUS:
+            matrix[status] = {None} | set(resumable_from)  # type: ignore[arg-type]
+        # Forward motion: a preparing/running state is reachable from any
+        # earlier live phase and from transient states.
+        seen_earlier: Set[str] = set(self.PENDING_STATUS)
+        for phase in ordered_phases[1:]:
+            for status in phase:
+                matrix[status] = set(seen_earlier) | set(self.TRANSIENT_STATUS)
+            seen_earlier |= phase
+        # Within-phase motion for the running phase (scheduled→starting→running
+        # is ordered by the caller passing them in order; we simply allow any
+        # intra-phase move that is not a self-loop).
+        for status in self.RUNNING_STATUS:
+            matrix[status] |= self.RUNNING_STATUS - {status}
+        # Done states absorb everything live.
+        for status in self.DONE_STATUS:
+            matrix[status] = set(live)
+        # Stop may also override other done states except itself/skipped (the
+        # reference allows re-stopping failed/succeeded runs for cleanup).
+        if StatusOptions.STOPPED in self.DONE_STATUS:
+            matrix[StatusOptions.STOPPED] = set(
+                self.VALUES - {StatusOptions.STOPPED, StatusOptions.SKIPPED}
+            )
+        # Transient states are reachable from anything live (not from done,
+        # and never from themselves).
+        for status in self.TRANSIENT_STATUS:
+            matrix[status] = set(live - {status})
+        for status, sources in extra_edges.items():
+            matrix.setdefault(status, set()).update(sources)
+        return matrix
+
+    @property
+    def transition_matrix(self) -> Mapping[str, Set[str]]:
+        return self._matrix
+
+    # -- gates ---------------------------------------------------------------
+    def can_transition(self, status_from: Optional[str], status_to: str) -> bool:
+        if status_to not in self._matrix:
+            return False
+        return status_from in self._matrix[status_to]
+
+    # -- predicates ----------------------------------------------------------
+    def is_pending(self, status: str) -> bool:
+        return status in self.PENDING_STATUS
+
+    def is_running(self, status: str) -> bool:
+        return status in self.RUNNING_STATUS or status in self.PREPARING_STATUS
+
+    def is_done(self, status: str) -> bool:
+        return status in self.DONE_STATUS
+
+    def failed(self, status: str) -> bool:
+        return status in self.FAILED_STATUS
+
+    def succeeded(self, status: str) -> bool:
+        return status == StatusOptions.SUCCEEDED
+
+    def stopped(self, status: str) -> bool:
+        return status == StatusOptions.STOPPED
+
+    def skipped(self, status: str) -> bool:
+        return status == StatusOptions.SKIPPED
+
+    def is_unschedulable(self, status: str) -> bool:
+        return status == StatusOptions.UNSCHEDULABLE
+
+    def is_warning(self, status: str) -> bool:
+        return status == StatusOptions.WARNING
+
+    def is_unknown(self, status: str) -> bool:
+        return status == StatusOptions.UNKNOWN
+
+    def is_stoppable(self, status: str) -> bool:
+        return not self.is_done(status)
+
+    def needs_heartbeat(self, status: str) -> bool:
+        return status in self.HEARTBEAT_STATUS

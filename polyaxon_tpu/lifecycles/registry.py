@@ -1,0 +1,118 @@
+"""Per-entity lifecycle instances + gang status roll-up.
+
+Parity targets in the reference:
+- ``polyaxon/lifecycles/experiments.py:10-62`` (experiment machine + the
+  ``jobs_status`` roll-up used when aggregating per-replica pod statuses),
+- ``polyaxon/lifecycles/jobs.py`` (job machine),
+- ``polyaxon/lifecycles/experiment_groups.py`` (group machine),
+- ``polyaxon/lifecycles/pipelines.py`` + ``operations.py`` (DAG machines).
+
+Here a distributed experiment's per-*host-process* statuses roll up to the
+experiment status with gang semantics: any failure fails the gang (jax
+collectives are all-or-nothing over ICI/DCN, unlike the reference's PS
+clusters where a lost PS might only degrade).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from polyaxon_tpu.lifecycles.machine import LifeCycle, StatusOptions
+
+S = StatusOptions
+
+#: Experiments: full machine incl. BUILDING (code snapshot) and RESUMING.
+ExperimentLifeCycle = LifeCycle(
+    pending=(S.CREATED, S.RESUMING),
+    preparing=(S.BUILDING,),
+    running=(S.SCHEDULED, S.STARTING, S.RUNNING),
+    done=(S.SUCCEEDED, S.FAILED, S.UPSTREAM_FAILED, S.STOPPED, S.SKIPPED),
+    transient=(S.WARNING, S.UNKNOWN, S.UNSCHEDULABLE),
+    resumable_from=(S.SUCCEEDED, S.STOPPED, S.SKIPPED, S.WARNING, S.FAILED),
+)
+
+#: Host-process jobs (the replica unit inside a gang).
+JobLifeCycle = LifeCycle(
+    pending=(S.CREATED,),
+    preparing=(S.BUILDING,),
+    running=(S.SCHEDULED, S.STARTING, S.RUNNING),
+    done=(S.SUCCEEDED, S.FAILED, S.UPSTREAM_FAILED, S.STOPPED, S.SKIPPED),
+    transient=(S.WARNING, S.UNKNOWN, S.UNSCHEDULABLE),
+)
+
+#: Experiment groups (hpsearch sweeps): RUNNING covers the whole sweep window.
+GroupLifeCycle = LifeCycle(
+    pending=(S.CREATED, S.RESUMING),
+    running=(S.RUNNING,),
+    done=(S.SUCCEEDED, S.FAILED, S.STOPPED, S.SKIPPED, S.DONE),
+    transient=(S.WARNING,),
+    resumable_from=(S.DONE, S.STOPPED, S.SUCCEEDED),
+)
+
+#: Workflow pipelines and their operation runs (polyflow equivalent).
+PipelineLifeCycle = LifeCycle(
+    pending=(S.CREATED, S.RESUMING),
+    preparing=(S.SCHEDULED,),
+    running=(S.RUNNING,),
+    done=(S.SUCCEEDED, S.FAILED, S.UPSTREAM_FAILED, S.STOPPED, S.SKIPPED, S.DONE),
+    transient=(S.WARNING,),
+    resumable_from=(S.DONE, S.STOPPED),
+)
+
+OperationRunLifeCycle = LifeCycle(
+    pending=(S.CREATED, S.RETRYING),
+    preparing=(S.SCHEDULED,),
+    running=(S.RUNNING,),
+    done=(S.SUCCEEDED, S.FAILED, S.UPSTREAM_FAILED, S.STOPPED, S.SKIPPED),
+    transient=(S.WARNING,),
+    resumable_from=(S.FAILED, S.STOPPED),
+)
+
+_KIND_MAP = {
+    "experiment": ExperimentLifeCycle,
+    "job": JobLifeCycle,
+    "build": JobLifeCycle,
+    "notebook": JobLifeCycle,
+    "tensorboard": JobLifeCycle,
+    "service": JobLifeCycle,
+    "group": GroupLifeCycle,
+    "pipeline": PipelineLifeCycle,
+    "operation": OperationRunLifeCycle,
+}
+
+
+def lifecycle_for_kind(kind: str) -> LifeCycle:
+    try:
+        return _KIND_MAP[kind]
+    except KeyError:
+        raise KeyError(f"No lifecycle registered for kind {kind!r}") from None
+
+
+def gang_status(process_statuses: List[str]) -> Optional[str]:
+    """Roll a gang's per-process statuses up to one experiment status.
+
+    Gang semantics (vs reference ``ExperimentLifeCycle.jobs_status``,
+    ``lifecycles/experiments.py:121-147``): a jax.distributed world is
+    all-or-nothing — one failed process fails the experiment even while
+    others still run, and success requires *all* processes succeeded.
+    """
+    if not process_statuses:
+        return None
+    statuses = set(process_statuses)
+    if S.UNKNOWN in statuses:
+        return S.UNKNOWN
+    if S.UNSCHEDULABLE in statuses:
+        return S.UNSCHEDULABLE
+    if S.FAILED in statuses or S.UPSTREAM_FAILED in statuses:
+        return S.FAILED
+    if S.STOPPED in statuses:
+        return S.STOPPED
+    if S.WARNING in statuses:
+        return S.WARNING
+    if statuses == {S.SUCCEEDED}:
+        return S.SUCCEEDED
+    if S.RUNNING in statuses:
+        return S.RUNNING
+    if S.STARTING in statuses or S.SCHEDULED in statuses or S.BUILDING in statuses:
+        return S.STARTING
+    return S.UNKNOWN

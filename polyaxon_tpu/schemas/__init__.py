@@ -1,0 +1,54 @@
+from polyaxon_tpu.schemas.environments import (
+    MeshConfig,
+    ResourcesConfig,
+    RestartPolicyConfig,
+    TopologyConfig,
+)
+from polyaxon_tpu.schemas.hptuning import (
+    BOConfig,
+    EarlyStoppingConfig,
+    GridSearchConfig,
+    HPTuningConfig,
+    HyperbandConfig,
+    RandomSearchConfig,
+    SearchMetricConfig,
+)
+from polyaxon_tpu.schemas.matrix import MatrixConfig
+from polyaxon_tpu.schemas.polyaxonfile import PolyaxonFile
+from polyaxon_tpu.schemas.run import BuildConfig, RunConfig
+from polyaxon_tpu.schemas.specifications import (
+    BaseSpecification,
+    ExperimentSpecification,
+    GroupSpecification,
+    JobSpecification,
+    Kinds,
+    PipelineSpecification,
+    ServiceSpecification,
+    specification_for_kind,
+)
+
+__all__ = [
+    "MatrixConfig",
+    "HPTuningConfig",
+    "GridSearchConfig",
+    "RandomSearchConfig",
+    "HyperbandConfig",
+    "BOConfig",
+    "EarlyStoppingConfig",
+    "SearchMetricConfig",
+    "TopologyConfig",
+    "MeshConfig",
+    "ResourcesConfig",
+    "RestartPolicyConfig",
+    "RunConfig",
+    "BuildConfig",
+    "Kinds",
+    "BaseSpecification",
+    "ExperimentSpecification",
+    "GroupSpecification",
+    "JobSpecification",
+    "ServiceSpecification",
+    "PipelineSpecification",
+    "specification_for_kind",
+    "PolyaxonFile",
+]

@@ -1,0 +1,188 @@
+"""Environment section: TPU topology, mesh, resources, restart policy.
+
+This is the TPU-native replacement for the reference's per-framework
+environment sections (``tensorflow: {n_workers, n_ps}``, ``horovod``,
+``pytorch``, ``mxnet`` — consumed by ``polyaxon/polypod/{tensorflow,horovod,
+pytorch,mxnet}.py``) and its k8s resources/node-selector blocks
+(``polyaxon/polypod/templates/resources.py:40-45`` already sketched a
+``resources.tpu`` key; ``tpu.py:6-11`` the TPU pod annotations).
+
+Instead of replica counts per framework role, users declare a *topology*:
+an accelerator slice plus a named mesh (axis → size).  The compiler turns
+this into a gang plan (process count, coordinator, per-process env) and a
+``jax.sharding.Mesh`` recipe; parallelism strategies (ddp/fsdp/tp/pp/
+sp_ring/ulysses/ep) are sharding templates, not env-var dialects.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+from pydantic import BaseModel, ConfigDict, Field, field_validator, model_validator
+
+#: Known accelerator slice shapes: name -> (num_chips, num_hosts).
+#: Chips-per-host follows the platform generation (v4/v5p: 4, v5e/v6e: 8,
+#: cpu: virtual devices on one host for dev/test).
+ACCELERATOR_CATALOG: Dict[str, Tuple[int, int]] = {
+    "cpu": (8, 1),
+    "cpu-1": (1, 1),
+    "v4-8": (4, 1),
+    "v4-16": (8, 2),
+    "v4-32": (16, 4),
+    "v5e-1": (1, 1),
+    "v5e-4": (4, 1),
+    "v5e-8": (8, 1),
+    "v5e-16": (16, 2),
+    "v5e-32": (32, 4),
+    "v5e-64": (64, 8),
+    "v5e-128": (128, 16),
+    "v5e-256": (256, 32),
+    "v5p-8": (4, 1),
+    "v5p-16": (8, 2),
+    "v5p-32": (16, 4),
+    "v6e-8": (8, 1),
+    "v6e-16": (16, 2),
+    "v6e-32": (32, 4),
+}
+
+#: Canonical mesh axis names understood by the sharding templates
+#: (polyaxon_tpu.parallel). Order matters: outermost (DCN-friendly) first,
+#: innermost (ICI-bandwidth-hungry: tensor) last.
+CANONICAL_AXES = ("replica", "data", "fsdp", "pipeline", "expert", "sequence", "tensor")
+
+STRATEGIES = ("ddp", "fsdp", "tp", "tp_dp", "pp", "sp_ring", "ulysses", "ep", "custom")
+
+
+class MeshConfig(BaseModel):
+    """Ordered logical mesh: axis name -> size. One axis may be -1 (infer)."""
+
+    axes: Dict[str, int]
+
+    model_config = ConfigDict(extra="forbid")
+
+    @field_validator("axes")
+    @classmethod
+    def _check_axes(cls, v: Dict[str, int]) -> Dict[str, int]:
+        if not v:
+            raise ValueError("mesh must declare at least one axis")
+        wildcards = [k for k, s in v.items() if s == -1]
+        if len(wildcards) > 1:
+            raise ValueError(f"At most one -1 axis allowed, got {wildcards}")
+        for k, s in v.items():
+            if s != -1 and s < 1:
+                raise ValueError(f"Axis {k!r} must be >= 1 or -1, got {s}")
+        return v
+
+    @property
+    def names(self) -> List[str]:
+        return list(self.axes)
+
+    def resolve(self, num_devices: int) -> Dict[str, int]:
+        """Fill a -1 wildcard axis and check the product matches devices."""
+        axes = dict(self.axes)
+        wildcard = next((k for k, s in axes.items() if s == -1), None)
+        known = math.prod(s for s in axes.values() if s != -1)
+        if wildcard is not None:
+            if num_devices % known != 0:
+                raise ValueError(
+                    f"Cannot infer axis {wildcard!r}: {num_devices} devices not "
+                    f"divisible by {known}"
+                )
+            axes[wildcard] = num_devices // known
+        elif known != num_devices:
+            raise ValueError(
+                f"Mesh product {known} != device count {num_devices} ({axes})"
+            )
+        return axes
+
+
+class TopologyConfig(BaseModel):
+    """Accelerator slice + logical mesh + parallelism strategy."""
+
+    accelerator: str = "cpu"
+    num_hosts: Optional[int] = Field(default=None, ge=1)
+    num_devices: Optional[int] = Field(default=None, ge=1)
+    mesh: Optional[MeshConfig] = None
+    strategy: str = "ddp"
+    #: Extra knobs for templates (e.g. microbatches for pp, ring chunk size).
+    strategy_options: Dict[str, Any] = Field(default_factory=dict)
+
+    model_config = ConfigDict(extra="forbid")
+
+    @field_validator("mesh", mode="before")
+    @classmethod
+    def _coerce_mesh(cls, v: Any) -> Any:
+        if isinstance(v, dict) and "axes" not in v:
+            return {"axes": v}
+        return v
+
+    @field_validator("strategy")
+    @classmethod
+    def _check_strategy(cls, v: str) -> str:
+        if v not in STRATEGIES:
+            raise ValueError(f"strategy must be one of {STRATEGIES}")
+        return v
+
+    @model_validator(mode="after")
+    def _fill_from_catalog(self) -> "TopologyConfig":
+        cat = ACCELERATOR_CATALOG.get(self.accelerator)
+        if cat is not None:
+            chips, hosts = cat
+            if self.num_devices is None:
+                self.num_devices = chips
+            if self.num_hosts is None:
+                self.num_hosts = hosts
+        else:
+            if self.num_devices is None or self.num_hosts is None:
+                raise ValueError(
+                    f"Unknown accelerator {self.accelerator!r}: set num_devices "
+                    f"and num_hosts explicitly (known: {sorted(ACCELERATOR_CATALOG)})"
+                )
+        if self.mesh is not None:
+            self.mesh.resolve(self.num_devices)  # raises if inconsistent
+        return self
+
+    def resolved_mesh(self) -> Dict[str, int]:
+        """The concrete axis->size mapping (default: pure data parallel)."""
+        if self.mesh is None:
+            return {"data": int(self.num_devices)}
+        return self.mesh.resolve(int(self.num_devices))
+
+    @property
+    def devices_per_host(self) -> int:
+        return int(self.num_devices) // int(self.num_hosts)
+
+
+class ResourcesConfig(BaseModel):
+    """Host-process resource requests (the reference's k8s resources block)."""
+
+    cpu: Optional[float] = None
+    memory_mb: Optional[int] = None
+    tpu: Optional[int] = None
+
+    model_config = ConfigDict(extra="forbid")
+
+
+class RestartPolicyConfig(BaseModel):
+    """Gang restart policy.
+
+    Parity: reference ``polypod/templates/restart_policy.py`` (max_restarts on
+    pods).  Gang semantics here: any process failure tears down and restarts
+    the whole gang (jax.distributed worlds are all-or-nothing).
+    """
+
+    max_restarts: int = Field(default=0, ge=0)
+    backoff_seconds: float = Field(default=1.0, ge=0)
+
+    model_config = ConfigDict(extra="forbid")
+
+
+class EnvironmentConfig(BaseModel):
+    topology: TopologyConfig = Field(default_factory=TopologyConfig)
+    resources: Optional[ResourcesConfig] = None
+    restart_policy: RestartPolicyConfig = Field(default_factory=RestartPolicyConfig)
+    seed: Optional[int] = None
+    env_vars: Dict[str, str] = Field(default_factory=dict)
+
+    model_config = ConfigDict(extra="forbid")

@@ -1,0 +1,204 @@
+"""Hyperparameter-tuning section of a group spec.
+
+Capability parity with ``polyaxon_schemas`` ``HPTuningConfig`` /
+``SearchAlgorithms`` / ``EarlyStoppingConfig`` (re-exported by reference
+``polyaxon/schemas/__init__.py:30-45``) as consumed by
+``polyaxon/hpsearch/search_managers/*`` and
+``polyaxon/db/models/experiment_groups.py:310-409``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from pydantic import BaseModel, ConfigDict, Field, field_validator, model_validator
+
+from polyaxon_tpu.schemas.matrix import MatrixConfig
+
+
+class Optimization:
+    MAXIMIZE = "maximize"
+    MINIMIZE = "minimize"
+    VALUES = (MAXIMIZE, MINIMIZE)
+
+
+class SearchAlgorithms:
+    GRID = "grid"
+    RANDOM = "random"
+    HYPERBAND = "hyperband"
+    BO = "bo"
+    VALUES = (GRID, RANDOM, HYPERBAND, BO)
+
+
+class _Base(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+
+class SearchMetricConfig(_Base):
+    """The target metric a search optimizes (e.g. loss / accuracy)."""
+
+    name: str
+    optimization: str = Optimization.MAXIMIZE
+
+    @field_validator("optimization")
+    @classmethod
+    def _check_opt(cls, v: str) -> str:
+        v = v.lower()
+        if v not in Optimization.VALUES:
+            raise ValueError(f"optimization must be one of {Optimization.VALUES}")
+        return v
+
+
+class EarlyStoppingConfig(_Base):
+    """Stop the whole sweep once a metric crosses a threshold.
+
+    Parity: reference group early-stopping check
+    ``db/models/experiment_groups.py:326-344`` consumed before each start wave
+    (``hpsearch/tasks/base.py:64-78``).
+    """
+
+    metric: SearchMetricConfig
+    value: float
+    policy: str = "all"  # reserved for future policies
+
+    def passed(self, value: Optional[float]) -> bool:
+        if value is None:
+            return False
+        if self.metric.optimization == Optimization.MAXIMIZE:
+            return value >= self.value
+        return value <= self.value
+
+
+class GridSearchConfig(_Base):
+    n_experiments: Optional[int] = Field(default=None, ge=1)
+
+
+class RandomSearchConfig(_Base):
+    n_experiments: int = Field(ge=1)
+    seed: Optional[int] = None
+
+
+class HyperbandConfig(_Base):
+    """Hyperband bracket config.
+
+    Parity: ``hpsearch/search_managers/hyperband.py:9-147`` — ``max_iterations``
+    is R (max resource per trial), ``eta`` the down-sampling rate, ``resource``
+    names the budget hyperparameter injected into suggestions.
+    """
+
+    max_iterations: int = Field(ge=1)
+    eta: float = Field(gt=1)
+    resource: SearchMetricConfig  # name + (ab)used: optimization unused
+    metric: SearchMetricConfig
+    resume: bool = False
+    seed: Optional[int] = None
+
+
+class GaussianProcessConfig(_Base):
+    kernel: str = "matern"  # matern | rbf
+    length_scale: float = 1.0
+    nu: float = 1.5
+    n_restarts_optimizer: int = 0
+
+    @field_validator("kernel")
+    @classmethod
+    def _check_kernel(cls, v: str) -> str:
+        if v not in ("matern", "rbf"):
+            raise ValueError("kernel must be 'matern' or 'rbf'")
+        return v
+
+
+class UtilityFunctionConfig(_Base):
+    """Acquisition function config (UCB kappa / EI-POI eps)."""
+
+    acquisition_function: str = "ucb"  # ucb | ei | poi
+    kappa: float = 2.576
+    eps: float = 0.0
+    gaussian_process: GaussianProcessConfig = GaussianProcessConfig()
+    n_warmup: int = 200
+    n_iter: int = 10
+
+    @field_validator("acquisition_function")
+    @classmethod
+    def _check_acq(cls, v: str) -> str:
+        if v not in ("ucb", "ei", "poi"):
+            raise ValueError("acquisition_function must be ucb|ei|poi")
+        return v
+
+
+class BOConfig(_Base):
+    """Bayesian-optimization config.
+
+    Parity: ``hpsearch/search_managers/bayesian_optimization/manager.py:7-41``.
+    """
+
+    n_initial_trials: int = Field(ge=1)
+    n_iterations: int = Field(ge=1)
+    metric: SearchMetricConfig
+    utility_function: UtilityFunctionConfig = UtilityFunctionConfig()
+    seed: Optional[int] = None
+
+
+class HPTuningConfig(_Base):
+    """The ``hptuning`` section: matrix + exactly one search algorithm."""
+
+    matrix: Dict[str, MatrixConfig]
+    concurrency: int = Field(default=1, ge=1)
+    grid_search: Optional[GridSearchConfig] = None
+    random_search: Optional[RandomSearchConfig] = None
+    hyperband: Optional[HyperbandConfig] = None
+    bo: Optional[BOConfig] = None
+    early_stopping: List[EarlyStoppingConfig] = Field(default_factory=list)
+    seed: Optional[int] = None
+
+    model_config = ConfigDict(extra="forbid", arbitrary_types_allowed=True)
+
+    @field_validator("matrix", mode="before")
+    @classmethod
+    def _coerce_matrix(cls, v: Any) -> Dict[str, MatrixConfig]:
+        if not isinstance(v, dict) or not v:
+            raise ValueError("matrix must be a non-empty mapping")
+        out = {}
+        for name, entry in v.items():
+            out[name] = entry if isinstance(entry, MatrixConfig) else MatrixConfig.from_dict(entry)
+        return out
+
+    @model_validator(mode="after")
+    def _one_algorithm(self) -> "HPTuningConfig":
+        set_algos = [
+            a
+            for a in ("grid_search", "random_search", "hyperband", "bo")
+            if getattr(self, a) is not None
+        ]
+        if len(set_algos) > 1:
+            raise ValueError(f"At most one search algorithm allowed, got {set_algos}")
+        if self.hyperband is not None:
+            resource = self.hyperband.resource.name
+            if resource in self.matrix:
+                raise ValueError(
+                    f"Hyperband resource param {resource!r} must not appear in matrix"
+                )
+        if self.bo is not None:
+            for name, m in self.matrix.items():
+                if m.is_continuous and m.min is None:
+                    raise ValueError(
+                        f"BO requires bounded params; {name!r} ({m.op}) is unbounded"
+                    )
+        return self
+
+    @property
+    def search_algorithm(self) -> str:
+        if self.grid_search is not None:
+            return SearchAlgorithms.GRID
+        if self.random_search is not None:
+            return SearchAlgorithms.RANDOM
+        if self.hyperband is not None:
+            return SearchAlgorithms.HYPERBAND
+        if self.bo is not None:
+            return SearchAlgorithms.BO
+        return SearchAlgorithms.GRID
+
+    def to_dict(self) -> Dict[str, Any]:
+        data = self.model_dump(exclude_none=True, exclude={"matrix"})
+        data["matrix"] = {k: m.to_dict() for k, m in self.matrix.items()}
+        return data

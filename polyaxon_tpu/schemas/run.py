@@ -1,0 +1,55 @@
+"""Run + build sections of a spec.
+
+The reference's ``run: {cmd: ...}`` launched a user container; its ``build``
+section produced a Docker image (``polyaxon/dockerizer/``).  TPU-native
+equivalents: ``run`` is either a shell command or an in-process python
+entrypoint ``module:function`` (preferred — the trainer then runs inside the
+managed ``jax.distributed`` world); ``build`` is a content-addressed code
+snapshot (no containers).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+from pydantic import BaseModel, ConfigDict, Field, model_validator
+
+_ENTRYPOINT_RE = re.compile(r"^[A-Za-z_][\w.]*:[A-Za-z_]\w*$")
+
+
+class RunConfig(BaseModel):
+    """What to execute on every gang process."""
+
+    cmd: Optional[str] = None
+    entrypoint: Optional[str] = None  # "package.module:function"
+    #: Extra kwargs passed to the entrypoint (beyond declarations).
+    kwargs: Dict[str, Any] = Field(default_factory=dict)
+
+    model_config = ConfigDict(extra="forbid")
+
+    @model_validator(mode="after")
+    def _exactly_one(self) -> "RunConfig":
+        if bool(self.cmd) == bool(self.entrypoint):
+            raise ValueError("run must set exactly one of cmd / entrypoint")
+        if self.entrypoint and not _ENTRYPOINT_RE.match(self.entrypoint):
+            raise ValueError(
+                f"entrypoint must look like 'pkg.module:function', got {self.entrypoint!r}"
+            )
+        return self
+
+
+class BuildConfig(BaseModel):
+    """Code snapshot config (dockerizer equivalent, container-free).
+
+    Parity: reference ``polyaxon/dockerizer/dockerizer/initializer/*`` download
+    + extract + generate; here: snapshot ``context`` into the content-addressed
+    artifact store, so runs are reproducible and restartable byte-for-byte.
+    """
+
+    context: str = "."
+    include: List[str] = Field(default_factory=lambda: ["**/*.py", "**/*.yaml", "**/*.yml"])
+    exclude: List[str] = Field(default_factory=lambda: ["**/__pycache__/**", ".git/**"])
+    ref: Optional[str] = None  # pre-existing snapshot hash to reuse
+
+    model_config = ConfigDict(extra="forbid")

@@ -1,0 +1,253 @@
+"""Typed specifications: the compiled, validated form of a polyaxonfile.
+
+Capability parity with the external ``polyaxon_schemas`` Specification
+classes re-exported by reference ``polyaxon/schemas/__init__.py:46-60``
+(``ExperimentSpecification``, ``GroupSpecification``, ``JobSpecification``,
+``NotebookSpecification``, ``TensorboardSpecification``, ...) and with the
+framework cluster-definition logic in ``polypod/tensorflow.py:10-123``
+(cluster_def / per-task resources).  TPU-native difference: ``cluster_def``
+becomes a *gang plan* (num_hosts × devices/host + mesh axes) instead of
+{master/worker/ps: addresses}.
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from pydantic import BaseModel, ConfigDict, Field, field_validator
+
+from polyaxon_tpu.exceptions import SchemaError
+from polyaxon_tpu.schemas.environments import EnvironmentConfig
+from polyaxon_tpu.schemas.hptuning import HPTuningConfig
+from polyaxon_tpu.schemas.run import BuildConfig, RunConfig
+
+
+class Kinds:
+    EXPERIMENT = "experiment"
+    GROUP = "group"
+    JOB = "job"
+    BUILD = "build"
+    NOTEBOOK = "notebook"
+    TENSORBOARD = "tensorboard"
+    PIPELINE = "pipeline"
+    VALUES = (EXPERIMENT, GROUP, JOB, BUILD, NOTEBOOK, TENSORBOARD, PIPELINE)
+
+
+_TEMPLATE_RE = re.compile(r"\{\{\s*([\w.]+)\s*\}\}")
+
+
+def interpolate(value: Any, params: Dict[str, Any]) -> Any:
+    """Substitute ``{{ name }}`` templates with declaration values.
+
+    Dotted names traverse nested dicts.  A string that is exactly one
+    template resolves to the raw value (keeping its type); mixed strings
+    render values inline.  Parity: the reference's jinja declarations
+    (``tests/fixtures_static/advanced_file.yml``), restricted to variable
+    substitution (no for/if — control flow belongs in python entrypoints).
+    """
+
+    def lookup(name: str) -> Any:
+        node: Any = params
+        for part in name.split("."):
+            if not isinstance(node, dict) or part not in node:
+                raise SchemaError(f"Unknown template variable {name!r}")
+            node = node[part]
+        return node
+
+    if isinstance(value, str):
+        exact = _TEMPLATE_RE.fullmatch(value.strip())
+        if exact:
+            return lookup(exact.group(1))
+        return _TEMPLATE_RE.sub(lambda m: str(lookup(m.group(1))), value)
+    if isinstance(value, dict):
+        return {k: interpolate(v, params) for k, v in value.items()}
+    if isinstance(value, list):
+        return [interpolate(v, params) for v in value]
+    return value
+
+
+class BaseSpecification(BaseModel):
+    """Common document shape. ``declarations`` are the run's hyperparameters."""
+
+    version: int = 1
+    kind: str
+    name: Optional[str] = None
+    description: Optional[str] = None
+    tags: List[str] = Field(default_factory=list)
+    declarations: Dict[str, Any] = Field(default_factory=dict)
+    environment: EnvironmentConfig = Field(default_factory=EnvironmentConfig)
+    build: Optional[BuildConfig] = None
+
+    model_config = ConfigDict(extra="forbid")
+
+    @field_validator("version")
+    @classmethod
+    def _check_version(cls, v: int) -> int:
+        if v != 1:
+            raise ValueError(f"Unsupported spec version {v}")
+        return v
+
+    # -- gang plan (cluster_def equivalent) -----------------------------------
+    @property
+    def gang_def(self) -> Tuple[int, int]:
+        """(num_hosts, devices_per_host) — replaces reference cluster_def."""
+        topo = self.environment.topology
+        return int(topo.num_hosts), topo.devices_per_host
+
+    @property
+    def mesh_axes(self) -> Dict[str, int]:
+        return self.environment.topology.resolved_mesh()
+
+    def to_dict(self) -> Dict[str, Any]:
+        data = self.model_dump(exclude_none=True)
+        return data
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "BaseSpecification":
+        try:
+            return cls.model_validate(data)
+        except Exception as e:  # normalize pydantic errors to SchemaError
+            raise SchemaError(str(e)) from e
+
+
+class ExperimentSpecification(BaseSpecification):
+    kind: str = Kinds.EXPERIMENT
+    run: RunConfig
+
+    @field_validator("kind")
+    @classmethod
+    def _check_kind(cls, v: str) -> str:
+        if v != Kinds.EXPERIMENT:
+            raise ValueError(f"Expected kind=experiment, got {v!r}")
+        return v
+
+    def resolved_run(self) -> RunConfig:
+        """Run section with declarations interpolated."""
+        data = self.run.model_dump()
+        return RunConfig.model_validate(interpolate(data, self.declarations))
+
+
+class JobSpecification(ExperimentSpecification):
+    """Generic run-once job (reference ``polypod/job.py``): same shape as an
+    experiment but without metric/hptuning semantics."""
+
+    kind: str = Kinds.JOB
+
+    @field_validator("kind")
+    @classmethod
+    def _check_kind(cls, v: str) -> str:
+        if v not in (Kinds.JOB, Kinds.BUILD):
+            raise ValueError(f"Expected kind=job|build, got {v!r}")
+        return v
+
+
+class ServiceSpecification(BaseSpecification):
+    """Long-running service (notebook / tensorboard / dashboard).
+
+    Parity: reference ``polypod/notebook.py:35``, ``polypod/tensorboard.py:32``.
+    """
+
+    kind: str = Kinds.NOTEBOOK
+    run: Optional[RunConfig] = None
+    port: int = 0  # 0 = auto-assign
+
+    @field_validator("kind")
+    @classmethod
+    def _check_kind(cls, v: str) -> str:
+        if v not in (Kinds.NOTEBOOK, Kinds.TENSORBOARD):
+            raise ValueError(f"Expected kind=notebook|tensorboard, got {v!r}")
+        return v
+
+
+class GroupSpecification(BaseSpecification):
+    """An hptuning sweep over an experiment template.
+
+    Parity: reference ``GroupSpecification`` + the bridge used by hpsearch:
+    ``spec.get_experiment_spec(matrix_declaration)``
+    (``hpsearch/tasks/base.py:33-55``).
+    """
+
+    kind: str = Kinds.GROUP
+    run: RunConfig
+    hptuning: HPTuningConfig
+
+    model_config = ConfigDict(extra="forbid", arbitrary_types_allowed=True)
+
+    @field_validator("kind")
+    @classmethod
+    def _check_kind(cls, v: str) -> str:
+        if v != Kinds.GROUP:
+            raise ValueError(f"Expected kind=group, got {v!r}")
+        return v
+
+    def get_experiment_spec(self, matrix_declaration: Dict[str, Any]) -> ExperimentSpecification:
+        """Materialize one trial: group spec minus hptuning, declarations
+        merged with the suggestion (suggestion wins)."""
+        data = self.model_dump(exclude_none=True, exclude={"hptuning"})
+        data["kind"] = Kinds.EXPERIMENT
+        data["declarations"] = {**copy.deepcopy(self.declarations), **matrix_declaration}
+        return ExperimentSpecification.model_validate(data)
+
+    @property
+    def matrix_space(self) -> Optional[int]:
+        """Grid cardinality, None if any param is a continuous distribution."""
+        total = 1
+        for m in self.hptuning.matrix.values():
+            n = m.length
+            if n is None:
+                return None
+            total *= n
+        return total
+
+
+class PipelineSpecification(BaseSpecification):
+    """DAG-of-operations spec (reference ``polyflow`` + ``db/models/pipelines.py``).
+
+    ``ops`` is a list of {name, template|run sections, dependencies: [names]}.
+    """
+
+    kind: str = Kinds.PIPELINE
+    ops: List[Dict[str, Any]] = Field(default_factory=list)
+    concurrency: Optional[int] = None
+
+    @field_validator("kind")
+    @classmethod
+    def _check_kind(cls, v: str) -> str:
+        if v != Kinds.PIPELINE:
+            raise ValueError(f"Expected kind=pipeline, got {v!r}")
+        return v
+
+    @field_validator("ops")
+    @classmethod
+    def _check_ops(cls, v: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        names = [op.get("name") for op in v]
+        if any(n is None for n in names):
+            raise ValueError("every pipeline op needs a name")
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate op names in pipeline: {names}")
+        known = set(names)
+        for op in v:
+            for dep in op.get("dependencies", []):
+                if dep not in known:
+                    raise ValueError(f"op {op['name']!r} depends on unknown op {dep!r}")
+        return v
+
+
+_KIND_TO_SPEC = {
+    Kinds.EXPERIMENT: ExperimentSpecification,
+    Kinds.GROUP: GroupSpecification,
+    Kinds.JOB: JobSpecification,
+    Kinds.BUILD: JobSpecification,
+    Kinds.NOTEBOOK: ServiceSpecification,
+    Kinds.TENSORBOARD: ServiceSpecification,
+    Kinds.PIPELINE: PipelineSpecification,
+}
+
+
+def specification_for_kind(kind: str) -> type:
+    try:
+        return _KIND_TO_SPEC[kind]
+    except KeyError:
+        raise SchemaError(f"Unknown kind {kind!r}; one of {Kinds.VALUES}") from None

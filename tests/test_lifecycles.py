@@ -1,0 +1,107 @@
+"""Lifecycle machine tests.
+
+Mirrors the transition-gating assertions the reference makes implicitly in
+``scheduler/tasks/experiments.py:72-77`` and its lifecycle classes.
+"""
+
+import pytest
+
+from polyaxon_tpu.lifecycles import (
+    ExperimentLifeCycle,
+    GroupLifeCycle,
+    JobLifeCycle,
+    PipelineLifeCycle,
+    StatusOptions as S,
+    lifecycle_for_kind,
+)
+from polyaxon_tpu.lifecycles.registry import gang_status
+
+
+class TestExperimentLifeCycle:
+    def test_creation_only_from_nothing(self):
+        assert ExperimentLifeCycle.can_transition(None, S.CREATED)
+        assert not ExperimentLifeCycle.can_transition(S.RUNNING, S.CREATED)
+
+    def test_happy_path(self):
+        chain = [S.CREATED, S.BUILDING, S.SCHEDULED, S.STARTING, S.RUNNING, S.SUCCEEDED]
+        for frm, to in zip(chain, chain[1:]):
+            assert ExperimentLifeCycle.can_transition(frm, to), (frm, to)
+
+    def test_skipping_phases_is_legal(self):
+        assert ExperimentLifeCycle.can_transition(S.CREATED, S.RUNNING)
+        assert ExperimentLifeCycle.can_transition(S.CREATED, S.FAILED)
+
+    def test_done_is_terminal_except_resume_and_stop(self):
+        for done in (S.SUCCEEDED, S.FAILED, S.UPSTREAM_FAILED, S.SKIPPED):
+            assert not ExperimentLifeCycle.can_transition(done, S.RUNNING), done
+            assert ExperimentLifeCycle.is_done(done)
+        assert ExperimentLifeCycle.can_transition(S.SUCCEEDED, S.RESUMING)
+        assert ExperimentLifeCycle.can_transition(S.STOPPED, S.RESUMING)
+        assert ExperimentLifeCycle.can_transition(S.FAILED, S.STOPPED)
+        assert not ExperimentLifeCycle.can_transition(S.STOPPED, S.STOPPED)
+
+    def test_resume_reenters_pipeline(self):
+        assert ExperimentLifeCycle.can_transition(S.RESUMING, S.SCHEDULED)
+        assert ExperimentLifeCycle.can_transition(S.RESUMING, S.RUNNING)
+
+    def test_transient_states(self):
+        assert ExperimentLifeCycle.can_transition(S.RUNNING, S.WARNING)
+        assert ExperimentLifeCycle.can_transition(S.WARNING, S.RUNNING)
+        assert not ExperimentLifeCycle.can_transition(S.SUCCEEDED, S.WARNING)
+        assert not ExperimentLifeCycle.can_transition(S.WARNING, S.WARNING)
+        assert ExperimentLifeCycle.can_transition(S.UNKNOWN, S.FAILED)
+
+    def test_predicates(self):
+        assert ExperimentLifeCycle.is_running(S.RUNNING)
+        assert ExperimentLifeCycle.is_running(S.BUILDING)
+        assert ExperimentLifeCycle.is_pending(S.CREATED)
+        assert ExperimentLifeCycle.failed(S.UPSTREAM_FAILED)
+        assert ExperimentLifeCycle.succeeded(S.SUCCEEDED)
+        assert ExperimentLifeCycle.is_stoppable(S.RUNNING)
+        assert not ExperimentLifeCycle.is_stoppable(S.SUCCEEDED)
+        assert ExperimentLifeCycle.needs_heartbeat(S.RUNNING)
+        assert not ExperimentLifeCycle.needs_heartbeat(S.CREATED)
+
+
+class TestOtherLifecycles:
+    def test_job_has_no_resume(self):
+        assert not JobLifeCycle.can_transition(S.SUCCEEDED, S.RESUMING)
+
+    def test_group_done_status(self):
+        assert GroupLifeCycle.can_transition(S.RUNNING, S.DONE)
+        assert GroupLifeCycle.is_done(S.DONE)
+
+    def test_pipeline(self):
+        assert PipelineLifeCycle.can_transition(S.CREATED, S.SCHEDULED)
+        assert PipelineLifeCycle.can_transition(S.SCHEDULED, S.RUNNING)
+        assert PipelineLifeCycle.is_done(S.UPSTREAM_FAILED)
+
+    def test_kind_registry(self):
+        assert lifecycle_for_kind("experiment") is ExperimentLifeCycle
+        assert lifecycle_for_kind("build") is JobLifeCycle
+        with pytest.raises(KeyError):
+            lifecycle_for_kind("nope")
+
+
+class TestGangStatus:
+    def test_empty(self):
+        assert gang_status([]) is None
+
+    def test_all_succeeded(self):
+        assert gang_status([S.SUCCEEDED] * 4) == S.SUCCEEDED
+
+    def test_partial_success_is_not_success(self):
+        assert gang_status([S.SUCCEEDED, S.RUNNING]) == S.RUNNING
+
+    def test_any_failure_fails_gang(self):
+        assert gang_status([S.RUNNING, S.FAILED, S.RUNNING]) == S.FAILED
+        assert gang_status([S.SUCCEEDED, S.UPSTREAM_FAILED]) == S.FAILED
+
+    def test_unknown_dominates(self):
+        assert gang_status([S.UNKNOWN, S.FAILED]) == S.UNKNOWN
+
+    def test_starting_phase(self):
+        assert gang_status([S.SCHEDULED, S.STARTING]) == S.STARTING
+
+    def test_stopped(self):
+        assert gang_status([S.STOPPED, S.RUNNING]) == S.STOPPED

@@ -1,0 +1,119 @@
+"""Matrix op tests (parity model: reference
+``tests/test_experiment_groups/test_search_managers.py`` exercises these
+spaces via the search managers; here the space itself is unit-tested)."""
+
+import numpy as np
+import pytest
+
+from polyaxon_tpu.exceptions import SchemaError
+from polyaxon_tpu.schemas.matrix import MatrixConfig
+
+
+class TestGridOps:
+    def test_values(self):
+        m = MatrixConfig.from_dict({"values": [1, 2, 3]})
+        assert m.length == 3
+        assert list(m.to_numpy()) == [1, 2, 3]
+        assert not m.is_distribution
+        assert m.min == 1 and m.max == 3
+
+    def test_categorical_values(self):
+        m = MatrixConfig.from_dict({"values": ["adam", "sgd"]})
+        assert m.is_categorical
+        assert m.min is None
+
+    def test_range_forms(self):
+        for arg in ([0, 10, 2], "0:10:2", {"start": 0, "stop": 10, "step": 2}):
+            m = MatrixConfig.from_dict({"range": arg})
+            assert list(m.to_numpy()) == [0, 2, 4, 6, 8], arg
+            assert m.length == 5
+
+    def test_linspace_logspace_geomspace(self):
+        assert MatrixConfig.from_dict({"linspace": "0:1:5"}).length == 5
+        np.testing.assert_allclose(
+            MatrixConfig.from_dict({"logspace": "0:2:3"}).to_numpy(), [1, 10, 100]
+        )
+        np.testing.assert_allclose(
+            MatrixConfig.from_dict({"geomspace": "1:64:4"}).to_numpy(),
+            [1.0, 4.0, 16.0, 64.0],
+        )
+
+    def test_grid_sample_stays_in_grid(self):
+        m = MatrixConfig.from_dict({"values": [5, 7, 9]})
+        rng = np.random.default_rng(0)
+        assert all(m.sample(rng) in (5, 7, 9) for _ in range(20))
+
+
+class TestDistributions:
+    def test_uniform_bounds(self):
+        m = MatrixConfig.from_dict({"uniform": [0.1, 0.9]})
+        rng = np.random.default_rng(0)
+        samples = [m.sample(rng) for _ in range(100)]
+        assert all(0.1 <= s <= 0.9 for s in samples)
+        assert m.is_continuous and m.length is None
+        with pytest.raises(SchemaError):
+            m.to_numpy()
+
+    def test_quniform_quantized(self):
+        m = MatrixConfig.from_dict({"quniform": [0, 1, 0.25]})
+        rng = np.random.default_rng(0)
+        for _ in range(50):
+            s = m.sample(rng)
+            assert s in (0.0, 0.25, 0.5, 0.75, 1.0)
+
+    def test_loguniform(self):
+        m = MatrixConfig.from_dict({"loguniform": [1e-5, 1e-1]})
+        rng = np.random.default_rng(0)
+        samples = np.array([m.sample(rng) for _ in range(500)])
+        assert samples.min() >= 1e-5 and samples.max() <= 1e-1
+        # log-uniform: median orders of magnitude below arithmetic mean
+        assert np.median(samples) < samples.mean()
+
+    def test_normal_family(self):
+        rng = np.random.default_rng(0)
+        m = MatrixConfig.from_dict({"normal": [0, 1]})
+        xs = np.array([m.sample(rng) for _ in range(2000)])
+        assert abs(xs.mean()) < 0.1
+        q = MatrixConfig.from_dict({"qnormal": [0, 1, 0.5]})
+        assert all(abs(q.sample(rng) / 0.5 % 1) < 1e-9 for _ in range(20))
+        ln = MatrixConfig.from_dict({"lognormal": [0, 1]})
+        assert all(ln.sample(rng) > 0 for _ in range(20))
+
+    def test_pvalues(self):
+        m = MatrixConfig.from_dict({"pvalues": [["a", 0.9], ["b", 0.1]]})
+        assert m.is_categorical
+        rng = np.random.default_rng(0)
+        samples = [m.sample(rng) for _ in range(200)]
+        assert samples.count("a") > samples.count("b")
+
+    def test_pvalues_must_sum_to_one(self):
+        with pytest.raises(SchemaError):
+            MatrixConfig.from_dict({"pvalues": [["a", 0.5], ["b", 0.1]]})
+
+    def test_seeded_determinism(self):
+        m = MatrixConfig.from_dict({"uniform": [0, 1]})
+        a = [m.sample(np.random.default_rng(42)) for _ in range(3)]
+        b = [m.sample(np.random.default_rng(42)) for _ in range(3)]
+        assert a == b
+
+
+class TestValidation:
+    def test_unknown_op(self):
+        with pytest.raises(SchemaError):
+            MatrixConfig.from_dict({"bogus": [1, 2]})
+
+    def test_two_ops(self):
+        with pytest.raises(SchemaError):
+            MatrixConfig.from_dict({"values": [1], "uniform": [0, 1]})
+
+    def test_empty_values(self):
+        with pytest.raises(SchemaError):
+            MatrixConfig.from_dict({"values": []})
+
+    def test_zero_step_range(self):
+        with pytest.raises(SchemaError):
+            MatrixConfig.from_dict({"range": [0, 10, 0]})
+
+    def test_roundtrip(self):
+        m = MatrixConfig.from_dict({"linspace": "0:1:5"})
+        assert MatrixConfig.from_dict(m.to_dict()) == m

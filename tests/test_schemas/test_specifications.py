@@ -1,0 +1,193 @@
+"""Spec DSL + polyaxonfile tests.
+
+Parity model: reference spec validation (``polyaxon/libs/spec_validation.py``)
+and cluster-def assertions (``tests/test_spawner/test_spawner.py:17-53``) —
+here the gang plan replaces cluster_def.
+"""
+
+import pytest
+
+from polyaxon_tpu.compiler import compile_spec
+from polyaxon_tpu.compiler.service import compile_gang_plan
+from polyaxon_tpu.exceptions import CompilerError, SchemaError
+from polyaxon_tpu.schemas import (
+    ExperimentSpecification,
+    GroupSpecification,
+    Kinds,
+    PolyaxonFile,
+)
+from polyaxon_tpu.schemas.specifications import interpolate
+
+EXPERIMENT_YAML = """
+version: 1
+kind: experiment
+name: cifar10-dp
+declarations:
+  lr: 0.05
+  batch_size: 512
+environment:
+  topology:
+    accelerator: v5e-16
+    mesh: {data: -1, tensor: 2}
+    strategy: tp_dp
+  restart_policy: {max_restarts: 2}
+run:
+  entrypoint: polyaxon_tpu.models.trainers:train_classifier
+  kwargs: {model: simple_cnn, dataset: cifar10}
+"""
+
+GROUP_YAML = """
+version: 1
+kind: group
+declarations: {batch_size: 128}
+hptuning:
+  concurrency: 4
+  matrix:
+    lr: {loguniform: [0.0001, 0.1]}
+    depth: {values: [2, 4]}
+  random_search: {n_experiments: 8, seed: 33}
+run:
+  cmd: "python train.py --lr={{ lr }} --depth={{ depth }} --bs={{ batch_size }}"
+"""
+
+
+class TestExperimentSpec:
+    def test_parse_and_gang_plan(self):
+        spec = compile_spec(EXPERIMENT_YAML, kind=Kinds.EXPERIMENT)
+        assert isinstance(spec, ExperimentSpecification)
+        assert spec.gang_def == (2, 8)  # v5e-16: 16 chips over 2 hosts
+        assert spec.mesh_axes == {"data": 8, "tensor": 2}
+        plan = compile_gang_plan(spec)
+        assert plan.num_hosts == 2
+        assert plan.num_devices == 16
+        assert plan.strategy == "tp_dp"
+        assert plan.max_restarts == 2
+
+    def test_kind_mismatch(self):
+        with pytest.raises(CompilerError):
+            compile_spec(EXPERIMENT_YAML, kind=Kinds.GROUP)
+
+    def test_mesh_must_match_devices(self):
+        with pytest.raises(SchemaError):
+            compile_spec(
+                {
+                    "kind": "experiment",
+                    "environment": {"topology": {"accelerator": "v5e-8", "mesh": {"data": 3}}},
+                    "run": {"cmd": "true"},
+                }
+            )
+
+    def test_run_requires_exactly_one_of_cmd_entrypoint(self):
+        with pytest.raises(SchemaError):
+            compile_spec({"kind": "experiment", "run": {}})
+        with pytest.raises(SchemaError):
+            compile_spec(
+                {"kind": "experiment", "run": {"cmd": "x", "entrypoint": "a:b"}}
+            )
+
+    def test_unknown_accelerator_needs_explicit_counts(self):
+        with pytest.raises(SchemaError):
+            compile_spec(
+                {"kind": "experiment", "run": {"cmd": "x"},
+                 "environment": {"topology": {"accelerator": "v99-512"}}}
+            )
+        spec = compile_spec(
+            {"kind": "experiment", "run": {"cmd": "x"},
+             "environment": {"topology": {"accelerator": "v99-512",
+                                          "num_devices": 512, "num_hosts": 64}}}
+        )
+        assert spec.gang_def == (64, 8)
+
+
+class TestInterpolation:
+    def test_exact_template_keeps_type(self):
+        assert interpolate("{{ lr }}", {"lr": 0.05}) == 0.05
+
+    def test_inline_rendering(self):
+        out = interpolate("--lr={{lr}} --bs={{ bs }}", {"lr": 0.1, "bs": 64})
+        assert out == "--lr=0.1 --bs=64"
+
+    def test_dotted_lookup(self):
+        assert interpolate("{{ cnn.kernels }}", {"cnn": {"kernels": [64, 32]}}) == [64, 32]
+
+    def test_unknown_var(self):
+        with pytest.raises(SchemaError):
+            interpolate("{{ nope }}", {})
+
+    def test_resolved_run(self):
+        spec = compile_spec(
+            {"kind": "experiment", "declarations": {"lr": 0.2},
+             "run": {"cmd": "train --lr={{ lr }}"}}
+        )
+        assert spec.resolved_run().cmd == "train --lr=0.2"
+
+
+class TestGroupSpec:
+    def test_parse(self):
+        spec = compile_spec(GROUP_YAML, kind=Kinds.GROUP)
+        assert isinstance(spec, GroupSpecification)
+        assert spec.hptuning.search_algorithm == "random"
+        assert spec.hptuning.concurrency == 4
+        assert spec.matrix_space is None  # loguniform is continuous
+
+    def test_get_experiment_spec_merges_suggestion(self):
+        spec = compile_spec(GROUP_YAML)
+        exp = spec.get_experiment_spec({"lr": 0.01, "depth": 4})
+        assert exp.kind == Kinds.EXPERIMENT
+        assert exp.declarations == {"batch_size": 128, "lr": 0.01, "depth": 4}
+        assert exp.resolved_run().cmd == "python train.py --lr=0.01 --depth=4 --bs=128"
+
+    def test_grid_space_cardinality(self):
+        spec = compile_spec(
+            {"kind": "group",
+             "hptuning": {"matrix": {"a": {"values": [1, 2, 3]}, "b": {"linspace": "0:1:4"}}},
+             "run": {"cmd": "x"}}
+        )
+        assert spec.matrix_space == 12
+
+    def test_two_algorithms_rejected(self):
+        with pytest.raises(SchemaError):
+            compile_spec(
+                {"kind": "group",
+                 "hptuning": {"matrix": {"a": {"values": [1]}},
+                              "grid_search": {}, "random_search": {"n_experiments": 2}},
+                 "run": {"cmd": "x"}}
+            )
+
+
+class TestPolyaxonFile:
+    def test_kind_autodetect_experiment(self):
+        pf = PolyaxonFile.load({"run": {"cmd": "echo"}})
+        assert pf.kind == Kinds.EXPERIMENT
+
+    def test_kind_autodetect_group_from_hptuning(self):
+        pf = PolyaxonFile.load(
+            {"hptuning": {"matrix": {"lr": {"values": [1]}}}, "run": {"cmd": "echo"}}
+        )
+        assert pf.kind == Kinds.GROUP
+
+    def test_legacy_top_level_matrix(self):
+        pf = PolyaxonFile.load({"matrix": {"lr": {"values": [1]}}, "run": {"cmd": "echo"}})
+        assert pf.kind == Kinds.GROUP
+
+    def test_from_path(self, tmp_path):
+        p = tmp_path / "spec.yml"
+        p.write_text(EXPERIMENT_YAML)
+        pf = PolyaxonFile.load(p)
+        assert pf.specification.name == "cifar10-dp"
+
+    def test_pipeline_dag_validation(self):
+        with pytest.raises(SchemaError):
+            PolyaxonFile.load(
+                {"kind": "pipeline",
+                 "ops": [{"name": "a", "dependencies": ["missing"]}]}
+            )
+        pf = PolyaxonFile.load(
+            {"kind": "pipeline",
+             "ops": [{"name": "a"}, {"name": "b", "dependencies": ["a"]}]}
+        )
+        assert pf.kind == Kinds.PIPELINE
+
+    def test_extra_keys_rejected(self):
+        with pytest.raises(SchemaError):
+            PolyaxonFile.load({"run": {"cmd": "x"}, "bogus_section": 1})
