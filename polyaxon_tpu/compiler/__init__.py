@@ -1,3 +1,3 @@
-from polyaxon_tpu.compiler.service import compile_spec
+from polyaxon_tpu.compiler.service import GangPlan, compile_gang_plan, compile_spec
 
-__all__ = ["compile_spec"]
+__all__ = ["GangPlan", "compile_gang_plan", "compile_spec"]

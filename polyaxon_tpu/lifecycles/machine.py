@@ -57,12 +57,19 @@ class LifeCycle:
         transient: Iterable[str] = (StatusOptions.WARNING, StatusOptions.UNKNOWN),
         failed: Iterable[str] = (StatusOptions.FAILED, StatusOptions.UPSTREAM_FAILED),
         resumable_from: Iterable[str] = (),
+        resume_statuses: Iterable[str] = (StatusOptions.RESUMING, StatusOptions.RETRYING),
         heartbeat: Iterable[str] = (StatusOptions.RUNNING,),
         extra_edges: Optional[Mapping[str, Iterable[str]]] = None,
     ) -> None:
+        self._preparing_order = tuple(preparing)
+        self._running_order = tuple(running)
+        #: Pending statuses acting as explicit resume entry points: reachable
+        #: only from ``resumable_from`` (never from nothing); every other
+        #: pending status is reachable only at creation time (from ``None``).
+        self._resume_statuses = tuple(resume_statuses)
         self.PENDING_STATUS: FrozenSet[str] = frozenset(pending)
-        self.PREPARING_STATUS: FrozenSet[str] = frozenset(preparing)
-        self.RUNNING_STATUS: FrozenSet[str] = frozenset(running)
+        self.PREPARING_STATUS: FrozenSet[str] = frozenset(self._preparing_order)
+        self.RUNNING_STATUS: FrozenSet[str] = frozenset(self._running_order)
         self.DONE_STATUS: FrozenSet[str] = frozenset(done)
         self.TRANSIENT_STATUS: FrozenSet[str] = frozenset(transient)
         self.FAILED_STATUS: FrozenSet[str] = frozenset(failed) & self.DONE_STATUS
@@ -83,28 +90,28 @@ class LifeCycle:
         extra_edges: Mapping[str, Iterable[str]],
     ) -> Dict[str, Set[str]]:
         live = self.VALUES - self.DONE_STATUS
-        ordered_phases = [
-            self.PENDING_STATUS,
-            self.PREPARING_STATUS,
-            self.RUNNING_STATUS,
-        ]
         matrix: Dict[str, Set[str]] = {}
-        # Entry states are only reachable at creation time (from nothing) or
-        # via an explicit resume edge.
+        # Entry states are reachable only at creation time (from nothing);
+        # resume states only via their explicit resume edges (the reference
+        # routes resume through RESUMING the same way —
+        # lifecycles/experiments.py TRANSITION_MATRIX: CREATED: {None}).
+        resume_members = self.PENDING_STATUS & set(self._resume_statuses)
         for status in self.PENDING_STATUS:
-            matrix[status] = {None} | set(resumable_from)  # type: ignore[arg-type]
-        # Forward motion: a preparing/running state is reachable from any
-        # earlier live phase and from transient states.
+            if status in resume_members:
+                matrix[status] = set(resumable_from)
+            else:
+                matrix[status] = {None}  # type: ignore[arg-type]
+        # Forward motion only: a preparing/running state is reachable from any
+        # earlier live phase, from transient states, and from *earlier*
+        # statuses within its own phase (phase tuples are ordered, e.g.
+        # scheduled → starting → running; backward moves are illegal).
         seen_earlier: Set[str] = set(self.PENDING_STATUS)
-        for phase in ordered_phases[1:]:
-            for status in phase:
-                matrix[status] = set(seen_earlier) | set(self.TRANSIENT_STATUS)
-            seen_earlier |= phase
-        # Within-phase motion for the running phase (scheduled→starting→running
-        # is ordered by the caller passing them in order; we simply allow any
-        # intra-phase move that is not a self-loop).
-        for status in self.RUNNING_STATUS:
-            matrix[status] |= self.RUNNING_STATUS - {status}
+        for phase_order in (self._preparing_order, self._running_order):
+            phase_seen: Set[str] = set()
+            for status in phase_order:
+                matrix[status] = set(seen_earlier) | set(self.TRANSIENT_STATUS) | phase_seen
+                phase_seen.add(status)
+            seen_earlier |= set(phase_order)
         # Done states absorb everything live.
         for status in self.DONE_STATUS:
             matrix[status] = set(live)

@@ -25,7 +25,7 @@ S = StatusOptions
 ExperimentLifeCycle = LifeCycle(
     pending=(S.CREATED, S.RESUMING),
     preparing=(S.BUILDING,),
-    running=(S.SCHEDULED, S.STARTING, S.RUNNING),
+    running=(S.SCHEDULED, S.STARTING, S.RUNNING, S.STOPPING),
     done=(S.SUCCEEDED, S.FAILED, S.UPSTREAM_FAILED, S.STOPPED, S.SKIPPED),
     transient=(S.WARNING, S.UNKNOWN, S.UNSCHEDULABLE),
     resumable_from=(S.SUCCEEDED, S.STOPPED, S.SKIPPED, S.WARNING, S.FAILED),
@@ -35,7 +35,7 @@ ExperimentLifeCycle = LifeCycle(
 JobLifeCycle = LifeCycle(
     pending=(S.CREATED,),
     preparing=(S.BUILDING,),
-    running=(S.SCHEDULED, S.STARTING, S.RUNNING),
+    running=(S.SCHEDULED, S.STARTING, S.RUNNING, S.STOPPING),
     done=(S.SUCCEEDED, S.FAILED, S.UPSTREAM_FAILED, S.STOPPED, S.SKIPPED),
     transient=(S.WARNING, S.UNKNOWN, S.UNSCHEDULABLE),
 )
@@ -107,12 +107,23 @@ def gang_status(process_statuses: List[str]) -> Optional[str]:
         return S.FAILED
     if S.STOPPED in statuses:
         return S.STOPPED
+    if S.STOPPING in statuses:
+        # Still live: the stop may fail; only STOPPED is terminal.
+        return S.STOPPING
     if S.WARNING in statuses:
         return S.WARNING
-    if statuses == {S.SUCCEEDED}:
-        return S.SUCCEEDED
-    if S.RUNNING in statuses:
+    done = {S.SUCCEEDED, S.SKIPPED}
+    if statuses <= done:
+        # All processes finished cleanly; a mixed succeeded/skipped gang
+        # counts as succeeded (skip only wins when unanimous).
+        return S.SUCCEEDED if S.SUCCEEDED in statuses else S.SKIPPED
+    if S.RUNNING in statuses or (statuses & done):
+        # Any process running — or some done while others still progress.
         return S.RUNNING
     if S.STARTING in statuses or S.SCHEDULED in statuses or S.BUILDING in statuses:
         return S.STARTING
+    if statuses <= {S.CREATED, S.RESUMING}:
+        # Freshly created gang: pending, not unknown (the reference folds
+        # CREATED into its starting phase — jobs.py STARTING_STATUS).
+        return S.CREATED
     return S.UNKNOWN

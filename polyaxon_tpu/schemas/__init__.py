@@ -1,4 +1,5 @@
 from polyaxon_tpu.schemas.environments import (
+    EnvironmentConfig,
     MeshConfig,
     ResourcesConfig,
     RestartPolicyConfig,
@@ -37,6 +38,7 @@ __all__ = [
     "EarlyStoppingConfig",
     "SearchMetricConfig",
     "TopologyConfig",
+    "EnvironmentConfig",
     "MeshConfig",
     "ResourcesConfig",
     "RestartPolicyConfig",

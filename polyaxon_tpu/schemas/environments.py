@@ -139,6 +139,11 @@ class TopologyConfig(BaseModel):
                     f"Unknown accelerator {self.accelerator!r}: set num_devices "
                     f"and num_hosts explicitly (known: {sorted(ACCELERATOR_CATALOG)})"
                 )
+        if self.num_devices % self.num_hosts != 0:
+            raise ValueError(
+                f"num_devices ({self.num_devices}) must be divisible by "
+                f"num_hosts ({self.num_hosts})"
+            )
         if self.mesh is not None:
             self.mesh.resolve(self.num_devices)  # raises if inconsistent
         return self

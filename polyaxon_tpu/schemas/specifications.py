@@ -182,6 +182,14 @@ class GroupSpecification(BaseSpecification):
             raise ValueError(f"Expected kind=group, got {v!r}")
         return v
 
+    def to_dict(self) -> Dict[str, Any]:
+        # model_dump leaves MatrixConfig instances embedded (the field is
+        # arbitrary-typed); route through HPTuningConfig.to_dict so the result
+        # is json-serializable.
+        data = super().to_dict()
+        data["hptuning"] = self.hptuning.to_dict()
+        return data
+
     def get_experiment_spec(self, matrix_declaration: Dict[str, Any]) -> ExperimentSpecification:
         """Materialize one trial: group spec minus hptuning, declarations
         merged with the suggestion (suggestion wins)."""

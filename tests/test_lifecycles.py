@@ -44,6 +44,22 @@ class TestExperimentLifeCycle:
         assert ExperimentLifeCycle.can_transition(S.RESUMING, S.SCHEDULED)
         assert ExperimentLifeCycle.can_transition(S.RESUMING, S.RUNNING)
 
+    def test_done_runs_cannot_be_reset_to_created(self):
+        # ADVICE r1: resume must route through RESUMING; CREATED only from None.
+        assert not ExperimentLifeCycle.can_transition(S.FAILED, S.CREATED)
+        assert not ExperimentLifeCycle.can_transition(S.SUCCEEDED, S.CREATED)
+        assert ExperimentLifeCycle.can_transition(S.FAILED, S.RESUMING)
+
+    def test_runs_cannot_be_born_resuming(self):
+        assert not ExperimentLifeCycle.can_transition(None, S.RESUMING)
+
+    def test_no_backward_motion_in_running_phase(self):
+        # VERDICT r1: SCHEDULED is not reachable from RUNNING.
+        assert not ExperimentLifeCycle.can_transition(S.RUNNING, S.SCHEDULED)
+        assert not ExperimentLifeCycle.can_transition(S.STARTING, S.SCHEDULED)
+        assert not ExperimentLifeCycle.can_transition(S.RUNNING, S.STARTING)
+        assert ExperimentLifeCycle.can_transition(S.SCHEDULED, S.STARTING)
+
     def test_transient_states(self):
         assert ExperimentLifeCycle.can_transition(S.RUNNING, S.WARNING)
         assert ExperimentLifeCycle.can_transition(S.WARNING, S.RUNNING)
@@ -105,3 +121,20 @@ class TestGangStatus:
 
     def test_stopped(self):
         assert gang_status([S.STOPPED, S.RUNNING]) == S.STOPPED
+
+    def test_stopping_is_live(self):
+        assert gang_status([S.STOPPING, S.RUNNING]) == S.STOPPING
+        assert ExperimentLifeCycle.can_transition(S.RUNNING, S.STOPPING)
+        assert ExperimentLifeCycle.can_transition(S.STOPPING, S.STOPPED)
+        assert not ExperimentLifeCycle.is_done(S.STOPPING)
+
+    def test_fresh_gang_is_created_not_unknown(self):
+        # ADVICE r1: a freshly created gang must not roll up to UNKNOWN.
+        assert gang_status([S.CREATED, S.CREATED]) == S.CREATED
+
+    def test_done_mix_rolls_up(self):
+        assert gang_status([S.SUCCEEDED, S.SKIPPED]) == S.SUCCEEDED
+        assert gang_status([S.SKIPPED, S.SKIPPED]) == S.SKIPPED
+
+    def test_partial_done_is_running(self):
+        assert gang_status([S.SUCCEEDED, S.CREATED]) == S.RUNNING
