@@ -1,0 +1,3 @@
+from polyaxon_tpu.auditor.service import Auditor
+
+__all__ = ["Auditor"]

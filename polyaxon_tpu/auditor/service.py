@@ -1,0 +1,41 @@
+"""Central event recorder + fan-out.
+
+Parity: reference ``auditor/service.py:33-58`` — ``record(event_type, ...)``
+serializes the event, persists it (activitylogs/tracker), and fans out to
+the executor and notifier.  Here the celery indirection is gone: handlers
+are plain callables invoked inline, in registration order; the executor's
+follow-up *actions* still go through the task bus so they get countdown /
+retry semantics.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, List, Optional
+
+from polyaxon_tpu.db.registry import RunRegistry
+from polyaxon_tpu.events import Event
+
+logger = logging.getLogger(__name__)
+
+Handler = Callable[[Event], None]
+
+
+class Auditor:
+    def __init__(self, registry: Optional[RunRegistry] = None) -> None:
+        self.registry = registry
+        self._handlers: List[Handler] = []
+
+    def subscribe(self, handler: Handler) -> None:
+        self._handlers.append(handler)
+
+    def record(self, event_type: str, **context: Any) -> Event:
+        event = Event(event_type=event_type, context=context)
+        if self.registry is not None:
+            self.registry.record_activity(event.event_type, event.context)
+        for handler in self._handlers:
+            try:
+                handler(event)
+            except Exception:  # noqa: BLE001 — an observer must not break the producer
+                logger.exception("Event handler failed for %s", event_type)
+        return event

@@ -1,0 +1,97 @@
+"""Built-in entrypoints: quick-start trainers + test probes.
+
+Parity: the reference's quick-start workloads (MNIST/CIFAR polyaxonfiles in
+its docs/tutorials) — here as in-process jax entrypoints any spec can point
+at (``run: {entrypoint: polyaxon_tpu.builtins.trainers:<name>}``).  The
+probe entrypoints (`failing`, `sleepy`, `flaky_once`) exist for the
+platform's own failure-handling tests, like the reference's fixture specs.
+"""
+
+from __future__ import annotations
+
+import time
+
+from polyaxon_tpu.tracking import Context
+
+
+def noop(ctx: Context) -> None:
+    """Smallest possible run: report one metric."""
+    ctx.log_text("noop trainer running")
+    ctx.log_metrics(step=0, done=1.0)
+
+
+def failing(ctx: Context) -> None:
+    """Always fails (failure-path probe)."""
+    raise RuntimeError("intentional failure")
+
+
+def sleepy(ctx: Context) -> None:
+    """Sleeps `seconds` (stop/zombie probe)."""
+    time.sleep(float(ctx.get_param("seconds", 30.0)))
+
+
+def flaky_once(ctx: Context) -> None:
+    """Fails on the first gang attempt, succeeds after restart.
+
+    Uses a marker file in outputs/ (which survives a gang restart) to
+    remember the first attempt.
+    """
+    marker = ctx.outputs_path / f"attempt_p{ctx.process_id}"
+    if not marker.exists():
+        marker.write_text("1")
+        raise RuntimeError("flaky first attempt")
+    ctx.log_metrics(recovered=1.0)
+
+
+def synthetic_regression(ctx: Context) -> None:
+    """A real (tiny) distributed training loop: pjit linear regression.
+
+    Exercises the full TPU-native path — mesh, NamedSharding, jit train
+    step, metric reporting — at a size that runs in milliseconds on the
+    virtual CPU mesh.  Params: lr, steps, batch, dim.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    lr = float(ctx.get_param("lr", 0.1))
+    steps = int(ctx.get_param("steps", 20))
+    batch = int(ctx.get_param("batch", 64))
+    dim = int(ctx.get_param("dim", 8))
+    seed = ctx.seed if ctx.seed is not None else 0
+
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(dim, 1)).astype(np.float32)
+    x = rng.normal(size=(batch, dim)).astype(np.float32)
+    y = x @ w_true + 0.01 * rng.normal(size=(batch, 1)).astype(np.float32)
+
+    params = {"w": jnp.zeros((dim, 1), jnp.float32)}
+    opt = optax.sgd(lr)
+    opt_state = opt.init(params)
+
+    mesh = ctx.mesh
+    if mesh is not None:
+        data_axes = tuple(n for n in mesh.axis_names if n in ("data", "fsdp", "replica"))
+        batch_sharding = NamedSharding(mesh, P(data_axes if data_axes else None))
+        x = jax.device_put(x, batch_sharding)
+        y = jax.device_put(y, batch_sharding)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            pred = x @ p["w"]
+            return jnp.mean((pred - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    loss = None
+    for i in range(steps):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        if ctx.is_leader and (i % 5 == 0 or i == steps - 1):
+            ctx.log_metrics(step=i, loss=float(loss))
+    ctx.log_text(f"final loss {float(loss):.6f}")

@@ -1,0 +1,3 @@
+from polyaxon_tpu.db.registry import Run, RunRegistry
+
+__all__ = ["Run", "RunRegistry"]

@@ -1,0 +1,672 @@
+"""Durable run registry: the control plane's single source of truth.
+
+Capability parity with the reference's Postgres models + Redis ephemeral
+state:
+
+- runs table        ~ ``db/models/experiments.py:48`` (``Experiment``) and the
+                      other entity models (jobs, groups, pipelines) folded into
+                      one polymorphic table keyed by ``kind``;
+- statuses table    ~ per-entity ``*Status`` models
+                      (``db/models/experiments.py:419``), with every write
+                      gated by the lifecycle machine the way the reference
+                      checks ``can_transition``
+                      (``scheduler/tasks/experiments.py:72-77``);
+- metrics table +   ~ ``ExperimentMetric`` rows + ``Experiment.set_metric``
+  ``last_metric``     merging into JSONB (``db/models/experiments.py:294-298``);
+- logs table        ~ the logs store written by ``logs_handlers/``;
+- heartbeats        ~ ``db/redis/heartbeat.py`` (``RedisHeartBeat``);
+- iterations        ~ ``ExperimentGroupIteration``
+                      (``db/models/experiment_groups.py:414``);
+- processes         ~ ``ExperimentJob`` rows (the replica unit,
+                      ``db/models/experiment_jobs.py``) — here a gang's host
+                      processes;
+- activity table    ~ ``activitylogs/``;
+- options table     ~ the DB-backed store of ``options/option.py:13-40``.
+
+TPU-native differences: sqlite (WAL) instead of Postgres+Redis — the control
+plane is a single service and workers report through run-dir files, so one
+embedded, multi-process-safe database replaces both; statuses/metrics/logs
+are ordinary rows so the streaming layer can tail them with a cursor.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+import uuid as uuid_mod
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+from polyaxon_tpu.exceptions import PolyaxonTPUError
+from polyaxon_tpu.lifecycles import StatusOptions as S, lifecycle_for_kind
+from polyaxon_tpu.schemas.specifications import (
+    BaseSpecification,
+    specification_for_kind,
+)
+
+
+class RegistryError(PolyaxonTPUError):
+    pass
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS runs (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    uuid TEXT UNIQUE NOT NULL,
+    kind TEXT NOT NULL,
+    name TEXT,
+    project TEXT NOT NULL DEFAULT 'default',
+    spec TEXT NOT NULL,
+    status TEXT NOT NULL,
+    group_id INTEGER,
+    pipeline_id INTEGER,
+    original_id INTEGER,
+    cloning_strategy TEXT,
+    restarts INTEGER NOT NULL DEFAULT 0,
+    tags TEXT NOT NULL DEFAULT '[]',
+    last_metric TEXT NOT NULL DEFAULT '{}',
+    outputs_path TEXT,
+    code_ref TEXT,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL,
+    started_at REAL,
+    finished_at REAL
+);
+CREATE INDEX IF NOT EXISTS ix_runs_kind ON runs (kind);
+CREATE INDEX IF NOT EXISTS ix_runs_group ON runs (group_id);
+CREATE INDEX IF NOT EXISTS ix_runs_status ON runs (status);
+
+CREATE TABLE IF NOT EXISTS statuses (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_id INTEGER NOT NULL,
+    status TEXT NOT NULL,
+    message TEXT,
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_statuses_run ON statuses (run_id);
+
+CREATE TABLE IF NOT EXISTS metrics (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_id INTEGER NOT NULL,
+    step INTEGER,
+    vals TEXT NOT NULL,
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_metrics_run ON metrics (run_id);
+
+CREATE TABLE IF NOT EXISTS logs (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_id INTEGER NOT NULL,
+    process_id INTEGER,
+    line TEXT NOT NULL,
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_logs_run ON logs (run_id);
+
+CREATE TABLE IF NOT EXISTS heartbeats (
+    run_id INTEGER PRIMARY KEY,
+    last_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS iterations (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    group_id INTEGER NOT NULL,
+    number INTEGER NOT NULL,
+    data TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL,
+    UNIQUE (group_id, number)
+);
+
+CREATE TABLE IF NOT EXISTS processes (
+    run_id INTEGER NOT NULL,
+    process_id INTEGER NOT NULL,
+    pid INTEGER,
+    status TEXT NOT NULL,
+    exit_code INTEGER,
+    updated_at REAL NOT NULL,
+    PRIMARY KEY (run_id, process_id)
+);
+
+CREATE TABLE IF NOT EXISTS activity (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    event_type TEXT NOT NULL,
+    context TEXT NOT NULL,
+    created_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS options (
+    key TEXT PRIMARY KEY,
+    value TEXT NOT NULL
+);
+"""
+
+
+@dataclass
+class Run:
+    """A registry row. ``spec`` is lazily parsed into a typed specification."""
+
+    id: int
+    uuid: str
+    kind: str
+    name: Optional[str]
+    project: str
+    status: str
+    spec_data: Dict[str, Any]
+    group_id: Optional[int] = None
+    pipeline_id: Optional[int] = None
+    original_id: Optional[int] = None
+    cloning_strategy: Optional[str] = None
+    restarts: int = 0
+    tags: List[str] = field(default_factory=list)
+    last_metric: Dict[str, Any] = field(default_factory=dict)
+    outputs_path: Optional[str] = None
+    code_ref: Optional[str] = None
+    created_at: float = 0.0
+    updated_at: float = 0.0
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+    @property
+    def spec(self) -> BaseSpecification:
+        cls = specification_for_kind(self.kind)
+        return cls.model_validate(self.spec_data)
+
+    @property
+    def lifecycle(self):
+        return lifecycle_for_kind(self.kind)
+
+    @property
+    def is_done(self) -> bool:
+        return self.lifecycle.is_done(self.status)
+
+
+def _row_to_run(row: sqlite3.Row) -> Run:
+    return Run(
+        id=row["id"],
+        uuid=row["uuid"],
+        kind=row["kind"],
+        name=row["name"],
+        project=row["project"],
+        status=row["status"],
+        spec_data=json.loads(row["spec"]),
+        group_id=row["group_id"],
+        pipeline_id=row["pipeline_id"],
+        original_id=row["original_id"],
+        cloning_strategy=row["cloning_strategy"],
+        restarts=row["restarts"],
+        tags=json.loads(row["tags"]),
+        last_metric=json.loads(row["last_metric"]),
+        outputs_path=row["outputs_path"],
+        code_ref=row["code_ref"],
+        created_at=row["created_at"],
+        updated_at=row["updated_at"],
+        started_at=row["started_at"],
+        finished_at=row["finished_at"],
+    )
+
+
+class RunRegistry:
+    """Sqlite-backed run registry, safe across threads and processes.
+
+    Every status write passes the lifecycle gate; a rejected transition is
+    reported (``False``) rather than raised, mirroring how the reference
+    silently skips illegal writes after checking ``can_transition``.
+    """
+
+    def __init__(self, path: Union[str, Path] = ":memory:") -> None:
+        self.path = str(path)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        with self._conn() as conn:
+            conn.executescript(_SCHEMA)
+
+    # -- connection management ------------------------------------------------
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path, timeout=30.0, check_same_thread=False)
+            conn.row_factory = sqlite3.Row
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA busy_timeout=30000")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = conn
+        return conn
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+    # -- runs ----------------------------------------------------------------
+    def create_run(
+        self,
+        spec: Union[BaseSpecification, Dict[str, Any]],
+        *,
+        name: Optional[str] = None,
+        project: str = "default",
+        group_id: Optional[int] = None,
+        pipeline_id: Optional[int] = None,
+        original_id: Optional[int] = None,
+        cloning_strategy: Optional[str] = None,
+        tags: Optional[Iterable[str]] = None,
+        status: str = S.CREATED,
+    ) -> Run:
+        if isinstance(spec, BaseSpecification):
+            spec_data = spec.to_dict()
+            kind = spec.kind
+            name = name or spec.name
+            spec_tags = spec.tags
+        else:
+            spec_data = dict(spec)
+            kind = spec_data.get("kind")
+            if kind is None:
+                raise RegistryError("spec dict must carry a 'kind'")
+            spec_tags = spec_data.get("tags", [])
+        lifecycle = lifecycle_for_kind(kind)
+        if not lifecycle.can_transition(None, status):
+            raise RegistryError(f"Runs of kind {kind!r} cannot be born {status!r}")
+        now = time.time()
+        run_uuid = uuid_mod.uuid4().hex
+        all_tags = sorted(set(spec_tags) | set(tags or ()))
+        with self._lock, self._conn() as conn:
+            cur = conn.execute(
+                """INSERT INTO runs (uuid, kind, name, project, spec, status,
+                                     group_id, pipeline_id, original_id,
+                                     cloning_strategy, tags, created_at, updated_at)
+                   VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)""",
+                (
+                    run_uuid,
+                    kind,
+                    name,
+                    project,
+                    json.dumps(spec_data),
+                    status,
+                    group_id,
+                    pipeline_id,
+                    original_id,
+                    cloning_strategy,
+                    json.dumps(all_tags),
+                    now,
+                    now,
+                ),
+            )
+            run_id = cur.lastrowid
+            conn.execute(
+                "INSERT INTO statuses (run_id, status, message, created_at) VALUES (?, ?, ?, ?)",
+                (run_id, status, None, now),
+            )
+        return self.get_run(run_id)
+
+    def get_run(self, run: Union[int, str]) -> Run:
+        col = "uuid" if isinstance(run, str) else "id"
+        row = self._conn().execute(f"SELECT * FROM runs WHERE {col} = ?", (run,)).fetchone()
+        if row is None:
+            raise RegistryError(f"No run with {col}={run!r}")
+        return _row_to_run(row)
+
+    def list_runs(
+        self,
+        *,
+        kind: Optional[str] = None,
+        project: Optional[str] = None,
+        group_id: Optional[int] = None,
+        pipeline_id: Optional[int] = None,
+        statuses: Optional[Sequence[str]] = None,
+        limit: Optional[int] = None,
+        offset: int = 0,
+    ) -> List[Run]:
+        clauses, params = [], []
+        if kind is not None:
+            clauses.append("kind = ?")
+            params.append(kind)
+        if project is not None:
+            clauses.append("project = ?")
+            params.append(project)
+        if group_id is not None:
+            clauses.append("group_id = ?")
+            params.append(group_id)
+        if pipeline_id is not None:
+            clauses.append("pipeline_id = ?")
+            params.append(pipeline_id)
+        if statuses:
+            clauses.append(f"status IN ({','.join('?' * len(statuses))})")
+            params.extend(statuses)
+        where = f"WHERE {' AND '.join(clauses)}" if clauses else ""
+        sql = f"SELECT * FROM runs {where} ORDER BY id"
+        if limit is not None:
+            sql += f" LIMIT {int(limit)} OFFSET {int(offset)}"
+        rows = self._conn().execute(sql, params).fetchall()
+        return [_row_to_run(r) for r in rows]
+
+    def update_run(self, run_id: int, **fields: Any) -> None:
+        allowed = {
+            "name",
+            "project",
+            "outputs_path",
+            "code_ref",
+            "group_id",
+            "pipeline_id",
+            "original_id",
+            "cloning_strategy",
+            "restarts",
+        }
+        unknown = set(fields) - allowed
+        if unknown:
+            raise RegistryError(f"Cannot update fields {sorted(unknown)}")
+        if not fields:
+            return
+        sets = ", ".join(f"{k} = ?" for k in fields)
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                f"UPDATE runs SET {sets}, updated_at = ? WHERE id = ?",
+                (*fields.values(), time.time(), run_id),
+            )
+
+    # -- statuses -------------------------------------------------------------
+    def set_status(
+        self,
+        run_id: int,
+        status: str,
+        message: Optional[str] = None,
+    ) -> bool:
+        """Gated status write; returns whether the transition was applied."""
+        now = time.time()
+        with self._lock, self._conn() as conn:
+            row = conn.execute(
+                "SELECT kind, status, started_at FROM runs WHERE id = ?", (run_id,)
+            ).fetchone()
+            if row is None:
+                raise RegistryError(f"No run with id={run_id}")
+            lifecycle = lifecycle_for_kind(row["kind"])
+            if not lifecycle.can_transition(row["status"], status):
+                return False
+            started_at = row["started_at"]
+            if started_at is None and lifecycle.is_running(status):
+                started_at = now
+            finished_at = now if lifecycle.is_done(status) else None
+            conn.execute(
+                """UPDATE runs SET status = ?, updated_at = ?, started_at = ?,
+                                   finished_at = COALESCE(?, finished_at)
+                   WHERE id = ?""",
+                (status, now, started_at, finished_at, run_id),
+            )
+            conn.execute(
+                "INSERT INTO statuses (run_id, status, message, created_at) VALUES (?, ?, ?, ?)",
+                (run_id, status, message, now),
+            )
+        return True
+
+    def get_statuses(self, run_id: int) -> List[Dict[str, Any]]:
+        rows = self._conn().execute(
+            "SELECT status, message, created_at FROM statuses WHERE run_id = ? ORDER BY id",
+            (run_id,),
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    def count_by_status(
+        self, *, kind: Optional[str] = None, group_id: Optional[int] = None
+    ) -> Dict[str, int]:
+        clauses, params = [], []
+        if kind is not None:
+            clauses.append("kind = ?")
+            params.append(kind)
+        if group_id is not None:
+            clauses.append("group_id = ?")
+            params.append(group_id)
+        where = f"WHERE {' AND '.join(clauses)}" if clauses else ""
+        rows = self._conn().execute(
+            f"SELECT status, COUNT(*) AS n FROM runs {where} GROUP BY status", params
+        ).fetchall()
+        return {r["status"]: r["n"] for r in rows}
+
+    # -- metrics --------------------------------------------------------------
+    def add_metric(
+        self, run_id: int, values: Dict[str, Any], step: Optional[int] = None
+    ) -> None:
+        now = time.time()
+        with self._lock, self._conn() as conn:
+            row = conn.execute(
+                "SELECT last_metric FROM runs WHERE id = ?", (run_id,)
+            ).fetchone()
+            if row is None:
+                raise RegistryError(f"No run with id={run_id}")
+            merged = json.loads(row["last_metric"])
+            merged.update(values)
+            conn.execute(
+                "INSERT INTO metrics (run_id, step, vals, created_at) VALUES (?, ?, ?, ?)",
+                (run_id, step, json.dumps(values), now),
+            )
+            conn.execute(
+                "UPDATE runs SET last_metric = ?, updated_at = ? WHERE id = ?",
+                (json.dumps(merged), now, run_id),
+            )
+
+    def get_metrics(self, run_id: int, since_id: int = 0) -> List[Dict[str, Any]]:
+        rows = self._conn().execute(
+            "SELECT id, step, vals, created_at FROM metrics WHERE run_id = ? AND id > ? ORDER BY id",
+            (run_id, since_id),
+        ).fetchall()
+        return [
+            {
+                "id": r["id"],
+                "step": r["step"],
+                "values": json.loads(r["vals"]),
+                "created_at": r["created_at"],
+            }
+            for r in rows
+        ]
+
+    def last_metric(self, run_id: int) -> Dict[str, Any]:
+        return self.get_run(run_id).last_metric
+
+    # -- logs -----------------------------------------------------------------
+    def add_log(
+        self,
+        run_id: int,
+        line: str,
+        process_id: Optional[int] = None,
+        created_at: Optional[float] = None,
+    ) -> None:
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                "INSERT INTO logs (run_id, process_id, line, created_at) VALUES (?, ?, ?, ?)",
+                (run_id, process_id, line, created_at or time.time()),
+            )
+
+    def add_logs(
+        self, run_id: int, lines: Iterable[Tuple[Optional[int], str]]
+    ) -> None:
+        now = time.time()
+        with self._lock, self._conn() as conn:
+            conn.executemany(
+                "INSERT INTO logs (run_id, process_id, line, created_at) VALUES (?, ?, ?, ?)",
+                [(run_id, pid, line, now) for pid, line in lines],
+            )
+
+    def get_logs(
+        self,
+        run_id: int,
+        *,
+        process_id: Optional[int] = None,
+        since_id: int = 0,
+        limit: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        sql = "SELECT id, process_id, line, created_at FROM logs WHERE run_id = ? AND id > ?"
+        params: List[Any] = [run_id, since_id]
+        if process_id is not None:
+            sql += " AND process_id = ?"
+            params.append(process_id)
+        sql += " ORDER BY id"
+        if limit is not None:
+            sql += f" LIMIT {int(limit)}"
+        rows = self._conn().execute(sql, params).fetchall()
+        return [dict(r) for r in rows]
+
+    # -- heartbeats -----------------------------------------------------------
+    def ping_heartbeat(self, run_id: int, at: Optional[float] = None) -> None:
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO heartbeats (run_id, last_at) VALUES (?, ?)
+                   ON CONFLICT (run_id) DO UPDATE SET last_at = excluded.last_at""",
+                (run_id, at or time.time()),
+            )
+
+    def last_heartbeat(self, run_id: int) -> Optional[float]:
+        row = self._conn().execute(
+            "SELECT last_at FROM heartbeats WHERE run_id = ?", (run_id,)
+        ).fetchone()
+        return row["last_at"] if row else None
+
+    def zombie_runs(self, ttl_seconds: float, now: Optional[float] = None) -> List[Run]:
+        """Runs in a heartbeat-requiring status whose heartbeat is stale.
+
+        Parity: the reference's zombie cron
+        (``crons/tasks/heartbeats.py`` + ``scheduler/tasks/experiments.py:111-120``).
+        """
+        now = now or time.time()
+        # One indexed scan over live statuses; the per-lifecycle predicate is
+        # re-checked on the (small) candidate set.
+        rows = self._conn().execute(
+            """SELECT r.* FROM runs r LEFT JOIN heartbeats h ON h.run_id = r.id
+               WHERE r.status = ? AND (h.last_at IS NULL OR ? - h.last_at > ?)""",
+            (S.RUNNING, now, ttl_seconds),
+        ).fetchall()
+        return [
+            run
+            for run in map(_row_to_run, rows)
+            if run.lifecycle.needs_heartbeat(run.status)
+        ]
+
+    # -- iterations (hpsearch) ------------------------------------------------
+    def create_iteration(self, group_id: int, data: Dict[str, Any]) -> int:
+        now = time.time()
+        with self._lock, self._conn() as conn:
+            row = conn.execute(
+                "SELECT MAX(number) AS n FROM iterations WHERE group_id = ?",
+                (group_id,),
+            ).fetchone()
+            number = (row["n"] or 0) + 1
+            conn.execute(
+                "INSERT INTO iterations (group_id, number, data, created_at, updated_at)"
+                " VALUES (?, ?, ?, ?, ?)",
+                (group_id, number, json.dumps(data), now, now),
+            )
+        return number
+
+    def update_iteration(self, group_id: int, number: int, data: Dict[str, Any]) -> None:
+        with self._lock, self._conn() as conn:
+            cur = conn.execute(
+                "UPDATE iterations SET data = ?, updated_at = ? WHERE group_id = ? AND number = ?",
+                (json.dumps(data), time.time(), group_id, number),
+            )
+            if cur.rowcount == 0:
+                raise RegistryError(f"No iteration {number} for group {group_id}")
+
+    def get_iteration(self, group_id: int, number: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        if number is None:
+            row = self._conn().execute(
+                "SELECT number, data FROM iterations WHERE group_id = ? ORDER BY number DESC LIMIT 1",
+                (group_id,),
+            ).fetchone()
+        else:
+            row = self._conn().execute(
+                "SELECT number, data FROM iterations WHERE group_id = ? AND number = ?",
+                (group_id, number),
+            ).fetchone()
+        if row is None:
+            return None
+        return {"number": row["number"], "data": json.loads(row["data"])}
+
+    def get_iterations(self, group_id: int) -> List[Dict[str, Any]]:
+        rows = self._conn().execute(
+            "SELECT number, data FROM iterations WHERE group_id = ? ORDER BY number",
+            (group_id,),
+        ).fetchall()
+        return [{"number": r["number"], "data": json.loads(r["data"])} for r in rows]
+
+    # -- processes (gang members) ---------------------------------------------
+    def upsert_process(
+        self,
+        run_id: int,
+        process_id: int,
+        *,
+        pid: Optional[int] = None,
+        status: str = S.CREATED,
+        exit_code: Optional[int] = None,
+    ) -> None:
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO processes (run_id, process_id, pid, status, exit_code, updated_at)
+                   VALUES (?, ?, ?, ?, ?, ?)
+                   ON CONFLICT (run_id, process_id) DO UPDATE SET
+                     pid = COALESCE(excluded.pid, pid),
+                     status = excluded.status,
+                     exit_code = COALESCE(excluded.exit_code, exit_code),
+                     updated_at = excluded.updated_at""",
+                (run_id, process_id, pid, status, exit_code, time.time()),
+            )
+
+    def get_processes(self, run_id: int) -> List[Dict[str, Any]]:
+        rows = self._conn().execute(
+            "SELECT process_id, pid, status, exit_code, updated_at FROM processes"
+            " WHERE run_id = ? ORDER BY process_id",
+            (run_id,),
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    def clear_processes(self, run_id: int) -> None:
+        with self._lock, self._conn() as conn:
+            conn.execute("DELETE FROM processes WHERE run_id = ?", (run_id,))
+
+    # -- activity log ----------------------------------------------------------
+    def record_activity(self, event_type: str, context: Dict[str, Any]) -> None:
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                "INSERT INTO activity (event_type, context, created_at) VALUES (?, ?, ?)",
+                (event_type, json.dumps(context, default=str), time.time()),
+            )
+
+    def get_activities(
+        self, event_type: Optional[str] = None, limit: Optional[int] = None
+    ) -> List[Dict[str, Any]]:
+        sql = "SELECT event_type, context, created_at FROM activity"
+        params: List[Any] = []
+        if event_type is not None:
+            sql += " WHERE event_type = ?"
+            params.append(event_type)
+        sql += " ORDER BY id DESC"
+        if limit is not None:
+            sql += f" LIMIT {int(limit)}"
+        rows = self._conn().execute(sql, params).fetchall()
+        return [
+            {
+                "event_type": r["event_type"],
+                "context": json.loads(r["context"]),
+                "created_at": r["created_at"],
+            }
+            for r in rows
+        ]
+
+    # -- options (DB-backed conf store) ---------------------------------------
+    def set_option(self, key: str, value: Any) -> None:
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO options (key, value) VALUES (?, ?)
+                   ON CONFLICT (key) DO UPDATE SET value = excluded.value""",
+                (key, json.dumps(value)),
+            )
+
+    def get_option(self, key: str, default: Any = None) -> Any:
+        row = self._conn().execute(
+            "SELECT value FROM options WHERE key = ?", (key,)
+        ).fetchone()
+        return json.loads(row["value"]) if row else default
+
+    def delete_option(self, key: str) -> None:
+        with self._lock, self._conn() as conn:
+            conn.execute("DELETE FROM options WHERE key = ?", (key,))

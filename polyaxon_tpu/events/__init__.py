@@ -1,0 +1,3 @@
+from polyaxon_tpu.events.registry import Event, EventTypes
+
+__all__ = ["Event", "EventTypes"]

@@ -1,0 +1,85 @@
+"""Declarative event registry.
+
+Parity: the reference's ``events/`` package — ``Event``/``Attribute`` classes
+(``events/event.py:17,41``) plus ~20 per-subject registry modules
+(``events/registry/{experiment,experiment_group,pipeline,...}.py``).  The
+TPU-native version keeps the two load-bearing pieces — stable dotted event
+names and a serializable payload — and drops the marshmallow-style attribute
+declarations (payloads are plain dicts; the registry db stores them as JSON).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+
+def _subject_events(subject: str, *actions: str) -> Dict[str, str]:
+    return {a.upper(): f"{subject}.{a}" for a in actions}
+
+
+class EventTypes:
+    """Dotted event-type names, ``<subject>.<action>``."""
+
+    # experiments (reference events/registry/experiment.py)
+    EXPERIMENT_CREATED = "experiment.created"
+    EXPERIMENT_RESUMED = "experiment.resumed"
+    EXPERIMENT_RESTARTED = "experiment.restarted"
+    EXPERIMENT_COPIED = "experiment.copied"
+    EXPERIMENT_BUILD_STARTED = "experiment.build_started"
+    EXPERIMENT_BUILD_DONE = "experiment.build_done"
+    EXPERIMENT_NEW_STATUS = "experiment.new_status"
+    EXPERIMENT_NEW_METRIC = "experiment.new_metric"
+    EXPERIMENT_SUCCEEDED = "experiment.succeeded"
+    EXPERIMENT_FAILED = "experiment.failed"
+    EXPERIMENT_STOPPED = "experiment.stopped"
+    EXPERIMENT_DONE = "experiment.done"
+    EXPERIMENT_ZOMBIE = "experiment.zombie"
+
+    # groups (events/registry/experiment_group.py)
+    GROUP_CREATED = "group.created"
+    GROUP_NEW_STATUS = "group.new_status"
+    GROUP_ITERATION = "group.iteration"
+    GROUP_DONE = "group.done"
+    GROUP_STOPPED = "group.stopped"
+
+    # jobs / services
+    JOB_CREATED = "job.created"
+    JOB_NEW_STATUS = "job.new_status"
+    JOB_DONE = "job.done"
+
+    # pipelines (events/registry/pipeline.py)
+    PIPELINE_CREATED = "pipeline.created"
+    PIPELINE_NEW_STATUS = "pipeline.new_status"
+    PIPELINE_DONE = "pipeline.done"
+    OPERATION_NEW_STATUS = "operation.new_status"
+    OPERATION_DONE = "operation.done"
+
+    # cluster / platform
+    CLUSTER_NODE_UPDATED = "cluster.node_updated"
+    PLATFORM_HEALTH = "platform.health"
+
+
+@dataclass
+class Event:
+    """A recorded platform event (stored in the registry's activity table)."""
+
+    event_type: str
+    context: Dict[str, Any] = field(default_factory=dict)
+    created_at: float = field(default_factory=time.time)
+
+    @property
+    def subject(self) -> str:
+        return self.event_type.split(".", 1)[0]
+
+    @property
+    def action(self) -> str:
+        return self.event_type.split(".", 1)[1]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "event_type": self.event_type,
+            "context": self.context,
+            "created_at": self.created_at,
+        }

@@ -1,0 +1,3 @@
+from polyaxon_tpu.executor.handlers import ExecutorHandlers
+
+__all__ = ["ExecutorHandlers"]

@@ -1,0 +1,73 @@
+"""Event → follow-up-action state machine.
+
+Parity: reference ``executor/handlers/experiment.py:12-118`` (and the other
+per-entity handlers): EXPERIMENT_CREATED → send build task; build done →
+start; SUCCEEDED/FAILED/DONE → stop/cleanup and, for grouped experiments,
+kick the next hpsearch wave.  The handler layer only *sends named tasks* —
+it never touches the spawner directly — so orchestration policy stays in
+one written-down place.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from polyaxon_tpu.events import Event, EventTypes
+from polyaxon_tpu.workers import HPTasks, PipelineTasks, SchedulerTasks, TaskBus
+
+logger = logging.getLogger(__name__)
+
+
+class ExecutorHandlers:
+    """Subscribes to the auditor; translates events into bus sends."""
+
+    def __init__(self, bus: TaskBus) -> None:
+        self.bus = bus
+        self._table = {
+            EventTypes.EXPERIMENT_CREATED: self._experiment_created,
+            EventTypes.EXPERIMENT_RESUMED: self._experiment_created,
+            EventTypes.EXPERIMENT_RESTARTED: self._experiment_created,
+            EventTypes.EXPERIMENT_BUILD_DONE: self._experiment_build_done,
+            EventTypes.EXPERIMENT_DONE: self._experiment_done,
+            EventTypes.GROUP_CREATED: self._group_created,
+            EventTypes.PIPELINE_CREATED: self._pipeline_created,
+            EventTypes.OPERATION_DONE: self._operation_done,
+        }
+
+    def __call__(self, event: Event) -> None:
+        handler = self._table.get(event.event_type)
+        if handler is not None:
+            handler(event)
+
+    # -- experiments ----------------------------------------------------------
+    def _experiment_created(self, event: Event) -> None:
+        # CREATED → build (code snapshot). The build task itself decides
+        # whether a snapshot is needed and chains to start (the reference's
+        # image-exists short-circuit, scheduler/dockerizer_scheduler.py:30-88).
+        self.bus.send(SchedulerTasks.EXPERIMENTS_BUILD, {"run_id": event.context["run_id"]})
+
+    def _experiment_build_done(self, event: Event) -> None:
+        self.bus.send(SchedulerTasks.EXPERIMENTS_START, {"run_id": event.context["run_id"]})
+
+    def _experiment_done(self, event: Event) -> None:
+        run_id = event.context["run_id"]
+        group_id = event.context.get("group_id")
+        pipeline_id = event.context.get("pipeline_id")
+        # Cleanup/stop of any leftover gang state.
+        self.bus.send(SchedulerTasks.EXPERIMENTS_STOP, {"run_id": run_id, "cleanup": True})
+        if group_id is not None:
+            # Next hpsearch wave (reference: HP_START on experiment done).
+            self.bus.send(HPTasks.START, {"group_id": group_id})
+        if pipeline_id is not None:
+            self.bus.send(PipelineTasks.CHECK, {"pipeline_id": pipeline_id})
+
+    # -- groups ---------------------------------------------------------------
+    def _group_created(self, event: Event) -> None:
+        self.bus.send(HPTasks.CREATE, {"group_id": event.context["group_id"]})
+
+    # -- pipelines ------------------------------------------------------------
+    def _pipeline_created(self, event: Event) -> None:
+        self.bus.send(PipelineTasks.START, {"pipeline_id": event.context["pipeline_id"]})
+
+    def _operation_done(self, event: Event) -> None:
+        self.bus.send(PipelineTasks.CHECK, {"pipeline_id": event.context["pipeline_id"]})

@@ -1,0 +1,3 @@
+from polyaxon_tpu.monitor.watcher import GangWatcher
+
+__all__ = ["GangWatcher"]

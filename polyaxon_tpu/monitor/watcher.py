@@ -1,0 +1,113 @@
+"""Gang observation: tail report files, reconcile process liveness.
+
+Parity: the reference's observation stack — the ocular pod watch loop
+(``monitor_statuses/monitor.py:87-200``), the k8s events handlers writing
+job-status rows (``k8s_events_handlers/tasks/statuses.py:36-288``), and the
+sidecar liveness reconcile (``sidecar/sidecar/__main__.py:39-58``).
+TPU-native: statuses/metrics/logs arrive as appended JSON lines in the run's
+``reports/`` dir; liveness is the subprocess table itself.  Both sources are
+reconciled into the registry, statuses gated by the job lifecycle, and the
+gang roll-up (``gang_status``) becomes the experiment status.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict, List, Optional
+
+from polyaxon_tpu.db.registry import RunRegistry
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.lifecycles.registry import gang_status
+from polyaxon_tpu.spawner.local import GangHandle
+
+logger = logging.getLogger(__name__)
+
+
+class GangWatcher:
+    """Stateless-per-call watcher; tail cursors live on the GangHandle."""
+
+    def __init__(self, registry: RunRegistry) -> None:
+        self.registry = registry
+
+    # -- report ingestion -----------------------------------------------------
+    def ingest(self, handle: GangHandle) -> None:
+        """Drain new report lines from every gang process into the registry."""
+        for process_id in range(handle.plan.num_hosts):
+            path = handle.paths.report_file(process_id)
+            if not path.exists():
+                continue
+            offset = handle.report_offsets.get(process_id, 0)
+            with open(path, "rb") as fh:
+                fh.seek(offset)
+                chunk = fh.read()
+            if not chunk:
+                continue
+            # Only consume complete lines; a partially-flushed tail is
+            # re-read next poll.
+            end = chunk.rfind(b"\n")
+            if end < 0:
+                continue
+            handle.report_offsets[process_id] = offset + end + 1
+            for raw in chunk[: end + 1].splitlines():
+                try:
+                    event = json.loads(raw)
+                except json.JSONDecodeError:
+                    logger.warning("Bad report line from proc %d: %r", process_id, raw[:200])
+                    continue
+                self._apply(handle, process_id, event)
+
+    def _apply(self, handle: GangHandle, process_id: int, event: dict) -> None:
+        etype = event.get("type")
+        run_id = handle.run_id
+        if etype == "metric":
+            self.registry.add_metric(run_id, event.get("values") or {}, step=event.get("step"))
+        elif etype == "log":
+            self.registry.add_log(run_id, event.get("line", ""), process_id=process_id)
+        elif etype == "heartbeat":
+            self.registry.ping_heartbeat(run_id, at=event.get("ts"))
+        elif etype == "status":
+            status = event.get("status")
+            if not status:
+                logger.warning("Status report without status from proc %d", process_id)
+                return
+            message = event.get("message")
+            if event.get("traceback"):
+                self.registry.add_log(run_id, event["traceback"], process_id=process_id)
+            self.registry.upsert_process(run_id, process_id, status=status)
+            if message:
+                self.registry.add_log(
+                    run_id, f"[proc {process_id}] {status}: {message}", process_id=process_id
+                )
+
+    # -- liveness reconcile ---------------------------------------------------
+    def reconcile(self, handle: GangHandle) -> List[str]:
+        """Reconcile subprocess exit codes with reported statuses.
+
+        A process that exited without reporting a terminal status (crash,
+        OOM-kill) is recorded from its exit code — the reference's sidecar
+        reconcile for pods that die before phoning home.
+        """
+        reported = {p["process_id"]: p for p in self.registry.get_processes(handle.run_id)}
+        statuses: List[str] = []
+        for process_id, exit_code in handle.poll().items():
+            rec = reported.get(process_id)
+            status = rec["status"] if rec else S.STARTING
+            job_done = status in (S.SUCCEEDED, S.FAILED, S.STOPPED)
+            if exit_code is not None and not job_done:
+                status = S.SUCCEEDED if exit_code == 0 else S.FAILED
+                self.registry.upsert_process(
+                    handle.run_id, process_id, status=status, exit_code=exit_code
+                )
+            elif exit_code is not None and rec is not None and rec.get("exit_code") is None:
+                self.registry.upsert_process(
+                    handle.run_id, process_id, status=status, exit_code=exit_code
+                )
+            statuses.append(status)
+        return statuses
+
+    def observe(self, handle: GangHandle) -> Optional[str]:
+        """One poll: ingest reports, reconcile liveness, return gang roll-up."""
+        self.ingest(handle)
+        statuses = self.reconcile(handle)
+        return gang_status(statuses)

@@ -1,0 +1,159 @@
+"""The control-plane service: one object wiring every subsystem.
+
+This is the TPU-native collapse of the reference's deployment topology —
+Django API + celery workers + beat + monitors, all separate processes
+(``polyaxon/config_manager.py:104-137`` service roles) — into a single
+embeddable service: registry (state), task bus (async orchestration),
+auditor/executor (events), spawner+watcher (gang layer), crons.
+
+Two operating modes:
+- **eager** (tests / notebooks): call :meth:`pump` / :meth:`wait` to drive
+  the task graph in the calling thread — the reference's
+  ``CELERY_TASK_ALWAYS_EAGER`` test pattern (``tests/base/case.py:79-87``);
+- **service** (CLI / API server): :meth:`start` runs the bus in a
+  background thread, with beat crons (heartbeat zombie check).
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional, Union
+
+from polyaxon_tpu.auditor import Auditor
+from polyaxon_tpu.db import Run, RunRegistry
+from polyaxon_tpu.events import EventTypes
+from polyaxon_tpu.exceptions import PolyaxonTPUError
+from polyaxon_tpu.executor import ExecutorHandlers
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.monitor import GangWatcher
+from polyaxon_tpu.schemas import PolyaxonFile
+from polyaxon_tpu.schemas.specifications import BaseSpecification, Kinds
+from polyaxon_tpu.scheduler.tasks import SchedulerContext, register_scheduler_tasks
+from polyaxon_tpu.spawner import LocalGangSpawner
+from polyaxon_tpu.stores import StoreLayout
+from polyaxon_tpu.workers import CronTasks, SchedulerTasks, TaskBus
+
+
+class Orchestrator:
+    def __init__(
+        self,
+        base_dir: Union[str, Path],
+        *,
+        time_scale: float = 1.0,
+        monitor_interval: float = 0.2,
+        heartbeat_interval: float = 5.0,
+        heartbeat_ttl: float = 600.0,
+        heartbeat_check_interval: float = 60.0,
+    ) -> None:
+        self.base_dir = Path(base_dir)
+        self.layout = StoreLayout(self.base_dir)
+        self.registry = RunRegistry(self.base_dir / "registry.db")
+        self.bus = TaskBus(time_scale=time_scale)
+        self.auditor = Auditor(self.registry)
+        self.executor = ExecutorHandlers(self.bus)
+        self.auditor.subscribe(self.executor)
+        self.spawner = LocalGangSpawner(
+            self.layout, heartbeat_interval=heartbeat_interval
+        )
+        self.watcher = GangWatcher(self.registry)
+        self.ctx = SchedulerContext(
+            registry=self.registry,
+            bus=self.bus,
+            auditor=self.auditor,
+            layout=self.layout,
+            spawner=self.spawner,
+            watcher=self.watcher,
+            monitor_interval=monitor_interval,
+            heartbeat_ttl=heartbeat_ttl,
+        )
+        register_scheduler_tasks(self.ctx)
+        self._heartbeat_check_interval = heartbeat_check_interval
+        self._register_placeholder_tasks()
+
+    def _register_placeholder_tasks(self) -> None:
+        """Tasks wired by the executor but implemented by later layers
+        (hpsearch, pipelines) register no-ops until those layers attach."""
+        from polyaxon_tpu.workers import HPTasks, PipelineTasks
+
+        for name in (HPTasks.CREATE, HPTasks.START, HPTasks.ITERATE,
+                     PipelineTasks.START, PipelineTasks.CHECK, PipelineTasks.STOP):
+            if not self.bus.has_task(name):
+                self.bus.register(name, lambda **kw: None)
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> None:
+        self.bus.add_cron(CronTasks.HEARTBEAT_CHECK, self._heartbeat_check_interval)
+        self.bus.start()
+
+    def stop(self) -> None:
+        self.bus.stop()
+        for run_id in list(self.ctx.gangs):
+            handle = self.ctx.gangs.pop(run_id)
+            self.spawner.stop(handle)
+        self.registry.close()
+
+    # -- client surface (the API layer calls these) ---------------------------
+    def submit(
+        self,
+        spec: Union[str, Dict[str, Any], BaseSpecification],
+        *,
+        project: str = "default",
+        name: Optional[str] = None,
+        tags: Optional[list] = None,
+    ) -> Run:
+        """Create a run from a spec and fire its created event.
+
+        The reference equivalent is POST /experiments → signals → auditor →
+        executor (SURVEY §3.1).
+        """
+        if not isinstance(spec, BaseSpecification):
+            spec = PolyaxonFile.load(spec).specification
+        run = self.registry.create_run(spec, project=project, name=name, tags=tags)
+        created_events = {
+            Kinds.EXPERIMENT: (EventTypes.EXPERIMENT_CREATED, "run_id"),
+            Kinds.JOB: (EventTypes.EXPERIMENT_CREATED, "run_id"),
+            Kinds.BUILD: (EventTypes.EXPERIMENT_CREATED, "run_id"),
+            Kinds.GROUP: (EventTypes.GROUP_CREATED, "group_id"),
+            Kinds.PIPELINE: (EventTypes.PIPELINE_CREATED, "pipeline_id"),
+        }
+        event_type, key = created_events.get(
+            run.kind, (EventTypes.EXPERIMENT_CREATED, "run_id")
+        )
+        self.auditor.record(event_type, **{key: run.id})
+        return run
+
+    def stop_run(self, run_id: int) -> None:
+        run = self.registry.get_run(run_id)
+        if run.kind == Kinds.GROUP:
+            # Stop all trials, then the group itself.
+            for trial in self.registry.list_runs(group_id=run_id):
+                if not trial.is_done:
+                    self.bus.send(SchedulerTasks.EXPERIMENTS_STOP, {"run_id": trial.id})
+            if self.registry.set_status(run_id, S.STOPPED):
+                self.auditor.record(EventTypes.GROUP_STOPPED, group_id=run_id)
+            return
+        self.bus.send(SchedulerTasks.EXPERIMENTS_STOP, {"run_id": run_id})
+
+    def get_run(self, run_id: Union[int, str]) -> Run:
+        return self.registry.get_run(run_id)
+
+    # -- eager driving (tests; service mode doesn't need these) ----------------
+    def pump(self, max_wait: float = 0.0) -> int:
+        return self.bus.pump(max_wait=max_wait)
+
+    def wait(
+        self, run_id: int, timeout: float = 60.0, poll: float = 0.05
+    ) -> Run:
+        """Drive the bus until the run reaches a terminal status."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            self.bus.pump(max_wait=poll)
+            run = self.registry.get_run(run_id)
+            if run.is_done:
+                return run
+            time.sleep(min(poll, max(0.0, deadline - time.time())))
+        raise PolyaxonTPUError(
+            f"Run {run_id} not done after {timeout}s "
+            f"(status={self.registry.get_run(run_id).status!r})"
+        )

@@ -1,0 +1,4 @@
+from polyaxon_tpu.runtime.env import EnvVars
+from polyaxon_tpu.runtime.mesh import build_mesh
+
+__all__ = ["EnvVars", "build_mesh"]

@@ -1,0 +1,160 @@
+"""Gang-process entrypoint: ``python -m polyaxon_tpu.runtime.worker``.
+
+This is what runs inside every gang member — the TPU-native fusion of the
+reference's user container + sidecar + init container
+(``polypod/experiment.py:160-244`` pod anatomy): it bootstraps the
+distributed world (``jax.distributed.initialize`` — replacing TF_CONFIG /
+MASTER_ADDR rendezvous), builds the device mesh, runs the spec's command or
+python entrypoint with a tracking :class:`Context`, heartbeats, and reports
+statuses/metrics/logs through the run-dir reporting channel.
+
+Env knobs are set *before* importing jax: for the ``cpu`` accelerator the
+worker forces ``JAX_PLATFORMS=cpu`` and a virtual device count, which is how
+tests and the driver's multichip dry-run exercise real sharding without TPU
+hardware.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+
+def _configure_jax_env(info) -> None:
+    """Force the jax platform to match the plan's accelerator.
+
+    Env vars alone are not enough: site plugins (e.g. a TPU PJRT plugin
+    registered from sitecustomize) may have imported jax at interpreter
+    start and pinned ``jax_platforms`` — so for the cpu accelerator we also
+    override the config explicitly after import.
+    """
+    if info.accelerator.startswith("cpu"):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        # The plan's device count wins over any inherited flag value.
+        flags = [
+            f
+            for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={info.devices_per_host}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+    # Deterministic partitionable PRNG across meshes (same key → same stream
+    # regardless of sharding).
+    os.environ.setdefault("JAX_THREEFRY_PARTITIONABLE", "1")
+    if info.accelerator.startswith("cpu"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _init_distributed(info) -> bool:
+    """Join the jax.distributed world. Returns True if initialized."""
+    if info.num_processes <= 1 or not info.coordinator:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=info.coordinator,
+        num_processes=info.num_processes,
+        process_id=info.process_id,
+    )
+    return True
+
+
+def _run_cmd(cmd: str, env: dict, cwd: str) -> int:
+    proc = subprocess.run(cmd, shell=True, env=env, cwd=cwd)
+    return proc.returncode
+
+
+def main() -> int:
+    from polyaxon_tpu.runtime.env import GangInfo
+    from polyaxon_tpu.stores.layout import RunPaths
+    from polyaxon_tpu.tracking import Context, Reporter
+
+    info = GangInfo.from_env()
+    paths = RunPaths(Path(info.run_dir)).ensure()
+    reporter = Reporter(paths.report_file(info.process_id), info.process_id)
+    reporter.status("starting")
+    reporter.start_heartbeat(info.heartbeat_interval)
+
+    try:
+        _configure_jax_env(info)
+
+        spec_data = json.loads(Path(info.spec_path).read_text())
+        from polyaxon_tpu.schemas.specifications import specification_for_kind
+
+        spec = specification_for_kind(spec_data["kind"]).model_validate(spec_data)
+        run_cfg = spec.resolved_run() if hasattr(spec, "resolved_run") else spec.run
+
+        # Code snapshot (if the build step materialized one) takes import
+        # precedence — the init-container equivalent.
+        code_dir = paths.code
+        if code_dir.exists():
+            sys.path.insert(0, str(code_dir))
+
+        if run_cfg.cmd is not None:
+            # Shell command path: the distributed bootstrap belongs to the
+            # command itself (it can read the same env contract).
+            reporter.status("running")
+            rc = _run_cmd(
+                run_cfg.cmd,
+                env=dict(os.environ),
+                cwd=str(code_dir if code_dir.exists() else paths.root),
+            )
+            if rc == 0:
+                reporter.status("succeeded")
+                return 0
+            reporter.status("failed", message=f"command exited {rc}")
+            return 1
+
+        # Python entrypoint path: managed distributed world + mesh.
+        distributed = _init_distributed(info)
+        import jax
+
+        from polyaxon_tpu.runtime.mesh import build_mesh
+
+        mesh = None
+        if info.mesh_axes:
+            mesh = build_mesh(info.mesh_axes)
+
+        params = dict(spec.declarations)
+        params.update(run_cfg.kwargs)
+        ctx = Context(
+            params=params,
+            process_id=info.process_id,
+            num_processes=info.num_processes,
+            mesh=mesh,
+            strategy=info.strategy,
+            strategy_options=info.strategy_options,
+            outputs_path=str(paths.outputs),
+            checkpoints_path=str(paths.checkpoints),
+            reporter=reporter,
+            seed=info.seed,
+            run_uuid=info.run_uuid,
+        )
+
+        module_name, fn_name = run_cfg.entrypoint.split(":")
+        import importlib
+
+        module = importlib.import_module(module_name)
+        fn = getattr(module, fn_name)
+
+        reporter.status("running")
+        fn(ctx)
+
+        if distributed:
+            jax.distributed.shutdown()
+        reporter.status("succeeded")
+        return 0
+    except BaseException as e:  # noqa: BLE001 — report, then die loudly
+        reporter.error(e)
+        raise
+    finally:
+        reporter.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+from polyaxon_tpu.scheduler.tasks import register_scheduler_tasks
+
+__all__ = ["register_scheduler_tasks"]

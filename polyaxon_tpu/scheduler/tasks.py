@@ -1,0 +1,220 @@
+"""Scheduler tasks: the build → start → monitor → done chain.
+
+Parity: reference ``scheduler/tasks/experiments.py:59-103`` (build→start),
+``scheduler/experiment_scheduler.py:563-660`` (spawner driving +
+SCHEDULED/STARTING bookkeeping), the monitor/reconcile stack (§3.2), the
+heartbeat zombie cron (``scheduler/tasks/experiments.py:111-120``), and the
+gang restart policy (``polypod/templates/restart_policy.py``).
+
+All tasks are closures over one :class:`SchedulerContext` so orchestration
+state (active gang handles) lives in a single place.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from polyaxon_tpu.auditor import Auditor
+from polyaxon_tpu.compiler import compile_gang_plan
+from polyaxon_tpu.db.registry import RunRegistry
+from polyaxon_tpu.events import EventTypes
+from polyaxon_tpu.exceptions import PolyaxonTPUError
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.monitor import GangWatcher
+from polyaxon_tpu.spawner import GangHandle, LocalGangSpawner
+from polyaxon_tpu.stores import StoreLayout, create_snapshot
+from polyaxon_tpu.workers import CronTasks, SchedulerTasks, TaskBus
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class SchedulerContext:
+    registry: RunRegistry
+    bus: TaskBus
+    auditor: Auditor
+    layout: StoreLayout
+    spawner: LocalGangSpawner
+    watcher: GangWatcher
+    #: Live gang handles keyed by run id (the reference keeps equivalent
+    #: state in k8s; a single-service control plane keeps it in-process).
+    gangs: Dict[int, GangHandle] = field(default_factory=dict)
+    monitor_interval: float = 0.2
+    heartbeat_ttl: float = 600.0
+
+
+def _record_done(ctx: SchedulerContext, run_id: int, status: str) -> None:
+    run = ctx.registry.get_run(run_id)
+    by_status = {
+        S.SUCCEEDED: EventTypes.EXPERIMENT_SUCCEEDED,
+        S.FAILED: EventTypes.EXPERIMENT_FAILED,
+        S.STOPPED: EventTypes.EXPERIMENT_STOPPED,
+    }
+    if status in by_status:
+        ctx.auditor.record(by_status[status], run_id=run_id)
+    ctx.auditor.record(
+        EventTypes.EXPERIMENT_DONE,
+        run_id=run_id,
+        status=status,
+        group_id=run.group_id,
+        pipeline_id=run.pipeline_id,
+    )
+
+
+def register_scheduler_tasks(ctx: SchedulerContext) -> None:
+    bus = ctx.bus
+    reg = ctx.registry
+
+    @bus.register(SchedulerTasks.EXPERIMENTS_BUILD)
+    def experiments_build(run_id: int) -> None:
+        run = reg.get_run(run_id)
+        if run.is_done:
+            return
+        spec = run.spec
+        build = getattr(spec, "build", None)
+        if build is not None and run.code_ref is None:
+            if not reg.set_status(run_id, S.BUILDING):
+                return
+            ctx.auditor.record(EventTypes.EXPERIMENT_BUILD_STARTED, run_id=run_id)
+            try:
+                ref = create_snapshot(build, build.context, ctx.layout.snapshots_dir)
+            except PolyaxonTPUError as e:
+                reg.set_status(run_id, S.FAILED, message=f"build failed: {e}")
+                _record_done(ctx, run_id, S.FAILED)
+                return
+            reg.update_run(run_id, code_ref=ref)
+        ctx.auditor.record(EventTypes.EXPERIMENT_BUILD_DONE, run_id=run_id)
+
+    @bus.register(SchedulerTasks.EXPERIMENTS_START)
+    def experiments_start(run_id: int) -> None:
+        run = reg.get_run(run_id)
+        if run.is_done:
+            return
+        try:
+            plan = compile_gang_plan(run.spec)
+        except PolyaxonTPUError as e:
+            reg.set_status(run_id, S.FAILED, message=f"compile failed: {e}")
+            _record_done(ctx, run_id, S.FAILED)
+            return
+        if not reg.set_status(run_id, S.SCHEDULED):
+            logger.warning("Run %s not schedulable from %s", run_id, run.status)
+            return
+        try:
+            handle = ctx.spawner.start(run, plan)
+        except PolyaxonTPUError as e:
+            reg.set_status(run_id, S.UNSCHEDULABLE, message=str(e))
+            reg.set_status(run_id, S.FAILED, message=str(e))
+            _record_done(ctx, run_id, S.FAILED)
+            return
+        ctx.gangs[run_id] = handle
+        for process_id in range(plan.num_hosts):
+            reg.upsert_process(
+                run_id, process_id, pid=handle.processes[process_id].pid, status=S.STARTING
+            )
+        reg.set_status(run_id, S.STARTING)
+        bus.send(
+            SchedulerTasks.EXPERIMENTS_MONITOR,
+            {"run_id": run_id},
+            countdown=ctx.monitor_interval,
+        )
+
+    def _reschedule_monitor(run_id: int) -> None:
+        # A fresh send, NOT Retry: the monitor loop is unbounded by design
+        # and must not consume the bus's error-retry budget.
+        bus.send(
+            SchedulerTasks.EXPERIMENTS_MONITOR,
+            {"run_id": run_id},
+            countdown=ctx.monitor_interval,
+        )
+
+    @bus.register(SchedulerTasks.EXPERIMENTS_MONITOR)
+    def experiments_monitor(run_id: int) -> None:
+        handle = ctx.gangs.get(run_id)
+        if handle is None:
+            return
+        try:
+            rollup = ctx.watcher.observe(handle)
+            run = reg.get_run(run_id)
+        except Exception:
+            # A poll failure must not orphan the run: keep polling (the
+            # zombie cron is the final backstop), but give up after a
+            # sustained failure streak and fail the run explicitly.
+            logger.exception("Monitor poll failed for run %s", run_id)
+            handle.monitor_failures += 1
+            if handle.monitor_failures >= 25:
+                ctx.gangs.pop(run_id, None)
+                ctx.spawner.stop(handle)
+                reg.set_status(run_id, S.FAILED, message="monitor failed repeatedly")
+                _record_done(ctx, run_id, S.FAILED)
+                return
+            _reschedule_monitor(run_id)
+            return
+        handle.monitor_failures = 0
+        if run.is_done:
+            # Stopped externally while we slept.
+            ctx.gangs.pop(run_id, None)
+            return
+        if rollup == S.RUNNING:
+            reg.set_status(run_id, S.RUNNING)
+        if rollup in (S.SUCCEEDED, S.FAILED, S.SKIPPED) and handle.all_exited:
+            # One final ingest now that every process flushed and exited.
+            ctx.watcher.ingest(handle)
+            ctx.gangs.pop(run_id, None)
+            if rollup == S.FAILED and run.restarts < handle.plan.max_restarts:
+                restarts = run.restarts + 1
+                reg.update_run(run_id, restarts=restarts)
+                reg.clear_processes(run_id)
+                # Rotate report files so the next attempt's watcher (fresh
+                # offsets) doesn't re-ingest this attempt's lines.
+                for process_id in range(handle.plan.num_hosts):
+                    report = handle.paths.report_file(process_id)
+                    if report.exists():
+                        report.rename(report.with_suffix(f".jsonl.attempt{run.restarts}"))
+                reg.set_status(
+                    run_id,
+                    S.WARNING,
+                    message=f"gang failed; restart {restarts}/{handle.plan.max_restarts}",
+                )
+                ctx.auditor.record(EventTypes.EXPERIMENT_RESTARTED, run_id=run_id)
+                bus.send(
+                    SchedulerTasks.EXPERIMENTS_START,
+                    {"run_id": run_id},
+                    countdown=handle.plan.backoff_seconds,
+                )
+                return
+            reg.set_status(run_id, rollup)
+            _record_done(ctx, run_id, rollup)
+            return
+        _reschedule_monitor(run_id)
+
+    @bus.register(SchedulerTasks.EXPERIMENTS_STOP)
+    def experiments_stop(run_id: int, cleanup: bool = False) -> None:
+        handle = ctx.gangs.pop(run_id, None)
+        if handle is not None:
+            ctx.spawner.stop(handle)
+            ctx.watcher.ingest(handle)
+        if cleanup:
+            return
+        run = reg.get_run(run_id)
+        if run.is_done:
+            return
+        reg.set_status(run_id, S.STOPPING)
+        for p in reg.get_processes(run_id):
+            if p["status"] not in (S.SUCCEEDED, S.FAILED, S.STOPPED):
+                reg.upsert_process(run_id, p["process_id"], status=S.STOPPED)
+        reg.set_status(run_id, S.STOPPED)
+        _record_done(ctx, run_id, S.STOPPED)
+
+    @bus.register(CronTasks.HEARTBEAT_CHECK)
+    def heartbeat_check() -> None:
+        for run in reg.zombie_runs(ctx.heartbeat_ttl):
+            ctx.auditor.record(EventTypes.EXPERIMENT_ZOMBIE, run_id=run.id)
+            handle = ctx.gangs.pop(run.id, None)
+            if handle is not None:
+                ctx.spawner.stop(handle)
+            reg.set_status(
+                run.id, S.FAILED, message=f"zombie: no heartbeat in {ctx.heartbeat_ttl}s"
+            )
+            _record_done(ctx, run.id, S.FAILED)

@@ -1,0 +1,3 @@
+from polyaxon_tpu.spawner.local import GangHandle, LocalGangSpawner
+
+__all__ = ["GangHandle", "LocalGangSpawner"]

@@ -1,0 +1,4 @@
+from polyaxon_tpu.stores.layout import RunPaths, StoreLayout
+from polyaxon_tpu.stores.snapshots import create_snapshot, materialize_snapshot
+
+__all__ = ["StoreLayout", "RunPaths", "create_snapshot", "materialize_snapshot"]

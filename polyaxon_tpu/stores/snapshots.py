@@ -1,0 +1,98 @@
+"""Content-addressed code snapshots: the dockerizer replacement.
+
+Parity: reference ``dockerizer/`` (download + extract + generate + build
+image, ``dockerizer/dockerizer/initializer/*``) and the scheduler's
+image-exists short-circuit (``scheduler/dockerizer_scheduler.py:30-88``).
+TPU-native: no containers — a run's code is the set of files matched by its
+``BuildConfig``, hashed (sha256 over paths+contents) and stored once under
+``snapshots/<hash>/``; identical code re-uses the existing snapshot exactly
+like the reference re-uses a built image.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import shutil
+from pathlib import Path
+from typing import List, Optional, Union
+
+from polyaxon_tpu.exceptions import StoreError
+from polyaxon_tpu.schemas.run import BuildConfig
+
+
+def _matched_files(build: BuildConfig, source_dir: Path) -> List[Path]:
+    included: set = set()
+    for pattern in build.include:
+        included.update(p for p in source_dir.glob(pattern) if p.is_file())
+    excluded: set = set()
+    for pattern in build.exclude:
+        excluded.update(source_dir.glob(pattern))
+    # An excluded directory prunes everything under it.
+    def is_excluded(p: Path) -> bool:
+        return any(p == e or (e.is_dir() and e in p.parents) for e in excluded)
+
+    return sorted(p for p in included if not is_excluded(p))
+
+
+def snapshot_hash(build: BuildConfig, source_dir: Union[str, Path]) -> str:
+    source_dir = Path(source_dir)
+    h = hashlib.sha256()
+    for path in _matched_files(build, source_dir):
+        h.update(str(path.relative_to(source_dir)).encode())
+        h.update(path.read_bytes())
+    return h.hexdigest()[:16]
+
+
+def create_snapshot(
+    build: BuildConfig,
+    source_dir: Union[str, Path],
+    snapshots_dir: Union[str, Path],
+) -> str:
+    """Snapshot matched files; returns the content hash (idempotent)."""
+    source_dir = Path(source_dir)
+    if build.ref:  # pin to a pre-existing snapshot
+        ref_dir = Path(snapshots_dir) / build.ref
+        if not ref_dir.exists():
+            raise StoreError(f"Snapshot ref {build.ref!r} does not exist")
+        return build.ref
+    if not source_dir.exists():
+        raise StoreError(f"Build context {source_dir} does not exist")
+    ref = snapshot_hash(build, source_dir)
+    dest = Path(snapshots_dir) / ref
+    if dest.exists():
+        return ref  # image-exists short-circuit
+    tmp = dest.with_suffix(".tmp")
+    if tmp.exists():
+        shutil.rmtree(tmp)
+    for path in _matched_files(build, source_dir):
+        rel = path.relative_to(source_dir)
+        target = tmp / rel
+        target.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy2(path, target)
+    tmp.mkdir(parents=True, exist_ok=True)  # snapshot may legitimately be empty
+    tmp.rename(dest)
+    return ref
+
+
+def materialize_snapshot(
+    ref: str,
+    snapshots_dir: Union[str, Path],
+    dest: Union[str, Path],
+    symlink: bool = True,
+) -> Path:
+    """Expose snapshot ``ref`` at ``dest`` (symlink by default: read-only use)."""
+    src = Path(snapshots_dir) / ref
+    if not src.exists():
+        raise StoreError(f"Snapshot {ref!r} not found in {snapshots_dir}")
+    dest = Path(dest)
+    if dest.is_symlink() or dest.exists():
+        if dest.is_symlink() or dest.is_file():
+            dest.unlink()
+        else:
+            shutil.rmtree(dest)
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    if symlink:
+        dest.symlink_to(src, target_is_directory=True)
+    else:
+        shutil.copytree(src, dest)
+    return dest

@@ -1,0 +1,4 @@
+from polyaxon_tpu.tracking.context import Context
+from polyaxon_tpu.tracking.reporter import Reporter
+
+__all__ = ["Context", "Reporter"]

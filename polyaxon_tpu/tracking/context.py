@@ -1,0 +1,65 @@
+"""The run context handed to user entrypoints.
+
+Parity: the reference's in-job ``polyaxon-client`` helper (experiment
+tracking: metrics, outputs paths, cluster info) — here extended with the
+TPU-native runtime objects: the device mesh, the parallelism strategy, and
+first-class checkpoint paths.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from polyaxon_tpu.tracking.reporter import Reporter
+
+
+class Context:
+    """What a ``module:function`` entrypoint receives as its only argument."""
+
+    def __init__(
+        self,
+        *,
+        params: Dict[str, Any],
+        process_id: int = 0,
+        num_processes: int = 1,
+        mesh: Any = None,
+        strategy: str = "ddp",
+        strategy_options: Optional[Dict[str, Any]] = None,
+        outputs_path: Optional[str] = None,
+        checkpoints_path: Optional[str] = None,
+        reporter: Optional[Reporter] = None,
+        seed: Optional[int] = None,
+        run_uuid: Optional[str] = None,
+    ) -> None:
+        self.params = params
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.mesh = mesh
+        self.strategy = strategy
+        self.strategy_options = strategy_options or {}
+        self.outputs_path = Path(outputs_path) if outputs_path else None
+        self.checkpoints_path = Path(checkpoints_path) if checkpoints_path else None
+        self.reporter = reporter
+        self.seed = seed
+        self.run_uuid = run_uuid
+
+    # -- identity -------------------------------------------------------------
+    @property
+    def is_leader(self) -> bool:
+        """Process 0 — the one that should write checkpoints/summaries."""
+        return self.process_id == 0
+
+    # -- tracking -------------------------------------------------------------
+    def log_metrics(self, step: Optional[int] = None, **values: Any) -> None:
+        """Report metrics (leader-only by convention, like the reference's
+        master-task metric reporting)."""
+        if self.reporter is not None:
+            self.reporter.metric(values, step=step)
+
+    def log_text(self, line: str) -> None:
+        if self.reporter is not None:
+            self.reporter.log(line)
+
+    def get_param(self, name: str, default: Any = None) -> Any:
+        return self.params.get(name, default)

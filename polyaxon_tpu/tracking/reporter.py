@@ -1,0 +1,82 @@
+"""Worker→control-plane reporting channel.
+
+Parity: the reference's in-pod sidecar + client callbacks — metric POSTs
+(``api/experiments/views.py:495-509`` via polyaxon-client), sidecar liveness
+reconcile (``sidecar/sidecar/__main__.py:39-58``), log publisher
+(``publisher/service.py``).  TPU-native: each gang process appends typed
+JSON lines to its own file under the run's ``reports/`` dir; the control
+plane's watcher tails those files into the registry.  Append-only files on
+shared storage give the same at-least-once semantics with no broker.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import traceback
+from pathlib import Path
+from typing import Any, Dict, Optional, Union
+
+
+class Reporter:
+    """Append-only typed-line writer, safe for one writer per file."""
+
+    def __init__(self, path: Union[str, Path], process_id: int = 0) -> None:
+        self.path = Path(path)
+        self.process_id = process_id
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+
+    def _emit(self, type_: str, **payload: Any) -> None:
+        line = json.dumps({"type": type_, "ts": time.time(), **payload}, default=str)
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+    # -- typed events ---------------------------------------------------------
+    def status(self, status: str, message: Optional[str] = None) -> None:
+        self._emit("status", status=status, message=message)
+
+    def metric(self, values: Dict[str, Any], step: Optional[int] = None) -> None:
+        self._emit("metric", values=values, step=step)
+
+    def log(self, line: str) -> None:
+        self._emit("log", line=line)
+
+    def heartbeat(self) -> None:
+        self._emit("heartbeat")
+
+    def error(self, exc: BaseException) -> None:
+        self._emit(
+            "status",
+            status="failed",
+            message=f"{type(exc).__name__}: {exc}",
+            traceback=traceback.format_exc(),
+        )
+
+    # -- heartbeat thread -----------------------------------------------------
+    def start_heartbeat(self, interval: float) -> None:
+        if self._hb_thread is not None or interval <= 0:
+            return
+        self.heartbeat()  # immediate first beat: no zombie window at startup
+
+        def beat() -> None:
+            while not self._hb_stop.wait(interval):
+                self.heartbeat()
+
+        self._hb_thread = threading.Thread(target=beat, name="heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def close(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+            self._hb_thread = None
+        with self._lock:
+            self._fh.close()

@@ -1,0 +1,4 @@
+from polyaxon_tpu.workers.bus import Retry, TaskBus
+from polyaxon_tpu.workers.names import CronTasks, HPTasks, PipelineTasks, SchedulerTasks
+
+__all__ = ["TaskBus", "Retry", "SchedulerTasks", "HPTasks", "PipelineTasks", "CronTasks"]

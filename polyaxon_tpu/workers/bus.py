@@ -1,0 +1,189 @@
+"""In-process task bus: the celery replacement.
+
+Capability parity with the reference's async-orchestration layer
+(``polyaxon/workers/__init__.py:10-14`` ``send(task_name, kwargs,
+countdown)``, custom base task with retry, beat crons in
+``celery_settings.py:740-860``).  The entire broker/queue/routing stack
+collapses into one process: a priority queue ordered by due time, drained
+either by a background thread (service mode) or by an explicit ``pump()``
+(eager mode — what the reference's tests do with ``CELERY_TASK_ALWAYS_EAGER``,
+``tests/base/case.py:79-87``).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class Retry(Exception):
+    """Raised inside a task to requeue itself after ``countdown`` seconds."""
+
+    def __init__(self, countdown: float = 1.0) -> None:
+        super().__init__(f"retry in {countdown}s")
+        self.countdown = countdown
+
+
+class TaskBus:
+    """Named tasks + delayed sends + crons, one process, thread-safe.
+
+    ``time_scale`` multiplies every countdown/interval — tests compress the
+    reference's 30 s scheduler waves (``celery_settings.py:71-74``) into
+    milliseconds without changing orchestration code.
+    """
+
+    def __init__(self, *, time_scale: float = 1.0, max_retries: int = 100) -> None:
+        self.time_scale = time_scale
+        self.max_retries = max_retries
+        self._tasks: Dict[str, Callable[..., Any]] = {}
+        self._queue: List[Tuple[float, int, str, Dict[str, Any], int]] = []
+        self._counter = itertools.count()
+        self._lock = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self._crons: List[Tuple[str, float, Dict[str, Any]]] = []
+        #: Errors raised by tasks (task name, exception, traceback string).
+        self.errors: List[Tuple[str, BaseException, str]] = []
+
+    # -- registration ---------------------------------------------------------
+    def register(self, name: str, fn: Optional[Callable[..., Any]] = None):
+        """Register ``fn`` under ``name``; usable as a decorator."""
+        if fn is None:
+            def deco(f: Callable[..., Any]) -> Callable[..., Any]:
+                self._tasks[name] = f
+                return f
+            return deco
+        self._tasks[name] = fn
+        return fn
+
+    def has_task(self, name: str) -> bool:
+        return name in self._tasks
+
+    # -- sending --------------------------------------------------------------
+    def send(
+        self,
+        name: str,
+        kwargs: Optional[Dict[str, Any]] = None,
+        countdown: float = 0.0,
+        _retries: int = 0,
+    ) -> None:
+        if name not in self._tasks:
+            raise KeyError(f"Unknown task {name!r}; registered: {sorted(self._tasks)}")
+        due = time.monotonic() + countdown * self.time_scale
+        with self._lock:
+            heapq.heappush(self._queue, (due, next(self._counter), name, kwargs or {}, _retries))
+            self._lock.notify_all()
+
+    def add_cron(self, name: str, interval: float, kwargs: Optional[Dict[str, Any]] = None) -> None:
+        """Beat-style recurring task (first fire after one interval).
+
+        Idempotent per (name, kwargs): re-adding replaces the interval and
+        does not seed a second chain (a stop/start cycle must not double the
+        cron frequency).
+        """
+        kwargs = kwargs or {}
+        for i, (n, _, k) in enumerate(self._crons):
+            if n == name and k == kwargs:
+                self._crons[i] = (name, interval, kwargs)
+                return
+        self._crons.append((name, interval, kwargs))
+        self.send(name, kwargs, countdown=interval)
+
+    # -- execution ------------------------------------------------------------
+    def _run_one(self, name: str, kwargs: Dict[str, Any], retries: int) -> None:
+        fn = self._tasks[name]
+        try:
+            fn(**kwargs)
+        except Retry as r:
+            if retries + 1 > self.max_retries:
+                logger.error("Task %s exhausted %d retries", name, self.max_retries)
+                self.errors.append((name, r, f"max retries ({self.max_retries}) exhausted"))
+                return
+            self.send(name, kwargs, countdown=r.countdown, _retries=retries + 1)
+        except Exception as e:  # noqa: BLE001 — a task must never kill the bus
+            logger.exception("Task %s failed", name)
+            self.errors.append((name, e, traceback.format_exc()))
+
+    def _reschedule_cron(self, name: str, kwargs: Dict[str, Any]) -> None:
+        for cron_name, interval, cron_kwargs in self._crons:
+            if cron_name == name and cron_kwargs == kwargs:
+                self.send(name, kwargs, countdown=interval)
+                return
+
+    def _is_cron(self, name: str, kwargs: Dict[str, Any]) -> bool:
+        return any(n == name and k == kwargs for n, _, k in self._crons)
+
+    def pump(self, *, max_wait: float = 0.0, max_tasks: Optional[int] = None) -> int:
+        """Eagerly drain due tasks in the calling thread.
+
+        Processes everything due now; if the queue holds only future tasks
+        within ``max_wait`` seconds, sleeps until they come due and continues.
+        Returns the number of tasks executed.  Crons are *not* rescheduled by
+        pump (tests fire them explicitly; service mode reschedules).
+        """
+        deadline = time.monotonic() + max_wait
+        executed = 0
+        while max_tasks is None or executed < max_tasks:
+            with self._lock:
+                if not self._queue:
+                    break
+                due, _, name, kwargs, retries = self._queue[0]
+                now = time.monotonic()
+                if due > now:
+                    if due > deadline:
+                        break
+                    wait = due - now
+                else:
+                    heapq.heappop(self._queue)
+                    wait = None
+            if wait is not None:
+                time.sleep(wait)
+                continue
+            self._run_one(name, kwargs, retries)
+            executed += 1
+        return executed
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # -- service mode ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stopping = False
+        self._thread = threading.Thread(target=self._loop, name="taskbus", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            self._stopping = True
+            self._lock.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._stopping:
+                    return
+                if not self._queue:
+                    self._lock.wait(timeout=0.5)
+                    continue
+                due, _, name, kwargs, retries = self._queue[0]
+                now = time.monotonic()
+                if due > now:
+                    self._lock.wait(timeout=min(due - now, 0.5))
+                    continue
+                heapq.heappop(self._queue)
+            self._run_one(name, kwargs, retries)
+            if self._is_cron(name, kwargs):
+                self._reschedule_cron(name, kwargs)

@@ -1,0 +1,40 @@
+"""Named-task registries.
+
+Parity: the reference's task-name registries in
+``polyaxon/polyaxon/config_settings/celery_settings.py`` —
+``SchedulerCeleryTasks`` (:245), ``HPCeleryTasks`` (:304),
+``PipelinesCeleryTasks`` (:179), ``CronsCeleryTasks`` (:141).  The celery
+queue/routing machinery collapses away: one in-process bus, names kept for
+the same reason the reference keeps them — the executor wires events to
+task names, not functions.
+"""
+
+
+class SchedulerTasks:
+    EXPERIMENTS_BUILD = "experiments.build"
+    EXPERIMENTS_START = "experiments.start"
+    EXPERIMENTS_MONITOR = "experiments.monitor"
+    EXPERIMENTS_STOP = "experiments.stop"
+    EXPERIMENTS_CHECK_HEARTBEAT = "experiments.check_heartbeat"
+    GROUPS_CREATE = "groups.create"
+    GROUPS_STOP = "groups.stop"
+    GROUPS_CHECK_DONE = "groups.check_done"
+
+
+class HPTasks:
+    CREATE = "hp.create"
+    START = "hp.start"
+    ITERATE = "hp.iterate"
+
+
+class PipelineTasks:
+    START = "pipelines.start"
+    CHECK = "pipelines.check"
+    STOP = "pipelines.stop"
+    OPS_START = "pipelines.ops_start"
+
+
+class CronTasks:
+    HEARTBEAT_CHECK = "crons.heartbeat_check"
+    STATUS_RECONCILE = "crons.status_reconcile"
+    CLEAN_ACTIVITY = "crons.clean_activity"

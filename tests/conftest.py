@@ -7,13 +7,25 @@ hardware.  Must set env vars before jax is first imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ.setdefault("JAX_THREEFRY_PARTITIONABLE", "1")
+
+# A site-installed TPU PJRT plugin (sitecustomize) may already have imported
+# jax and pinned jax_platforms to the real chip; env vars alone can't undo
+# that, so force the config explicitly. Also keeps gang subprocesses (which
+# inherit our env) off the TPU tunnel.
+for _k in list(os.environ):
+    if _k.startswith(("PALLAS_AXON_", "AXON_")):
+        del os.environ[_k]
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

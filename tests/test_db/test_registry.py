@@ -1,0 +1,213 @@
+"""RunRegistry tests.
+
+Mirrors the reference's model/status tests (``tests/test_dbs``) — lifecycle
+gating on status writes, metric merging into last_metric, heartbeats,
+iterations — against the embedded sqlite registry.
+"""
+
+import threading
+
+import pytest
+
+from polyaxon_tpu.db import RunRegistry
+from polyaxon_tpu.db.registry import RegistryError
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.schemas import PolyaxonFile
+
+EXPERIMENT = {
+    "kind": "experiment",
+    "name": "exp1",
+    "run": {"cmd": "true"},
+    "tags": ["demo"],
+}
+
+
+@pytest.fixture()
+def reg(tmp_path):
+    r = RunRegistry(tmp_path / "reg.db")
+    yield r
+    r.close()
+
+
+def make_run(reg, **kw):
+    spec = PolyaxonFile.load(EXPERIMENT).specification
+    return reg.create_run(spec, **kw)
+
+
+class TestRuns:
+    def test_create_and_get(self, reg):
+        run = make_run(reg)
+        assert run.id == 1
+        assert run.kind == "experiment"
+        assert run.status == S.CREATED
+        assert run.tags == ["demo"]
+        assert reg.get_run(run.uuid).id == run.id
+        assert run.spec.resolved_run().cmd == "true"
+
+    def test_get_missing(self, reg):
+        with pytest.raises(RegistryError):
+            reg.get_run(999)
+
+    def test_cannot_be_born_done(self, reg):
+        spec = PolyaxonFile.load(EXPERIMENT).specification
+        with pytest.raises(RegistryError):
+            reg.create_run(spec, status=S.SUCCEEDED)
+
+    def test_list_filters(self, reg):
+        a = make_run(reg)
+        b = make_run(reg, group_id=7)
+        assert [r.id for r in reg.list_runs()] == [a.id, b.id]
+        assert [r.id for r in reg.list_runs(group_id=7)] == [b.id]
+        assert [r.id for r in reg.list_runs(statuses=[S.CREATED])] == [a.id, b.id]
+        assert reg.list_runs(statuses=[S.RUNNING]) == []
+
+    def test_update_run(self, reg):
+        run = make_run(reg)
+        reg.update_run(run.id, outputs_path="/tmp/x", restarts=2)
+        got = reg.get_run(run.id)
+        assert got.outputs_path == "/tmp/x"
+        assert got.restarts == 2
+        with pytest.raises(RegistryError):
+            reg.update_run(run.id, status=S.RUNNING)  # not via update_run
+
+
+class TestStatuses:
+    def test_gated_transitions(self, reg):
+        run = make_run(reg)
+        assert reg.set_status(run.id, S.SCHEDULED)
+        assert reg.set_status(run.id, S.STARTING)
+        assert not reg.set_status(run.id, S.SCHEDULED)  # backward: rejected
+        assert reg.set_status(run.id, S.RUNNING)
+        assert reg.set_status(run.id, S.SUCCEEDED)
+        assert not reg.set_status(run.id, S.RUNNING)  # done is terminal
+        history = [s["status"] for s in reg.get_statuses(run.id)]
+        assert history == [S.CREATED, S.SCHEDULED, S.STARTING, S.RUNNING, S.SUCCEEDED]
+
+    def test_timestamps(self, reg):
+        run = make_run(reg)
+        assert run.started_at is None
+        reg.set_status(run.id, S.RUNNING)
+        started = reg.get_run(run.id).started_at
+        assert started is not None
+        reg.set_status(run.id, S.FAILED, message="boom")
+        got = reg.get_run(run.id)
+        assert got.finished_at is not None
+        assert got.is_done
+        assert reg.get_statuses(run.id)[-1]["message"] == "boom"
+
+    def test_count_by_status(self, reg):
+        a = make_run(reg, group_id=1)
+        make_run(reg, group_id=1)
+        reg.set_status(a.id, S.RUNNING)
+        assert reg.count_by_status(group_id=1) == {S.CREATED: 1, S.RUNNING: 1}
+
+
+class TestMetrics:
+    def test_merge_last_metric(self, reg):
+        run = make_run(reg)
+        reg.add_metric(run.id, {"loss": 1.5}, step=0)
+        reg.add_metric(run.id, {"loss": 0.5, "acc": 0.9}, step=1)
+        assert reg.last_metric(run.id) == {"loss": 0.5, "acc": 0.9}
+        metrics = reg.get_metrics(run.id)
+        assert len(metrics) == 2
+        assert metrics[0]["values"] == {"loss": 1.5}
+        # cursor-based tailing
+        assert reg.get_metrics(run.id, since_id=metrics[0]["id"]) == metrics[1:]
+
+
+class TestLogs:
+    def test_append_and_tail(self, reg):
+        run = make_run(reg)
+        reg.add_log(run.id, "hello", process_id=0)
+        reg.add_logs(run.id, [(0, "a"), (1, "b")])
+        logs = reg.get_logs(run.id)
+        assert [l["line"] for l in logs] == ["hello", "a", "b"]
+        assert [l["line"] for l in reg.get_logs(run.id, process_id=1)] == ["b"]
+        assert [l["line"] for l in reg.get_logs(run.id, since_id=logs[0]["id"])] == ["a", "b"]
+
+
+class TestHeartbeats:
+    def test_ping_and_zombies(self, reg):
+        run = make_run(reg)
+        assert reg.last_heartbeat(run.id) is None
+        reg.set_status(run.id, S.RUNNING)
+        # running with no heartbeat ever: zombie
+        assert [r.id for r in reg.zombie_runs(ttl_seconds=10)] == [run.id]
+        reg.ping_heartbeat(run.id)
+        assert reg.zombie_runs(ttl_seconds=10) == []
+        reg.ping_heartbeat(run.id, at=1.0)  # ancient
+        assert [r.id for r in reg.zombie_runs(ttl_seconds=10)] == [run.id]
+        # done runs don't need heartbeats
+        reg.set_status(run.id, S.SUCCEEDED)
+        assert reg.zombie_runs(ttl_seconds=10) == []
+
+
+class TestIterations:
+    def test_lifecycle(self, reg):
+        n1 = reg.create_iteration(5, {"bracket": 0})
+        n2 = reg.create_iteration(5, {"bracket": 1})
+        assert (n1, n2) == (1, 2)
+        reg.update_iteration(5, 2, {"bracket": 1, "done": True})
+        assert reg.get_iteration(5)["data"] == {"bracket": 1, "done": True}
+        assert reg.get_iteration(5, 1)["data"] == {"bracket": 0}
+        assert len(reg.get_iterations(5)) == 2
+        with pytest.raises(RegistryError):
+            reg.update_iteration(5, 99, {})
+
+
+class TestProcesses:
+    def test_upsert(self, reg):
+        run = make_run(reg)
+        reg.upsert_process(run.id, 0, pid=100, status=S.STARTING)
+        reg.upsert_process(run.id, 1, pid=101, status=S.STARTING)
+        reg.upsert_process(run.id, 0, status=S.SUCCEEDED, exit_code=0)
+        procs = reg.get_processes(run.id)
+        assert len(procs) == 2
+        assert procs[0]["pid"] == 100  # preserved through upsert
+        assert procs[0]["status"] == S.SUCCEEDED
+        assert procs[0]["exit_code"] == 0
+        reg.clear_processes(run.id)
+        assert reg.get_processes(run.id) == []
+
+
+class TestOptionsAndActivity:
+    def test_options(self, reg):
+        assert reg.get_option("k", 3) == 3
+        reg.set_option("k", {"a": 1})
+        assert reg.get_option("k") == {"a": 1}
+        reg.delete_option("k")
+        assert reg.get_option("k") is None
+
+    def test_activity(self, reg):
+        reg.record_activity("experiment.created", {"id": 1})
+        reg.record_activity("experiment.done", {"id": 1})
+        assert len(reg.get_activities()) == 2
+        assert reg.get_activities("experiment.done")[0]["context"] == {"id": 1}
+
+
+class TestConcurrency:
+    def test_threaded_writes(self, reg):
+        run = make_run(reg)
+
+        def work(i):
+            for j in range(20):
+                reg.add_metric(run.id, {f"m{i}": j})
+
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(reg.get_metrics(run.id)) == 80
+        assert reg.last_metric(run.id) == {f"m{i}": 19 for i in range(4)}
+
+    def test_cross_connection_visibility(self, reg, tmp_path):
+        # A second registry handle (simulating another process) sees writes.
+        run = make_run(reg)
+        other = RunRegistry(reg.path)
+        try:
+            assert other.get_run(run.id).status == S.CREATED
+            reg.set_status(run.id, S.RUNNING)
+            assert other.get_run(run.id).status == S.RUNNING
+        finally:
+            other.close()

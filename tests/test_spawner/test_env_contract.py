@@ -1,0 +1,77 @@
+"""Spawner/runtime env-contract tests as pure data.
+
+Mirrors the reference's spawner tests (``tests/test_spawner/test_spawner.py``)
+which assert the generated cluster_def / TF_CONFIG env without a cluster —
+here the gang env contract round-trips through ``GangInfo``.
+"""
+
+from polyaxon_tpu.compiler import compile_gang_plan, compile_spec
+from polyaxon_tpu.runtime.env import EnvVars, GangInfo, gang_env
+from polyaxon_tpu.runtime.mesh import local_batch_slice
+
+
+class TestEnvContract:
+    def test_round_trip(self):
+        env = gang_env(
+            run_id=3,
+            run_uuid="u",
+            run_dir="/d",
+            spec_path="/d/spec.json",
+            process_id=1,
+            num_processes=4,
+            coordinator="127.0.0.1:555",
+            devices_per_host=8,
+            accelerator="v5e-32",
+            mesh_axes={"data": 4, "tensor": 8},
+            strategy="tp_dp",
+            strategy_options={"microbatches": 4},
+            seed=42,
+        )
+        info = GangInfo.from_env(env)
+        assert info.process_id == 1
+        assert info.num_processes == 4
+        assert info.coordinator == "127.0.0.1:555"
+        assert info.mesh_axes == {"data": 4, "tensor": 8}
+        assert info.strategy_options == {"microbatches": 4}
+        assert info.seed == 42
+
+    def test_single_host_has_no_coordinator(self):
+        env = gang_env(
+            run_id=1,
+            run_uuid="u",
+            run_dir="/d",
+            spec_path="/d/s.json",
+            process_id=0,
+            num_processes=1,
+            coordinator=None,
+            devices_per_host=8,
+            accelerator="cpu",
+            mesh_axes={"data": 8},
+            strategy="ddp",
+            strategy_options={},
+        )
+        assert EnvVars.COORDINATOR not in env
+        assert GangInfo.from_env(env).coordinator is None
+
+    def test_plan_from_spec_v5e16(self):
+        spec = compile_spec(
+            {
+                "kind": "experiment",
+                "run": {"cmd": "true"},
+                "environment": {
+                    "topology": {"accelerator": "v5e-16", "mesh": {"data": -1, "tensor": 4}}
+                },
+            }
+        )
+        plan = compile_gang_plan(spec)
+        assert (plan.num_hosts, plan.devices_per_host) == (2, 8)
+        assert plan.mesh_axes == {"data": 4, "tensor": 4}
+        assert plan.num_devices == 16
+
+
+class TestBatchSlice:
+    def test_slices_partition(self):
+        s0 = local_batch_slice(64, 4, 0)
+        s3 = local_batch_slice(64, 4, 3)
+        assert (s0.start, s0.stop) == (0, 16)
+        assert (s3.start, s3.stop) == (48, 64)

@@ -1,0 +1,82 @@
+"""Stores + snapshot tests (reference: tests/test_stores, dockerizer tests)."""
+
+import pytest
+
+from polyaxon_tpu.exceptions import StoreError
+from polyaxon_tpu.schemas.run import BuildConfig
+from polyaxon_tpu.stores import StoreLayout, create_snapshot, materialize_snapshot
+from polyaxon_tpu.stores.snapshots import snapshot_hash
+
+
+@pytest.fixture()
+def src(tmp_path):
+    d = tmp_path / "src"
+    (d / "pkg").mkdir(parents=True)
+    (d / "pkg" / "train.py").write_text("print('train')")
+    (d / "config.yaml").write_text("lr: 0.1")
+    (d / "pkg" / "__pycache__").mkdir()
+    (d / "pkg" / "__pycache__" / "train.cpython-312.pyc").write_text("junk")
+    (d / "notes.txt").write_text("not included")
+    return d
+
+
+class TestSnapshots:
+    def test_create_is_content_addressed_and_idempotent(self, src, tmp_path):
+        snaps = tmp_path / "snaps"
+        build = BuildConfig()
+        ref1 = create_snapshot(build, src, snaps)
+        ref2 = create_snapshot(build, src, snaps)
+        assert ref1 == ref2
+        assert (snaps / ref1 / "pkg" / "train.py").read_text() == "print('train')"
+        assert not (snaps / ref1 / "notes.txt").exists()
+        assert not (snaps / ref1 / "pkg" / "__pycache__").exists()
+
+    def test_content_change_changes_hash(self, src, tmp_path):
+        snaps = tmp_path / "snaps"
+        build = BuildConfig()
+        ref1 = create_snapshot(build, src, snaps)
+        (src / "pkg" / "train.py").write_text("print('changed')")
+        ref2 = create_snapshot(build, src, snaps)
+        assert ref1 != ref2
+        assert (snaps / ref1).exists() and (snaps / ref2).exists()
+
+    def test_hash_without_copy(self, src, tmp_path):
+        assert snapshot_hash(BuildConfig(), src) == create_snapshot(
+            BuildConfig(), src, tmp_path / "s"
+        )
+
+    def test_ref_pinning(self, src, tmp_path):
+        snaps = tmp_path / "snaps"
+        ref = create_snapshot(BuildConfig(), src, snaps)
+        assert create_snapshot(BuildConfig(ref=ref), src, snaps) == ref
+        with pytest.raises(StoreError):
+            create_snapshot(BuildConfig(ref="deadbeef"), src, snaps)
+
+    def test_materialize_symlink(self, src, tmp_path):
+        snaps = tmp_path / "snaps"
+        ref = create_snapshot(BuildConfig(), src, snaps)
+        dest = materialize_snapshot(ref, snaps, tmp_path / "run" / "code")
+        assert (dest / "pkg" / "train.py").exists()
+        with pytest.raises(StoreError):
+            materialize_snapshot("nope", snaps, tmp_path / "x")
+
+
+class TestLayout:
+    def test_run_paths(self, tmp_path):
+        layout = StoreLayout(tmp_path / "base")
+        paths = layout.run_paths("abc123").ensure()
+        assert paths.outputs.is_dir()
+        assert paths.reports.is_dir()
+        assert paths.checkpoints.is_dir()
+        assert paths.report_file(3).name == "proc3.jsonl"
+
+    def test_copy_outputs(self, tmp_path):
+        layout = StoreLayout(tmp_path / "base")
+        a = layout.run_paths("aaa").ensure()
+        (a.outputs / "model.bin").write_text("weights")
+        (a.checkpoints / "step_10").mkdir()
+        (a.checkpoints / "step_10" / "state").write_text("ck")
+        layout.copy_outputs("aaa", "bbb")
+        b = layout.run_paths("bbb")
+        assert (b.outputs / "model.bin").read_text() == "weights"
+        assert (b.checkpoints / "step_10" / "state").read_text() == "ck"

@@ -1,0 +1,181 @@
+"""Task bus + auditor/executor fan-out tests.
+
+Mirrors the eager-celery pattern of the reference test base
+(``tests/base/case.py:79-87``): the whole task graph runs in-process.
+"""
+
+import time
+
+import pytest
+
+from polyaxon_tpu.auditor import Auditor
+from polyaxon_tpu.db import RunRegistry
+from polyaxon_tpu.events import Event, EventTypes
+from polyaxon_tpu.executor import ExecutorHandlers
+from polyaxon_tpu.workers import HPTasks, Retry, SchedulerTasks, TaskBus
+
+
+class TestTaskBus:
+    def test_register_and_send(self):
+        bus = TaskBus()
+        seen = []
+        bus.register("t.a", lambda x: seen.append(x))
+        bus.send("t.a", {"x": 1})
+        bus.send("t.a", {"x": 2})
+        assert bus.pump() == 2
+        assert seen == [1, 2]
+
+    def test_unknown_task(self):
+        bus = TaskBus()
+        with pytest.raises(KeyError):
+            bus.send("nope")
+
+    def test_decorator_registration(self):
+        bus = TaskBus()
+
+        @bus.register("t.b")
+        def handler():
+            handler.called = True
+
+        bus.send("t.b")
+        bus.pump()
+        assert handler.called
+
+    def test_countdown_ordering_and_time_scale(self):
+        bus = TaskBus(time_scale=0.01)  # 1s countdown -> 10ms
+        seen = []
+        bus.register("t.c", lambda tag: seen.append(tag))
+        bus.send("t.c", {"tag": "later"}, countdown=1.0)
+        bus.send("t.c", {"tag": "now"})
+        assert bus.pump() == 1  # only the due task runs without waiting
+        assert seen == ["now"]
+        assert bus.pump(max_wait=1.0) == 1  # waits the scaled 10ms
+        assert seen == ["now", "later"]
+
+    def test_retry(self):
+        bus = TaskBus(time_scale=0)
+        attempts = []
+
+        @bus.register("t.d")
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise Retry(countdown=0)
+
+        bus.send("t.d")
+        bus.pump()
+        assert len(attempts) == 3
+        assert bus.errors == []
+
+    def test_retry_exhaustion(self):
+        bus = TaskBus(time_scale=0, max_retries=2)
+
+        @bus.register("t.e")
+        def always():
+            raise Retry(countdown=0)
+
+        bus.send("t.e")
+        bus.pump()
+        assert len(bus.errors) == 1
+
+    def test_errors_recorded_not_raised(self):
+        bus = TaskBus()
+
+        @bus.register("t.f")
+        def boom():
+            raise ValueError("boom")
+
+        bus.send("t.f")
+        bus.pump()
+        assert len(bus.errors) == 1
+        assert isinstance(bus.errors[0][1], ValueError)
+
+    def test_service_mode(self):
+        bus = TaskBus()
+        seen = []
+        bus.register("t.g", lambda: seen.append(1))
+        bus.start()
+        try:
+            bus.send("t.g")
+            deadline = time.time() + 2
+            while not seen and time.time() < deadline:
+                time.sleep(0.01)
+        finally:
+            bus.stop()
+        assert seen == [1]
+
+    def test_cron_reschedules_in_service_mode(self):
+        bus = TaskBus()
+        seen = []
+        bus.register("t.h", lambda: seen.append(1))
+        bus.add_cron("t.h", interval=0.02)
+        bus.start()
+        try:
+            deadline = time.time() + 2
+            while len(seen) < 3 and time.time() < deadline:
+                time.sleep(0.01)
+        finally:
+            bus.stop()
+        assert len(seen) >= 3
+
+
+class TestAuditorExecutor:
+    def test_record_persists_and_fans_out(self, tmp_path):
+        reg = RunRegistry(tmp_path / "r.db")
+        auditor = Auditor(reg)
+        seen = []
+        auditor.subscribe(lambda e: seen.append(e))
+        event = auditor.record(EventTypes.EXPERIMENT_CREATED, run_id=1)
+        assert event.subject == "experiment"
+        assert event.action == "created"
+        assert seen[0].context == {"run_id": 1}
+        acts = reg.get_activities(EventTypes.EXPERIMENT_CREATED)
+        assert acts[0]["context"] == {"run_id": 1}
+        reg.close()
+
+    def test_handler_exception_does_not_break_record(self):
+        auditor = Auditor()
+        auditor.subscribe(lambda e: (_ for _ in ()).throw(ValueError("x")))
+        seen = []
+        auditor.subscribe(lambda e: seen.append(e))
+        auditor.record(EventTypes.EXPERIMENT_CREATED, run_id=1)
+        assert len(seen) == 1
+
+    def _bus_with_stubs(self):
+        bus = TaskBus()
+        calls = []
+        for name in (
+            SchedulerTasks.EXPERIMENTS_BUILD,
+            SchedulerTasks.EXPERIMENTS_START,
+            SchedulerTasks.EXPERIMENTS_STOP,
+            HPTasks.START,
+            HPTasks.CREATE,
+        ):
+            bus.register(name, (lambda n: lambda **kw: calls.append((n, kw)))(name))
+        return bus, calls
+
+    def test_created_chains_to_build_then_start(self):
+        bus, calls = self._bus_with_stubs()
+        handlers = ExecutorHandlers(bus)
+        handlers(Event(EventTypes.EXPERIMENT_CREATED, {"run_id": 5}))
+        bus.pump()
+        assert calls == [(SchedulerTasks.EXPERIMENTS_BUILD, {"run_id": 5})]
+        handlers(Event(EventTypes.EXPERIMENT_BUILD_DONE, {"run_id": 5}))
+        bus.pump()
+        assert calls[-1] == (SchedulerTasks.EXPERIMENTS_START, {"run_id": 5})
+
+    def test_done_kicks_group_wave(self):
+        bus, calls = self._bus_with_stubs()
+        handlers = ExecutorHandlers(bus)
+        handlers(Event(EventTypes.EXPERIMENT_DONE, {"run_id": 5, "group_id": 2}))
+        bus.pump()
+        names = [c[0] for c in calls]
+        assert SchedulerTasks.EXPERIMENTS_STOP in names
+        assert HPTasks.START in names
+
+    def test_done_without_group_no_hp(self):
+        bus, calls = self._bus_with_stubs()
+        handlers = ExecutorHandlers(bus)
+        handlers(Event(EventTypes.EXPERIMENT_DONE, {"run_id": 5}))
+        bus.pump()
+        assert [c[0] for c in calls] == [SchedulerTasks.EXPERIMENTS_STOP]
