@@ -19,6 +19,7 @@ import socket
 import subprocess
 import sys
 import time
+from pathlib import Path
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
@@ -99,6 +100,13 @@ class LocalGangSpawner:
                         if key.startswith(("PALLAS_AXON_", "AXON_")) or key == "TPU_SKIP_MDS_QUERY":
                             env.pop(key)
                     env["JAX_PLATFORMS"] = "cpu"
+                # The worker runs with cwd=run_dir; make sure it can import
+                # this package even when it isn't pip-installed (dev/test
+                # checkouts) by prepending the package parent to PYTHONPATH.
+                pkg_parent = str(Path(__file__).resolve().parents[2])
+                env["PYTHONPATH"] = os.pathsep.join(
+                    p for p in (pkg_parent, env.get("PYTHONPATH")) if p
+                )
                 env.update(plan.env_vars)
                 env.update(
                     gang_env(
