@@ -377,6 +377,10 @@ class RunRegistry:
         """Gated status write; returns whether the transition was applied."""
         now = time.time()
         with self._lock, self._conn() as conn:
+            # The lifecycle gate is check-then-act: take the write lock up
+            # front so concurrent *processes* (the in-process lock can't see
+            # them) serialize the whole read-check-write.
+            conn.execute("BEGIN IMMEDIATE")
             row = conn.execute(
                 "SELECT kind, status, started_at FROM runs WHERE id = ?", (run_id,)
             ).fetchone()
@@ -430,6 +434,7 @@ class RunRegistry:
     ) -> None:
         now = time.time()
         with self._lock, self._conn() as conn:
+            conn.execute("BEGIN IMMEDIATE")
             row = conn.execute(
                 "SELECT last_metric FROM runs WHERE id = ?", (run_id,)
             ).fetchone()
@@ -546,6 +551,7 @@ class RunRegistry:
     def create_iteration(self, group_id: int, data: Dict[str, Any]) -> int:
         now = time.time()
         with self._lock, self._conn() as conn:
+            conn.execute("BEGIN IMMEDIATE")
             row = conn.execute(
                 "SELECT MAX(number) AS n FROM iterations WHERE group_id = ?",
                 (group_id,),

@@ -26,7 +26,9 @@ class ExecutorHandlers:
         self._table = {
             EventTypes.EXPERIMENT_CREATED: self._experiment_created,
             EventTypes.EXPERIMENT_RESUMED: self._experiment_created,
-            EventTypes.EXPERIMENT_RESTARTED: self._experiment_created,
+            # EXPERIMENT_RESTARTED is audit-only: the monitor task schedules
+            # the relaunch itself (with the restart-policy backoff); reacting
+            # here would dispatch a second, backoff-free START.
             EventTypes.EXPERIMENT_BUILD_DONE: self._experiment_build_done,
             EventTypes.EXPERIMENT_DONE: self._experiment_done,
             EventTypes.GROUP_CREATED: self._group_created,

@@ -13,6 +13,7 @@ state (active gang handles) lives in a single place.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
@@ -43,6 +44,9 @@ class SchedulerContext:
     gangs: Dict[int, GangHandle] = field(default_factory=dict)
     monitor_interval: float = 0.2
     heartbeat_ttl: float = 600.0
+    #: How long a logically-done gang may keep live members before the
+    #: spawner forces them down (survivors hung in collectives).
+    terminal_grace: float = 10.0
 
 
 def _record_done(ctx: SchedulerContext, run_id: int, status: str) -> None:
@@ -80,7 +84,7 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             ctx.auditor.record(EventTypes.EXPERIMENT_BUILD_STARTED, run_id=run_id)
             try:
                 ref = create_snapshot(build, build.context, ctx.layout.snapshots_dir)
-            except PolyaxonTPUError as e:
+            except Exception as e:
                 reg.set_status(run_id, S.FAILED, message=f"build failed: {e}")
                 _record_done(ctx, run_id, S.FAILED)
                 return
@@ -103,7 +107,9 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             return
         try:
             handle = ctx.spawner.start(run, plan)
-        except PolyaxonTPUError as e:
+        except Exception as e:  # disk-full/permission OSErrors included —
+            # anything escaping here would strand the run in SCHEDULED,
+            # a status the zombie cron never scans.
             reg.set_status(run_id, S.UNSCHEDULABLE, message=str(e))
             reg.set_status(run_id, S.FAILED, message=str(e))
             _record_done(ctx, run_id, S.FAILED)
@@ -158,7 +164,20 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             return
         if rollup == S.RUNNING:
             reg.set_status(run_id, S.RUNNING)
-        if rollup in (S.SUCCEEDED, S.FAILED, S.SKIPPED) and handle.all_exited:
+        if rollup in (S.SUCCEEDED, S.FAILED, S.SKIPPED) and not handle.all_exited:
+            # Gang is logically done but members are still alive — typically
+            # a survivor blocked in a collective on a dead peer. Give the
+            # gang a grace window to drain, then force it down; otherwise the
+            # run would sit RUNNING forever (the survivor keeps heartbeating,
+            # so the zombie cron can't catch it either).
+            now = time.time()
+            if handle.terminal_since is None:
+                handle.terminal_since = now
+            if now - handle.terminal_since < ctx.terminal_grace:
+                _reschedule_monitor(run_id)
+                return
+            ctx.spawner.stop(handle)
+        if rollup in (S.SUCCEEDED, S.FAILED, S.SKIPPED):
             # One final ingest now that every process flushed and exited.
             ctx.watcher.ingest(handle)
             ctx.gangs.pop(run_id, None)

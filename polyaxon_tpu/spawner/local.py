@@ -51,6 +51,9 @@ class GangHandle:
     started_at: float = field(default_factory=time.time)
     #: Consecutive monitor-poll failures (scheduler bookkeeping).
     monitor_failures: int = 0
+    #: When the gang's roll-up first went terminal while members were still
+    #: alive (scheduler grace-window bookkeeping).
+    terminal_since: Optional[float] = None
 
     def poll(self) -> Dict[int, Optional[int]]:
         """process_id -> exit code (None while running)."""
@@ -100,14 +103,16 @@ class LocalGangSpawner:
                         if key.startswith(("PALLAS_AXON_", "AXON_")) or key == "TPU_SKIP_MDS_QUERY":
                             env.pop(key)
                     env["JAX_PLATFORMS"] = "cpu"
+                env.update(plan.env_vars)
                 # The worker runs with cwd=run_dir; make sure it can import
                 # this package even when it isn't pip-installed (dev/test
-                # checkouts) by prepending the package parent to PYTHONPATH.
+                # checkouts) by prepending the package parent to PYTHONPATH —
+                # after the spec's env_vars so a user PYTHONPATH augments
+                # rather than clobbers it.
                 pkg_parent = str(Path(__file__).resolve().parents[2])
                 env["PYTHONPATH"] = os.pathsep.join(
                     p for p in (pkg_parent, env.get("PYTHONPATH")) if p
                 )
-                env.update(plan.env_vars)
                 env.update(
                     gang_env(
                         run_id=run.id,

@@ -13,8 +13,9 @@ from __future__ import annotations
 
 import hashlib
 import shutil
+import uuid
 from pathlib import Path
-from typing import List, Optional, Union
+from typing import List, Union
 
 from polyaxon_tpu.exceptions import StoreError
 from polyaxon_tpu.schemas.run import BuildConfig
@@ -34,13 +35,37 @@ def _matched_files(build: BuildConfig, source_dir: Path) -> List[Path]:
     return sorted(p for p in included if not is_excluded(p))
 
 
-def snapshot_hash(build: BuildConfig, source_dir: Union[str, Path]) -> str:
-    source_dir = Path(source_dir)
+def _snapshot_walk(
+    build: BuildConfig, source_dir: Path, write_dir: Union[Path, None] = None
+) -> str:
+    """Hash matched files, optionally streaming them into ``write_dir``.
+
+    One walk, one read per file: the bytes fed to the hasher are exactly the
+    bytes stored, so a file edited mid-snapshot can't be cached under the
+    wrong content hash. Streams in chunks (no whole-context buffering) and
+    preserves file modes (exec bits) via ``copystat``.
+    """
     h = hashlib.sha256()
     for path in _matched_files(build, source_dir):
-        h.update(str(path.relative_to(source_dir)).encode())
-        h.update(path.read_bytes())
+        rel = path.relative_to(source_dir)
+        h.update(str(rel).encode())
+        if write_dir is None:
+            with path.open("rb") as src:
+                while chunk := src.read(1 << 20):
+                    h.update(chunk)
+        else:
+            target = write_dir / rel
+            target.parent.mkdir(parents=True, exist_ok=True)
+            with path.open("rb") as src, target.open("wb") as dst:
+                while chunk := src.read(1 << 20):
+                    h.update(chunk)
+                    dst.write(chunk)
+            shutil.copystat(path, target)
     return h.hexdigest()[:16]
+
+
+def snapshot_hash(build: BuildConfig, source_dir: Union[str, Path]) -> str:
+    return _snapshot_walk(build, Path(source_dir))
 
 
 def create_snapshot(
@@ -57,20 +82,21 @@ def create_snapshot(
         return build.ref
     if not source_dir.exists():
         raise StoreError(f"Build context {source_dir} does not exist")
-    ref = snapshot_hash(build, source_dir)
-    dest = Path(snapshots_dir) / ref
-    if dest.exists():
-        return ref  # image-exists short-circuit
-    tmp = dest.with_suffix(".tmp")
-    if tmp.exists():
-        shutil.rmtree(tmp)
-    for path in _matched_files(build, source_dir):
-        rel = path.relative_to(source_dir)
-        target = tmp / rel
-        target.parent.mkdir(parents=True, exist_ok=True)
-        shutil.copy2(path, target)
-    tmp.mkdir(parents=True, exist_ok=True)  # snapshot may legitimately be empty
-    tmp.rename(dest)
+    # Stream into a staging dir while hashing (the ref isn't known until the
+    # walk ends), then rename to the hash-named dest.
+    snapshots_dir = Path(snapshots_dir)
+    staging = snapshots_dir / f".staging-{uuid.uuid4().hex}"
+    staging.mkdir(parents=True, exist_ok=True)  # snapshot may be empty
+    try:
+        ref = _snapshot_walk(build, source_dir, staging)
+        dest = snapshots_dir / ref
+        if dest.exists():  # image-exists short-circuit
+            shutil.rmtree(staging)
+            return ref
+        staging.rename(dest)
+    except BaseException:
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
     return ref
 
 

@@ -1,0 +1,39 @@
+"""Executor event→task dispatch contract.
+
+Parity: reference ``executor/handlers/experiment.py:12-118``. The restart
+case is the regression target: the monitor task owns the relaunch (with
+restart-policy backoff), so the executor must NOT also dispatch on
+EXPERIMENT_RESTARTED — doing both launched a second, backoff-free gang.
+"""
+
+from polyaxon_tpu.events import Event, EventTypes
+from polyaxon_tpu.executor import ExecutorHandlers
+from polyaxon_tpu.workers import HPTasks, SchedulerTasks
+
+
+class RecordingBus:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, name, kwargs=None, countdown=0.0):
+        self.sent.append((name, kwargs or {}))
+
+
+def dispatch(event_type, **context):
+    bus = RecordingBus()
+    ExecutorHandlers(bus)(Event(event_type=event_type, context=context))
+    return bus.sent
+
+
+class TestExecutorDispatch:
+    def test_created_chains_to_build(self):
+        sent = dispatch(EventTypes.EXPERIMENT_CREATED, run_id=1)
+        assert sent == [(SchedulerTasks.EXPERIMENTS_BUILD, {"run_id": 1})]
+
+    def test_restarted_is_audit_only(self):
+        assert dispatch(EventTypes.EXPERIMENT_RESTARTED, run_id=1) == []
+
+    def test_done_kicks_group_wave(self):
+        sent = dispatch(EventTypes.EXPERIMENT_DONE, run_id=1, group_id=7, status="failed")
+        assert (SchedulerTasks.EXPERIMENTS_STOP, {"run_id": 1, "cleanup": True}) in sent
+        assert (HPTasks.START, {"group_id": 7}) in sent
