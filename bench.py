@@ -1,0 +1,146 @@
+"""Single-chip training benchmark: flagship transformer LM on the real TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+The headline number is model FLOP/s utilization (MFU) of a bf16 train step
+sized for one chip.  The reference publishes no training numbers
+(BASELINE.md: "published": {}), so vs_baseline compares against the last
+recorded run of THIS benchmark (BENCH_BASELINE.json, written on first run)
+— i.e. the bar is "don't regress, then beat yourself".
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+#: bf16 peak FLOP/s per chip by device kind (dense MXU).
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from polyaxon_tpu.models import (
+        TransformerConfig,
+        init_params,
+        loss_fn,
+        param_axes,
+    )
+    from polyaxon_tpu.parallel import template_for
+    from polyaxon_tpu.runtime.mesh import build_mesh
+    from polyaxon_tpu.runtime.train import build_train_step
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    # Sized to exercise the MXU on one chip; tiny fallback for CPU smoke.
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=32768,
+            d_model=1024,
+            n_layers=12,
+            n_heads=16,
+            head_dim=64,
+            d_ff=4096,
+            max_seq=1024,
+            # remat: recompute block activations in backward — without it the
+            # scan saves n_layers × [B,H,T,T] attention scores and OOMs HBM.
+            remat=True,
+        )
+        batch_size, seq, steps, warmup = 8, 1024, 20, 3
+    else:
+        cfg = TransformerConfig(
+            vocab_size=256,
+            d_model=64,
+            n_layers=2,
+            n_heads=4,
+            head_dim=16,
+            d_ff=128,
+            max_seq=64,
+            dtype=jnp.float32,
+        )
+        batch_size, seq, steps, warmup = 4, 64, 5, 1
+
+    mesh_axes = {"data": jax.local_device_count()}
+    mesh = build_mesh(mesh_axes)
+    template = template_for("ddp", mesh_axes)
+    ts = build_train_step(
+        loss_fn=lambda p, b: loss_fn(p, b, cfg, template=template, mesh=mesh),
+        init_fn=lambda k: init_params(k, cfg),
+        axes_tree=param_axes(cfg),
+        optimizer=optax.adamw(3e-4),
+        mesh=mesh,
+        template=template,
+    )
+    key = jax.random.PRNGKey(0)
+    params, opt_state = ts.init(key)
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, cfg.vocab_size, (batch_size, seq + 1))
+    batch = ts.place_batch(
+        {"tokens": jnp.asarray(tok[:, :-1]), "targets": jnp.asarray(tok[:, 1:])}
+    )
+
+    # Sync via a host read of the loss: on the axon (tunneled-TPU) platform
+    # block_until_ready can return before remote execution finishes, which
+    # made timings absurd; a device->host copy is a true barrier.
+    for _ in range(warmup):
+        params, opt_state, metrics = ts.step(params, opt_state, batch, key)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, metrics = ts.step(params, opt_state, batch, key)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    steps_per_s = steps / dt
+    tokens_per_s = steps_per_s * batch_size * seq
+    # Train-step FLOPs: 6*N per token (fwd+bwd matmuls) + attention scores
+    # 12*L*H*hd*T per token (fwd+bwd, causal halves then doubles back).
+    n_params = cfg.n_params
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.n_heads * cfg.head_dim * seq
+    model_flops_per_s = tokens_per_s * flops_per_token
+    peak = PEAK_FLOPS.get(dev.device_kind, 197e12) * jax.local_device_count()
+    mfu = model_flops_per_s / peak if on_tpu else 0.0
+
+    baseline_path = Path(__file__).parent / "BENCH_BASELINE.json"
+    vs_baseline = 1.0
+    if on_tpu:
+        if baseline_path.exists():
+            base = json.loads(baseline_path.read_text()).get("tokens_per_s", 0)
+            if base:
+                vs_baseline = tokens_per_s / base
+        else:
+            baseline_path.write_text(
+                json.dumps({"tokens_per_s": tokens_per_s, "mfu": mfu})
+            )
+
+    print(
+        json.dumps(
+            {
+                "metric": "lm_train_single_chip_mfu",
+                "value": round(mfu, 4),
+                "unit": "mfu",
+                "vs_baseline": round(vs_baseline, 3),
+                "tokens_per_s": round(tokens_per_s),
+                "steps_per_s": round(steps_per_s, 3),
+                "final_loss": round(float(metrics["loss"]), 4),
+                "device": dev.device_kind,
+                "n_params": n_params,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

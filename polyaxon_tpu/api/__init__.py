@@ -1,0 +1,3 @@
+from polyaxon_tpu.api.app import create_app, run_to_dict, serve
+
+__all__ = ["create_app", "run_to_dict", "serve"]

@@ -1,0 +1,235 @@
+"""REST + WebSocket API over the orchestrator.
+
+Parity: the reference's DRF surface (``api/experiments/views.py`` — list/
+detail :120-280, stop/restart/resume/copy :281-368, statuses :468, metric
+ingestion :495-509) and its Sanic streams service (``streams/api.py:14-45``,
+``streams/resources/experiments.py:22-113`` — WS log/metric tailing).
+TPU-native collapse: one aiohttp app over the embedded orchestrator; live
+tailing reads the registry's cursor-friendly rows (statuses/metrics/logs
+are ordinary ordered rows), no RabbitMQ/Redis fan-out needed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Dict, Optional
+
+from polyaxon_tpu.db.registry import Run, RunRegistry
+from polyaxon_tpu.exceptions import PolyaxonTPUError
+from polyaxon_tpu.orchestrator import Orchestrator
+
+logger = logging.getLogger(__name__)
+
+API_PREFIX = "/api/v1"
+
+
+def run_to_dict(run: Run) -> Dict[str, Any]:
+    return {
+        "id": run.id,
+        "uuid": run.uuid,
+        "kind": run.kind,
+        "name": run.name,
+        "project": run.project,
+        "status": run.status,
+        "group_id": run.group_id,
+        "pipeline_id": run.pipeline_id,
+        "original_id": run.original_id,
+        "cloning_strategy": run.cloning_strategy,
+        "restarts": run.restarts,
+        "tags": run.tags,
+        "last_metric": run.last_metric,
+        "is_done": run.is_done,
+        "created_at": run.created_at,
+        "started_at": run.started_at,
+        "finished_at": run.finished_at,
+        "spec": run.spec_data,
+    }
+
+
+def create_app(orch: Orchestrator):
+    from aiohttp import WSMsgType, web
+
+    routes = web.RouteTableDef()
+    reg: RunRegistry = orch.registry
+
+    def _run_or_404(request) -> Run:
+        try:
+            return reg.get_run(int(request.match_info["run_id"]))
+        except PolyaxonTPUError:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": f"run {request.match_info['run_id']} not found"}),
+                content_type="application/json",
+            )
+
+    @routes.get(f"{API_PREFIX}/status")
+    async def status(request):
+        # Health surface (reference checks/ + api/index/status.py).
+        from polyaxon_tpu.checks import run_health_checks
+
+        report = run_health_checks(orch)
+        code = 200 if report["healthy"] else 503
+        return web.json_response(report, status=code)
+
+    # -- runs CRUD + actions --------------------------------------------------
+    @routes.post(f"{API_PREFIX}/runs")
+    async def create_run(request):
+        body = await request.json()
+        try:
+            run = orch.submit(
+                body.get("spec") or body.get("content"),
+                project=body.get("project", "default"),
+                name=body.get("name"),
+                tags=body.get("tags"),
+            )
+        except PolyaxonTPUError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(run_to_dict(run), status=201)
+
+    @routes.get(f"{API_PREFIX}/runs")
+    async def list_runs(request):
+        q = request.rel_url.query
+        statuses = q.getall("status", []) or None
+        runs = reg.list_runs(
+            kind=q.get("kind"),
+            project=q.get("project"),
+            group_id=int(q["group_id"]) if "group_id" in q else None,
+            pipeline_id=int(q["pipeline_id"]) if "pipeline_id" in q else None,
+            statuses=statuses,
+            limit=int(q.get("limit", 100)),
+            offset=int(q.get("offset", 0)),
+        )
+        return web.json_response({"results": [run_to_dict(r) for r in runs]})
+
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}")
+    async def get_run(request):
+        return web.json_response(run_to_dict(_run_or_404(request)))
+
+    @routes.post(f"{API_PREFIX}/runs/{{run_id}}/stop")
+    async def stop_run(request):
+        run = _run_or_404(request)
+        orch.stop_run(run.id)
+        return web.json_response({"ok": True})
+
+    @routes.post(f"{API_PREFIX}/runs/{{run_id}}/restart")
+    async def restart_run(request):
+        run = _run_or_404(request)
+        clone = orch.clone_run(run.id, strategy="restart")
+        return web.json_response(run_to_dict(clone), status=201)
+
+    @routes.post(f"{API_PREFIX}/runs/{{run_id}}/resume")
+    async def resume_run(request):
+        run = _run_or_404(request)
+        clone = orch.clone_run(run.id, strategy="resume")
+        return web.json_response(run_to_dict(clone), status=201)
+
+    @routes.post(f"{API_PREFIX}/runs/{{run_id}}/copy")
+    async def copy_run(request):
+        run = _run_or_404(request)
+        clone = orch.clone_run(run.id, strategy="copy")
+        return web.json_response(run_to_dict(clone), status=201)
+
+    # -- sub-resources --------------------------------------------------------
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/statuses")
+    async def get_statuses(request):
+        run = _run_or_404(request)
+        return web.json_response({"results": reg.get_statuses(run.id)})
+
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/metrics")
+    async def get_metrics(request):
+        run = _run_or_404(request)
+        since = int(request.rel_url.query.get("since_id", 0))
+        return web.json_response({"results": reg.get_metrics(run.id, since_id=since)})
+
+    @routes.post(f"{API_PREFIX}/runs/{{run_id}}/metrics")
+    async def post_metrics(request):
+        # In-job metric ingestion (reference ExperimentMetricListView).
+        run = _run_or_404(request)
+        body = await request.json()
+        reg.add_metric(run.id, body.get("values", {}), step=body.get("step"))
+        return web.json_response({"ok": True}, status=201)
+
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/logs")
+    async def get_logs(request):
+        run = _run_or_404(request)
+        q = request.rel_url.query
+        rows = reg.get_logs(
+            run.id,
+            since_id=int(q.get("since_id", 0)),
+            limit=int(q["limit"]) if "limit" in q else None,
+        )
+        return web.json_response({"results": rows})
+
+    @routes.post(f"{API_PREFIX}/runs/{{run_id}}/heartbeat")
+    async def post_heartbeat(request):
+        run = _run_or_404(request)
+        reg.ping_heartbeat(run.id)
+        return web.json_response({"ok": True})
+
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/processes")
+    async def get_processes(request):
+        run = _run_or_404(request)
+        return web.json_response({"results": reg.get_processes(run.id)})
+
+    # -- live streaming (WS) --------------------------------------------------
+    async def _ws_tail(request, fetch, poll: float = 0.5):
+        """Generic WS tail loop: push new rows until the run is done."""
+        run = _run_or_404(request)
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
+        cursor = 0
+        try:
+            while not ws.closed:
+                rows = fetch(run.id, cursor)
+                for row in rows:
+                    cursor = max(cursor, row.get("id", cursor))
+                    await ws.send_json(row)
+                current = reg.get_run(run.id)
+                if current.is_done and not rows:
+                    await ws.send_json({"event": "done", "status": current.status})
+                    break
+                try:
+                    msg = await asyncio.wait_for(ws.receive(), timeout=poll)
+                    if msg.type in (WSMsgType.CLOSE, WSMsgType.CLOSING, WSMsgType.ERROR):
+                        break
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            await ws.close()
+        return ws
+
+    @routes.get("/ws/v1/runs/{run_id}/logs")
+    async def ws_logs(request):
+        return await _ws_tail(
+            request, lambda rid, cur: reg.get_logs(rid, since_id=cur)
+        )
+
+    @routes.get("/ws/v1/runs/{run_id}/metrics")
+    async def ws_metrics(request):
+        return await _ws_tail(
+            request, lambda rid, cur: reg.get_metrics(rid, since_id=cur)
+        )
+
+    app = web.Application()
+    app.add_routes(routes)
+    app["orchestrator"] = orch
+    return app
+
+
+def serve(
+    base_dir: str,
+    host: str = "127.0.0.1",
+    port: int = 8000,
+    orch: Optional[Orchestrator] = None,
+) -> None:
+    """Run the service: orchestrator loop in a thread + aiohttp in the main loop."""
+    from aiohttp import web
+
+    orch = orch or Orchestrator(base_dir)
+    orch.start()
+    app = create_app(orch)
+    try:
+        web.run_app(app, host=host, port=port, print=logger.info)
+    finally:
+        orch.stop()

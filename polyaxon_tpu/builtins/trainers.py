@@ -43,6 +43,138 @@ def flaky_once(ctx: Context) -> None:
     ctx.log_metrics(recovered=1.0)
 
 
+def metric_probe(ctx: Context) -> None:
+    """Report a deterministic metric of the hyperparams (hpsearch probe).
+
+    score = -(lr - 0.7)^2  (max at lr=0.7); loss = (lr - 0.3)^2 (min at 0.3).
+    Sweeps over this trainer exercise the full search loop in milliseconds.
+    """
+    lr = float(ctx.get_param("lr", 0.0))
+    ctx.log_metrics(
+        step=int(ctx.get_param("epochs", 1)),
+        score=-((lr - 0.7) ** 2),
+        loss=(lr - 0.3) ** 2,
+    )
+
+
+def lm_train(ctx: Context) -> None:
+    """Train the flagship transformer LM under the spec's strategy.
+
+    The quick-start "CIFAR-10 distributed" equivalent for this framework
+    (BASELINE.md north-star): one entrypoint that honors whatever mesh +
+    parallelism template the topology declares.  Data is a synthetic
+    next-token stream (deterministic from the seed) so the benchmark
+    isolates compute + collectives from IO.
+
+    Params: steps, batch, seq, lr, and any TransformerConfig field
+    (d_model, n_layers, n_heads, head_dim, d_ff, vocab_size, n_experts).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from polyaxon_tpu.models import (
+        TransformerConfig,
+        init_params,
+        loss_fn,
+        param_axes,
+    )
+    from polyaxon_tpu.parallel import template_for
+    from polyaxon_tpu.runtime.train import build_train_step
+
+    steps = int(ctx.get_param("steps", 10))
+    batch_size = int(ctx.get_param("batch", 8))
+    seq = int(ctx.get_param("seq", 128))
+    lr = float(ctx.get_param("lr", 3e-4))
+    cfg_fields = {
+        f: type(getattr(TransformerConfig, f))(ctx.get_param(f))
+        for f in (
+            "vocab_size", "d_model", "n_layers", "n_heads",
+            "head_dim", "d_ff", "n_experts",
+        )
+        if ctx.get_param(f) is not None
+    }
+    cfg = TransformerConfig(max_seq=seq, **cfg_fields)
+
+    mesh = ctx.mesh
+    if mesh is None:
+        from polyaxon_tpu.runtime.mesh import build_mesh
+
+        mesh = build_mesh({"data": jax.device_count()})
+    template = template_for(ctx.strategy, dict(mesh.shape), ctx.strategy_options)
+
+    ts = build_train_step(
+        loss_fn=lambda p, b: loss_fn(p, b, cfg, template=template, mesh=mesh),
+        init_fn=lambda k: init_params(k, cfg),
+        axes_tree=param_axes(cfg),
+        optimizer=optax.adamw(lr),
+        mesh=mesh,
+        template=template,
+    )
+    key = jax.random.PRNGKey(ctx.seed or 0)
+    params, opt_state = ts.init(key)
+
+    # Checkpoint/resume: restore whatever the checkpoints/ dir holds (a
+    # resumed clone inherits the original's checkpoints), save every
+    # `save_every` steps.
+    save_every = int(ctx.get_param("save_every", 0))
+    start_step = 0
+    ckpt = None
+    if save_every > 0 and ctx.checkpoints_path is not None:
+        from polyaxon_tpu.runtime.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(ctx.checkpoints_path, save_interval_steps=save_every)
+        restored = ckpt.restore(params, opt_state)
+        if restored is not None:
+            params, opt_state = restored["params"], restored["opt_state"]
+            start_step = restored["step"] + 1
+            ctx.log_text(f"restored checkpoint at step {restored['step']}")
+
+    rng = np.random.default_rng(ctx.seed or 0)
+    tokens = rng.integers(0, cfg.vocab_size, (batch_size, seq + 1))
+    batch = ts.place_batch(
+        {
+            "tokens": jnp.asarray(tokens[:, :-1]),
+            "targets": jnp.asarray(tokens[:, 1:]),
+        }
+    )
+
+    t0 = time.time()
+    loss = None
+    metrics = None
+    for i in range(start_step, steps):
+        params, opt_state, metrics = ts.step(params, opt_state, batch, key)
+        # Only sync to host on logging steps — a float() every step would
+        # serialize dispatch and understate throughput.
+        if ctx.is_leader and (i % 10 == 0 or i == steps - 1):
+            ctx.log_metrics(
+                step=i,
+                loss=float(metrics["loss"]),
+                grad_norm=float(metrics["grad_norm"]),
+            )
+        if ckpt is not None:
+            ckpt.save(i, params, opt_state)
+    loss = float(metrics["loss"]) if metrics is not None else None
+    if ckpt is not None:
+        ckpt.wait_until_finished()
+        ckpt.close()
+    jax.block_until_ready(params)
+    dt = time.time() - t0
+    steps_run = steps - start_step
+    if steps_run <= 0:
+        if ctx.is_leader:
+            ctx.log_text("lm_train: nothing to do (checkpoint already at end)")
+        return
+    if ctx.is_leader:
+        tps = steps_run * batch_size * seq / dt
+        ctx.log_metrics(step=steps, tokens_per_s=tps)
+        ctx.log_text(
+            f"lm_train done: {steps} steps, strategy={template.name}, "
+            f"final loss {loss:.4f}, {tps:.0f} tokens/s"
+        )
+
+
 def synthetic_regression(ctx: Context) -> None:
     """A real (tiny) distributed training loop: pjit linear regression.
 

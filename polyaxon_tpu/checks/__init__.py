@@ -1,0 +1,3 @@
+from polyaxon_tpu.checks.health import run_health_checks
+
+__all__ = ["run_health_checks"]

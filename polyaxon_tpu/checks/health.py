@@ -1,0 +1,80 @@
+"""Health-check framework.
+
+Parity: reference ``checks/`` (postgres/redis/rabbitmq/disk/memory probes +
+per-service worker round-trips, ``checks/worker.py:14-40``) surfaced at
+``/status`` (``api/index/status.py``).  TPU-native: the moving parts are
+the sqlite registry, the task bus, the store filesystem, and the
+accelerator backend — each gets a probe; the report is the ``/status``
+payload.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Any, Callable, Dict, Tuple
+
+
+def check_registry(orch) -> Tuple[bool, str]:
+    try:
+        orch.registry.count_by_status()
+        return True, "ok"
+    except Exception as e:  # pragma: no cover - exercised via fault tests
+        return False, f"registry error: {e}"
+
+
+def check_bus(orch) -> Tuple[bool, str]:
+    bus = orch.bus
+    n_errors = len(getattr(bus, "errors", ()))
+    detail = f"{bus.pending()} pending, {n_errors} dead-lettered tasks"
+    # Dead-lettered tasks are diagnostic, not fatal — the bus itself is
+    # healthy as long as it can report.
+    return True, detail
+
+
+def check_stores(orch) -> Tuple[bool, str]:
+    base = orch.layout.base_dir
+    if not os.access(base, os.W_OK):
+        return False, f"store base dir {base} not writable"
+    usage = shutil.disk_usage(base)
+    free_frac = usage.free / usage.total
+    if free_frac < 0.05:
+        return False, f"disk nearly full ({free_frac:.1%} free)"
+    return True, f"{free_frac:.0%} free"
+
+
+def check_devices(orch) -> Tuple[bool, str]:
+    """Accelerator visibility — only meaningful in-process on a worker/bench
+    host; the control plane itself may legitimately be CPU-only."""
+    try:
+        import jax
+
+        n = jax.local_device_count()
+        kind = jax.devices()[0].device_kind
+        return True, f"{n}x {kind}"
+    except Exception as e:
+        return False, f"no accelerator backend: {e}"
+
+
+CHECKS: Dict[str, Callable] = {
+    "registry": check_registry,
+    "bus": check_bus,
+    "stores": check_stores,
+}
+
+
+def run_health_checks(orch, include_devices: bool = False) -> Dict[str, Any]:
+    checks = dict(CHECKS)
+    if include_devices:
+        checks["devices"] = check_devices
+    results = {}
+    healthy = True
+    for name, fn in checks.items():
+        try:
+            ok, detail = fn(orch)
+        except Exception as e:  # a probe crashing is itself a failure
+            ok, detail = False, f"probe crashed: {e}"
+        results[name] = {"ok": ok, "detail": detail}
+        healthy = healthy and ok
+    return {"healthy": healthy, "checks": results, "at": time.time()}

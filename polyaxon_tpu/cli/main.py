@@ -1,0 +1,257 @@
+"""The ``polyaxon-tpu`` CLI.
+
+Parity: the reference's external ``polyaxon-cli`` (run/init/logs/stop over
+REST+WS, SURVEY §1 layer 1).  Two modes:
+
+- **local** (default): embed the orchestrator over ``--base-dir`` and drive
+  it in-process — no server needed, the dev/test workflow.
+- **remote** (``--host``): talk to a running ``polyaxon-tpu serve`` API.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+DEFAULT_BASE = os.environ.get("POLYAXON_TPU_HOME", "~/.polyaxon_tpu")
+
+
+class RemoteClient:
+    """Thin urllib client for the REST API (no extra deps in the CLI path)."""
+
+    def __init__(self, host: str) -> None:
+        self.base = host.rstrip("/")
+        if not self.base.startswith("http"):
+            self.base = f"http://{self.base}"
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None) -> Any:
+        req = urllib.request.Request(
+            f"{self.base}{path}",
+            method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read() or "{}")
+
+    def submit(self, spec, project, name, tags):
+        return self._request(
+            "POST",
+            "/api/v1/runs",
+            {"spec": spec, "project": project, "name": name, "tags": tags},
+        )
+
+    def list(self, **query):
+        qs = "&".join(f"{k}={v}" for k, v in query.items() if v is not None)
+        return self._request("GET", f"/api/v1/runs?{qs}")["results"]
+
+    def get(self, run_id):
+        return self._request("GET", f"/api/v1/runs/{run_id}")
+
+    def stop(self, run_id):
+        return self._request("POST", f"/api/v1/runs/{run_id}/stop")
+
+    def clone(self, run_id, strategy):
+        return self._request("POST", f"/api/v1/runs/{run_id}/{strategy}")
+
+    def logs(self, run_id, since_id=0):
+        return self._request(
+            "GET", f"/api/v1/runs/{run_id}/logs?since_id={since_id}"
+        )["results"]
+
+    def statuses(self, run_id):
+        return self._request("GET", f"/api/v1/runs/{run_id}/statuses")["results"]
+
+
+class LocalClient:
+    """Embedded-orchestrator backend (creates it lazily, pumps eagerly)."""
+
+    def __init__(self, base_dir: str) -> None:
+        from polyaxon_tpu.api.app import run_to_dict
+        from polyaxon_tpu.orchestrator import Orchestrator
+
+        self._to_dict = run_to_dict
+        self.orch = Orchestrator(Path(base_dir).expanduser())
+
+    def submit(self, spec, project, name, tags):
+        run = self.orch.submit(spec, project=project, name=name, tags=tags)
+        return self._to_dict(run)
+
+    def list(self, **query):
+        runs = self.orch.registry.list_runs(
+            project=query.get("project"),
+            kind=query.get("kind"),
+            limit=int(query.get("limit") or 100),
+        )
+        return [self._to_dict(r) for r in runs]
+
+    def get(self, run_id):
+        self.orch.pump()
+        return self._to_dict(self.orch.get_run(int(run_id)))
+
+    def stop(self, run_id):
+        self.orch.stop_run(int(run_id))
+        self.orch.pump(max_wait=1.0)
+        return {"ok": True}
+
+    def clone(self, run_id, strategy):
+        return self._to_dict(self.orch.clone_run(int(run_id), strategy=strategy))
+
+    def logs(self, run_id, since_id=0):
+        self.orch.pump()
+        return self.orch.registry.get_logs(int(run_id), since_id=since_id)
+
+    def statuses(self, run_id):
+        self.orch.pump()
+        return self.orch.registry.get_statuses(int(run_id))
+
+    def pump(self, max_wait: float) -> None:
+        self.orch.pump(max_wait=max_wait)
+
+    def close(self) -> None:
+        self.orch.stop()
+
+
+def _client(args):
+    if args.host:
+        return RemoteClient(args.host)
+    return LocalClient(args.base_dir)
+
+
+def _watch(client, run_id: int, poll: float = 0.5) -> str:
+    seen_status = None
+    log_cursor = 0
+    while True:
+        if isinstance(client, LocalClient):
+            client.pump(max_wait=poll)
+        run = client.get(run_id)
+        if run["status"] != seen_status:
+            seen_status = run["status"]
+            print(f"[status] {seen_status}", file=sys.stderr)
+        for row in client.logs(run_id, since_id=log_cursor):
+            log_cursor = max(log_cursor, row["id"])
+            prefix = f"p{row['process_id']}| " if row.get("process_id") is not None else ""
+            print(f"{prefix}{row['line']}")
+        if run["is_done"]:
+            return run["status"]
+        if not isinstance(client, LocalClient):
+            time.sleep(poll)
+
+
+def _print_runs(runs) -> None:
+    fmt = "{:>5}  {:12}  {:10}  {:12}  {:}"
+    print(fmt.format("ID", "KIND", "PROJECT", "STATUS", "NAME"))
+    for r in runs:
+        print(
+            fmt.format(
+                r["id"], r["kind"], r["project"][:10], r["status"], r["name"] or ""
+            )
+        )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="polyaxon-tpu", description="TPU-native experiment platform CLI"
+    )
+    parser.add_argument("--host", help="API server address (remote mode)")
+    parser.add_argument(
+        "--base-dir", default=DEFAULT_BASE, help="platform state dir (local mode)"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_run = sub.add_parser("run", help="submit a polyaxonfile")
+    p_run.add_argument("-f", "--file", required=True, help="spec file (yaml/json)")
+    p_run.add_argument("--project", default="default")
+    p_run.add_argument("--name")
+    p_run.add_argument("--tags", nargs="*")
+    p_run.add_argument(
+        "-w", "--watch", action="store_true", help="stream statuses/logs until done"
+    )
+
+    p_ps = sub.add_parser("ps", help="list runs")
+    p_ps.add_argument("--project")
+    p_ps.add_argument("--kind")
+    p_ps.add_argument("--limit", type=int, default=50)
+
+    p_get = sub.add_parser("get", help="show one run as json")
+    p_get.add_argument("run_id")
+
+    p_logs = sub.add_parser("logs", help="print run logs")
+    p_logs.add_argument("run_id")
+    p_logs.add_argument("-f", "--follow", action="store_true")
+
+    p_stop = sub.add_parser("stop", help="stop a run")
+    p_stop.add_argument("run_id")
+
+    for strategy in ("restart", "resume", "copy"):
+        p = sub.add_parser(strategy, help=f"{strategy} a run as a clone")
+        p.add_argument("run_id")
+
+    p_statuses = sub.add_parser("statuses", help="status history")
+    p_statuses.add_argument("run_id")
+
+    p_serve = sub.add_parser("serve", help="run the API service")
+    p_serve.add_argument("--port", type=int, default=8000)
+    p_serve.add_argument("--bind", default="127.0.0.1")
+
+    args = parser.parse_args(argv)
+
+    if args.command == "serve":
+        from polyaxon_tpu.api.app import serve
+
+        serve(str(Path(args.base_dir).expanduser()), host=args.bind, port=args.port)
+        return 0
+
+    client = _client(args)
+    try:
+        if args.command == "run":
+            spec_text = Path(args.file).read_text()
+            run = client.submit(spec_text, args.project, args.name, args.tags)
+            print(f"Created run {run['id']} ({run['kind']})", file=sys.stderr)
+            if args.watch:
+                status = _watch(client, run["id"])
+                return 0 if status == "succeeded" else 1
+            print(json.dumps(run, indent=2, default=str))
+            return 0
+        if args.command == "ps":
+            _print_runs(
+                client.list(project=args.project, kind=args.kind, limit=args.limit)
+            )
+            return 0
+        if args.command == "get":
+            print(json.dumps(client.get(args.run_id), indent=2, default=str))
+            return 0
+        if args.command == "logs":
+            if args.follow:
+                _watch(client, int(args.run_id))
+            else:
+                for row in client.logs(args.run_id):
+                    print(row["line"])
+            return 0
+        if args.command == "stop":
+            client.stop(args.run_id)
+            print("stopped", file=sys.stderr)
+            return 0
+        if args.command in ("restart", "resume", "copy"):
+            clone = client.clone(args.run_id, args.command)
+            print(json.dumps(clone, indent=2, default=str))
+            return 0
+        if args.command == "statuses":
+            for s in client.statuses(args.run_id):
+                msg = f"  {s['message']}" if s.get("message") else ""
+                print(f"{s['created_at']:.1f}  {s['status']}{msg}")
+            return 0
+    finally:
+        if isinstance(client, LocalClient):
+            client.close()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

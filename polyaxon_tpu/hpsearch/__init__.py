@@ -1,0 +1,18 @@
+from polyaxon_tpu.hpsearch.search_managers import (
+    BOSearchManager,
+    GridSearchManager,
+    HyperbandSearchManager,
+    RandomSearchManager,
+    get_search_manager,
+)
+from polyaxon_tpu.hpsearch.tasks import HPContext, register_hp_tasks
+
+__all__ = [
+    "BOSearchManager",
+    "GridSearchManager",
+    "HPContext",
+    "HyperbandSearchManager",
+    "RandomSearchManager",
+    "get_search_manager",
+    "register_hp_tasks",
+]

@@ -1,0 +1,387 @@
+"""Hyperparameter-search suggestion engines (pure math, no orchestration).
+
+Capability parity with the reference's search managers:
+``hpsearch/search_managers/grid.py:7-31`` (cartesian product),
+``random.py:6-21`` (seeded sampling), ``hyperband.py:9-147`` (bracket
+math), ``bayesian_optimization/`` (featurized space + GP + UCB/EI/POI
+acquisition).  Everything is deterministic under a seed; numpy Generators
+only (no global RNG state).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from polyaxon_tpu.exceptions import PolyaxonTPUError
+from polyaxon_tpu.schemas.hptuning import HPTuningConfig, Optimization, SearchAlgorithms
+from polyaxon_tpu.schemas.matrix import MatrixConfig
+
+Suggestion = Dict[str, Any]
+
+
+class SearchError(PolyaxonTPUError):
+    pass
+
+
+def _native(value: Any) -> Any:
+    """numpy scalar -> json-friendly python scalar."""
+    if isinstance(value, np.generic):
+        return value.item()
+    return value
+
+
+def _sample_matrix(
+    matrix: Dict[str, MatrixConfig], rng: np.random.Generator
+) -> Suggestion:
+    return {name: _native(m.sample(rng)) for name, m in matrix.items()}
+
+
+class GridSearchManager:
+    """Cartesian product over enumerable matrix params."""
+
+    def __init__(self, hptuning: HPTuningConfig) -> None:
+        self.hptuning = hptuning
+
+    def get_suggestions(self, iteration_data: Optional[dict] = None) -> List[Suggestion]:
+        names, spaces = [], []
+        for name, m in self.hptuning.matrix.items():
+            if m.is_distribution:
+                raise SearchError(
+                    f"Grid search needs enumerable params; {name!r} ({m.op}) is a "
+                    "continuous distribution"
+                )
+            names.append(name)
+            spaces.append([_native(v) for v in m.to_numpy()])
+        combos = itertools.product(*spaces)
+        limit = (
+            self.hptuning.grid_search.n_experiments
+            if self.hptuning.grid_search and self.hptuning.grid_search.n_experiments
+            else None
+        )
+        suggestions = [dict(zip(names, c)) for c in itertools.islice(combos, limit)]
+        return suggestions
+
+
+class RandomSearchManager:
+    """N seeded samples from the matrix."""
+
+    def __init__(self, hptuning: HPTuningConfig) -> None:
+        self.hptuning = hptuning
+
+    def get_suggestions(self, iteration_data: Optional[dict] = None) -> List[Suggestion]:
+        cfg = self.hptuning.random_search
+        seed = cfg.seed if cfg.seed is not None else self.hptuning.seed
+        rng = np.random.default_rng(seed)
+        return [
+            _sample_matrix(self.hptuning.matrix, rng) for _ in range(cfg.n_experiments)
+        ]
+
+
+class HyperbandSearchManager:
+    """Successive-halving brackets (Li et al. 2016).
+
+    Parity targets: ``hpsearch/search_managers/hyperband.py:9-147`` —
+    ``s_max``/``B``, ``get_n_configs``, ``get_resources_for_iteration``,
+    ``get_n_config_to_keep``, ``should_reschedule``/``should_reduce_configs``.
+    """
+
+    def __init__(self, hptuning: HPTuningConfig) -> None:
+        self.hptuning = hptuning
+        self.config = hptuning.hyperband
+        self.max_iterations = self.config.max_iterations
+        self.eta = self.config.eta
+        #: number of brackets - 1
+        self.s_max = int(math.log(self.max_iterations) / math.log(self.eta))
+        #: total budget (per bracket): (s_max + 1) * max_iterations
+        self.B = (self.s_max + 1) * self.max_iterations
+
+    # -- bracket math ---------------------------------------------------------
+    def get_bracket(self, iteration: int) -> int:
+        """Bracket s for the 0-based outer iteration (s counts DOWN)."""
+        return self.s_max - iteration
+
+    def get_n_configs(self, bracket: int) -> int:
+        return int(
+            math.ceil((self.B / self.max_iterations) * (self.eta**bracket) / (bracket + 1))
+        )
+
+    def get_resources(self, bracket: int) -> float:
+        return self.max_iterations * (self.eta**-bracket)
+
+    def get_resources_for_iteration(self, iteration: int) -> float:
+        return self.get_resources(self.get_bracket(iteration))
+
+    def get_n_config_to_keep(self, n_suggestions: int, bracket_iteration: int) -> int:
+        """How many configs survive step ``bracket_iteration`` of a bracket."""
+        n_configs = n_suggestions * (self.eta**-bracket_iteration)
+        return int(n_configs / self.eta)
+
+    def get_n_config_to_keep_for_iteration(
+        self, iteration: int, bracket_iteration: int
+    ) -> int:
+        bracket = self.get_bracket(iteration)
+        return self.get_n_config_to_keep(self.get_n_configs(bracket), bracket_iteration)
+
+    def should_reschedule(self, iteration: int, bracket_iteration: int) -> bool:
+        """Start a new bracket after the current one is exhausted?"""
+        if self.should_reduce_configs(iteration, bracket_iteration):
+            return False
+        return iteration + 1 <= self.s_max
+
+    def should_reduce_configs(self, iteration: int, bracket_iteration: int) -> bool:
+        """Continue inside the bracket with the top-k configs?"""
+        bracket = self.get_bracket(iteration)
+        return bracket_iteration + 1 <= bracket
+
+    # -- suggestions ----------------------------------------------------------
+    def get_suggestions(self, iteration_data: Optional[dict] = None) -> List[Suggestion]:
+        """Fresh random configs for a bracket's first step, with the resource
+        param injected (``hyperband.py:115-131``)."""
+        iteration = (iteration_data or {}).get("iteration", 0)
+        bracket = self.get_bracket(iteration)
+        n_configs = self.get_n_configs(bracket)
+        resource = self.get_resources(bracket)
+        seed = self.config.seed if self.config.seed is not None else self.hptuning.seed
+        rng = np.random.default_rng(None if seed is None else seed + iteration)
+        suggestions = []
+        for _ in range(n_configs):
+            s = _sample_matrix(self.hptuning.matrix, rng)
+            s[self.config.resource.name] = self._format_resource(resource)
+            suggestions.append(s)
+        return suggestions
+
+    def reduce_configs(
+        self,
+        iteration: int,
+        bracket_iteration: int,
+        configs: Sequence[Suggestion],
+        metrics: Sequence[Optional[float]],
+    ) -> List[Suggestion]:
+        """Top-k configs for the next bracket step, resource re-injected.
+
+        The wave passed in is already ``n_orig * eta^-bracket_iteration``
+        strong, so the survivors of this step are ``len(configs) / eta`` —
+        deriving from the actual wave keeps halving correct even when
+        failed trials were dropped.
+        """
+        k = int(len(configs) / self.eta)
+        reverse = self.config.metric.optimization == Optimization.MAXIMIZE
+        scored = [
+            (m, c) for m, c in zip(metrics, configs) if m is not None
+        ]
+        scored.sort(key=lambda mc: mc[0], reverse=reverse)
+        survivors = [dict(c) for _, c in scored[:k]]
+        resource = self.get_resources(self.get_bracket(iteration)) * (
+            self.eta ** (bracket_iteration + 1)
+        )
+        resource = min(resource, self.max_iterations)
+        for s in survivors:
+            s[self.config.resource.name] = self._format_resource(resource)
+        return survivors
+
+    def _format_resource(self, resource: float) -> Any:
+        # Integer resources stay ints (epochs/steps); eta may be fractional.
+        r = round(resource, 6)
+        return int(r) if float(r).is_integer() else r
+
+
+class SearchSpace:
+    """Featurizer: suggestion dict <-> continuous optimization vector.
+
+    Parity: ``hpsearch/search_managers/bayesian_optimization/space.py:9-60``
+    — continuous dims pass through with bounds, discrete dims become index
+    dims, categorical dims one-hot.
+    """
+
+    def __init__(self, matrix: Dict[str, MatrixConfig]) -> None:
+        self.matrix = dict(matrix)
+        self.names: List[str] = []
+        self.bounds: List[Tuple[float, float]] = []
+        #: per-feature decoder: (kind, param name, payload)
+        self._features: List[Tuple[str, str, Any]] = []
+        for name, m in matrix.items():
+            self.names.append(name)
+            if m.is_categorical:
+                values = [_native(v) for v in m.to_numpy()]
+                for v in values:
+                    self.bounds.append((0.0, 1.0))
+                    self._features.append(("onehot", name, values))
+            elif m.is_discrete:
+                values = sorted(_native(v) for v in m.to_numpy())
+                self.bounds.append((0.0, len(values) - 1e-9))
+                self._features.append(("index", name, values))
+            else:
+                self.bounds.append((float(m.min), float(m.max)))
+                self._features.append(("continuous", name, None))
+
+    @property
+    def dim(self) -> int:
+        return len(self.bounds)
+
+    def to_vector(self, suggestion: Suggestion) -> np.ndarray:
+        vec = np.zeros(self.dim)
+        i = 0
+        while i < self.dim:
+            kind, name, payload = self._features[i]
+            if kind == "onehot":
+                values = payload
+                idx = values.index(suggestion[name])
+                vec[i : i + len(values)] = 0.0
+                vec[i + idx] = 1.0
+                i += len(values)
+            elif kind == "index":
+                values = payload
+                vec[i] = values.index(suggestion[name])
+                i += 1
+            else:
+                vec[i] = float(suggestion[name])
+                i += 1
+        return vec
+
+    def to_suggestion(self, vec: np.ndarray) -> Suggestion:
+        out: Suggestion = {}
+        i = 0
+        while i < self.dim:
+            kind, name, payload = self._features[i]
+            if kind == "onehot":
+                values = payload
+                block = vec[i : i + len(values)]
+                out[name] = values[int(np.argmax(block))]
+                i += len(values)
+            elif kind == "index":
+                values = payload
+                idx = int(np.clip(round(float(vec[i])), 0, len(values) - 1))
+                out[name] = values[idx]
+                i += 1
+            else:
+                lo, hi = self.bounds[i]
+                out[name] = float(np.clip(vec[i], lo, hi))
+                i += 1
+        return out
+
+    def sample_vectors(self, n: int, rng: np.random.Generator) -> np.ndarray:
+        lo = np.array([b[0] for b in self.bounds])
+        hi = np.array([b[1] for b in self.bounds])
+        return rng.uniform(lo, hi, size=(n, self.dim))
+
+
+class UtilityFunction:
+    """UCB / EI / POI acquisition over a GP posterior.
+
+    Parity: ``bayesian_optimization/acquisition_function.py:1-115``.
+    """
+
+    def __init__(self, config) -> None:
+        self.config = config
+
+    def _gp(self):
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import RBF, Matern
+
+        g = self.config.gaussian_process
+        if g.kernel == "rbf":
+            kernel = RBF(length_scale=g.length_scale)
+        else:
+            kernel = Matern(length_scale=g.length_scale, nu=g.nu)
+        return GaussianProcessRegressor(
+            kernel=kernel,
+            # n_restarts_optimizer=0 means "honor the configured length
+            # scale" — with few observations the marginal-likelihood fit
+            # collapses to degenerate length scales and a spiky posterior.
+            optimizer=None if g.n_restarts_optimizer == 0 else "fmin_l_bfgs_b",
+            n_restarts_optimizer=g.n_restarts_optimizer,
+            normalize_y=True,
+            random_state=0,
+        )
+
+    def acquisition(self, gp, x: np.ndarray, y_max: float) -> np.ndarray:
+        from scipy import stats
+
+        mean, std = gp.predict(x, return_std=True)
+        std = np.maximum(std, 1e-9)
+        kind = self.config.acquisition_function
+        if kind == "ucb":
+            return mean + self.config.kappa * std
+        z = (mean - y_max - self.config.eps) / std
+        if kind == "ei":
+            return (mean - y_max - self.config.eps) * stats.norm.cdf(
+                z
+            ) + std * stats.norm.pdf(z)
+        return stats.norm.cdf(z)  # poi
+
+    def max_acquisition(
+        self, gp, space: SearchSpace, y_max: float, rng: np.random.Generator
+    ) -> np.ndarray:
+        from scipy.optimize import minimize
+
+        warmup = space.sample_vectors(self.config.n_warmup, rng)
+        scores = self.acquisition(gp, warmup, y_max)
+        best = warmup[int(np.argmax(scores))]
+        best_score = float(np.max(scores))
+        # Polish the best random candidates with L-BFGS-B.
+        for x0 in space.sample_vectors(self.config.n_iter, rng):
+            res = minimize(
+                lambda x: -self.acquisition(gp, x.reshape(1, -1), y_max)[0],
+                x0,
+                bounds=space.bounds,
+                method="L-BFGS-B",
+            )
+            if res.success and -res.fun > best_score:
+                best, best_score = res.x, -res.fun
+        return best
+
+
+class BOSearchManager:
+    """Seed round of random trials, then GP-posterior acquisition.
+
+    Parity: ``bayesian_optimization/manager.py:7-41``.
+    """
+
+    def __init__(self, hptuning: HPTuningConfig) -> None:
+        self.hptuning = hptuning
+        self.config = hptuning.bo
+        self.space = SearchSpace(hptuning.matrix)
+        self.utility = UtilityFunction(self.config.utility_function)
+
+    def _rng(self, salt: int = 0) -> np.random.Generator:
+        seed = self.config.seed if self.config.seed is not None else self.hptuning.seed
+        return np.random.default_rng(None if seed is None else seed + salt)
+
+    def get_suggestions(self, iteration_data: Optional[dict] = None) -> List[Suggestion]:
+        data = iteration_data or {}
+        configs = data.get("configs") or []
+        metrics = data.get("metrics") or []
+        if not configs:
+            rng = self._rng()
+            return [
+                _sample_matrix(self.hptuning.matrix, rng)
+                for _ in range(self.config.n_initial_trials)
+            ]
+        observed = [
+            (c, m) for c, m in zip(configs, metrics) if m is not None
+        ]
+        if not observed:
+            return [_sample_matrix(self.hptuning.matrix, self._rng(1))]
+        x = np.stack([self.space.to_vector(c) for c, _ in observed])
+        y = np.array([m for _, m in observed], dtype=float)
+        if self.config.metric.optimization == Optimization.MINIMIZE:
+            y = -y
+        gp = self.utility._gp()
+        gp.fit(x, y)
+        rng = self._rng(len(observed))
+        vec = self.utility.max_acquisition(gp, self.space, float(np.max(y)), rng)
+        return [self.space.to_suggestion(vec)]
+
+
+def get_search_manager(hptuning: HPTuningConfig):
+    algo = hptuning.search_algorithm
+    return {
+        SearchAlgorithms.GRID: GridSearchManager,
+        SearchAlgorithms.RANDOM: RandomSearchManager,
+        SearchAlgorithms.HYPERBAND: HyperbandSearchManager,
+        SearchAlgorithms.BO: BOSearchManager,
+    }[algo](hptuning)

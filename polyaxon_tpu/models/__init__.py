@@ -1,0 +1,15 @@
+from polyaxon_tpu.models.transformer import (
+    TransformerConfig,
+    forward,
+    init_params,
+    loss_fn,
+    param_axes,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "forward",
+    "init_params",
+    "loss_fn",
+    "param_axes",
+]

@@ -1,0 +1,349 @@
+"""Flagship model: a decoder-only transformer LM, TPU-first.
+
+The reference platform ships no models (training code lives in user
+containers — SURVEY §2.8); the TPU framework needs a first-class flagship
+so sharding templates, benchmarks, and the driver hooks have a real
+workload.  Design choices are all MXU/HBM-driven:
+
+- **bfloat16 compute, float32 params/accumulation** — MXU-native.
+- **einsum everywhere** — large, fusable contractions XLA tiles onto the
+  systolic array; no per-head Python loops.
+- **stacked layer parameters + ``lax.scan``** — one compiled block body
+  regardless of depth (fast compiles), and the leading ``layers`` axis IS
+  the pipeline-stage axis for pp sharding.
+- **logical axis names on every parameter** (``param_axes``) — the
+  parallelism templates (``polyaxon_tpu.parallel.templates``) map them onto
+  any mesh; the model never mentions a mesh axis.
+- optional **MoE MLP** (top-1 switch routing, einsum dispatch/combine) for
+  expert parallelism; optional **ring attention** for sequence parallelism.
+- ``jax.checkpoint`` on the block body (``remat=True``) to trade FLOPs for
+  HBM on long sequences.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from polyaxon_tpu.parallel.axes import AxisRules, with_logical_constraint
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    head_dim: int = 64
+    d_ff: int = 2048
+    max_seq: int = 1024
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    #: 0 = dense MLP; >0 = MoE with this many experts (top-1 switch routing)
+    n_experts: int = 0
+    #: per-expert capacity = capacity_factor * tokens / n_experts
+    capacity_factor: float = 1.25
+    remat: bool = False
+
+    def scaled(self, **overrides) -> "TransformerConfig":
+        return replace(self, **overrides)
+
+    @property
+    def n_params(self) -> int:
+        """Parameter count (for MFU math)."""
+        c = self
+        attn = c.d_model * c.n_heads * c.head_dim * 4
+        if c.n_experts:
+            mlp = c.d_model * c.n_experts + c.n_experts * c.d_model * c.d_ff * 3
+        else:
+            mlp = c.d_model * c.d_ff * 3
+        per_layer = attn + mlp + 2 * c.d_model
+        return c.vocab_size * c.d_model * 2 + c.n_layers * per_layer + c.d_model
+
+
+def param_axes(cfg: TransformerConfig) -> Dict[str, Any]:
+    """Logical axis names for every parameter (mirrors ``init_params``)."""
+    block: Dict[str, Any] = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "heads", "head_dim"),
+        "wv": ("layers", "embed", "heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "mlp_norm": ("layers", "embed"),
+    }
+    if cfg.n_experts:
+        block.update(
+            router=("layers", "embed", "experts"),
+            wi=("layers", "experts", "embed", "mlp"),
+            wg=("layers", "experts", "embed", "mlp"),
+            wd=("layers", "experts", "mlp", "embed"),
+        )
+    else:
+        block.update(
+            wi=("layers", "embed", "mlp"),
+            wg=("layers", "embed", "mlp"),
+            wd=("layers", "mlp", "embed"),
+        )
+    return {
+        "embed": ("vocab", "embed"),
+        "unembed": ("embed", "vocab"),
+        "final_norm": ("embed",),
+        "block": block,
+    }
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
+    c = cfg
+    k = iter(jax.random.split(key, 16))
+    dt = c.param_dtype
+
+    def norm(*shape, scale):
+        return jax.random.normal(next(k), shape, dt) * scale
+
+    L, D, H, hd, F = c.n_layers, c.d_model, c.n_heads, c.head_dim, c.d_ff
+    block: Dict[str, Any] = {
+        "attn_norm": jnp.ones((L, D), dt),
+        "wq": norm(L, D, H, hd, scale=D**-0.5),
+        "wk": norm(L, D, H, hd, scale=D**-0.5),
+        "wv": norm(L, D, H, hd, scale=D**-0.5),
+        "wo": norm(L, H, hd, D, scale=(H * hd) ** -0.5),
+        "mlp_norm": jnp.ones((L, D), dt),
+    }
+    if c.n_experts:
+        E = c.n_experts
+        block.update(
+            router=norm(L, D, E, scale=D**-0.5),
+            wi=norm(L, E, D, F, scale=D**-0.5),
+            wg=norm(L, E, D, F, scale=D**-0.5),
+            wd=norm(L, E, F, D, scale=F**-0.5),
+        )
+    else:
+        block.update(
+            wi=norm(L, D, F, scale=D**-0.5),
+            wg=norm(L, D, F, scale=D**-0.5),
+            wd=norm(L, F, D, scale=F**-0.5),
+        )
+    return {
+        "embed": norm(c.vocab_size, D, scale=1.0),
+        "unembed": norm(D, c.vocab_size, scale=D**-0.5),
+        "final_norm": jnp.ones((D,), dt),
+        "block": block,
+    }
+
+
+def _rmsnorm(x: jax.Array, w: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + 1e-6).astype(x.dtype)) * w.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over the last (head_dim) axis. x: [B,T,H,d]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d // 2, dtype=jnp.float32) / (d // 2))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,d/2]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def _dense_attention(q, k, v, q_pos, k_pos):
+    """Causal attention. q:[B,Tq,H,d] k,v:[B,Tk,H,d] → [B,Tq,H,d]."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = q_pos[:, None, :, None] >= k_pos[:, None, None, :]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _moe_mlp(x, layer, cfg: TransformerConfig, rules: AxisRules, mesh):
+    """Top-1 (switch) MoE with einsum dispatch/combine.
+
+    Token dispatch is expressed as dense einsums over a capacity-bounded
+    one-hot: with ``experts``→``expert`` sharding, XLA lowers the dispatch/
+    combine contractions into the expert all-to-alls — no manual comms.
+    """
+    B, T, D = x.shape
+    E = cfg.n_experts
+    tokens = B * T
+    capacity = max(1, int(cfg.capacity_factor * tokens / E))
+
+    logits = jnp.einsum("btd,de->bte", x, layer["router"].astype(x.dtype))
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [B,T,E]
+    flat_gates = gates.reshape(tokens, E)
+    expert_idx = jnp.argmax(flat_gates, axis=-1)  # [tokens]
+    gate_val = jnp.take_along_axis(flat_gates, expert_idx[:, None], axis=1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [tokens,E]
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # rank within expert
+    keep = (position < capacity) & (onehot > 0)
+    pos_onehot = jax.nn.one_hot(
+        jnp.where(keep.any(-1), position.max(-1), -1).astype(jnp.int32),
+        capacity,
+        dtype=jnp.float32,
+    )  # [tokens, C]
+    dispatch = (onehot * keep)[:, :, None] * pos_onehot[:, None, :]  # [tokens,E,C]
+    combine = dispatch * gate_val[:, None, None]
+
+    xf = x.reshape(tokens, D)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xf)
+    expert_in = with_logical_constraint(expert_in, ("experts",), rules, mesh)
+    h = jnp.einsum("ecd,edf->ecf", expert_in, layer["wi"].astype(x.dtype))
+    g = jnp.einsum("ecd,edf->ecf", expert_in, layer["wg"].astype(x.dtype))
+    h = jax.nn.silu(g) * h
+    out = jnp.einsum("ecf,efd->ecd", h, layer["wd"].astype(x.dtype))
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), out)
+    return y.reshape(B, T, D), gates, expert_idx.reshape(B, T)
+
+
+def moe_aux_loss(gates: jax.Array, expert_idx: jax.Array, n_experts: int) -> jax.Array:
+    """Switch-transformer load-balancing loss (mean over layers outside)."""
+    me = jnp.mean(gates.reshape(-1, n_experts), axis=0)
+    ce = jnp.mean(
+        jax.nn.one_hot(expert_idx.reshape(-1), n_experts, dtype=jnp.float32), axis=0
+    )
+    return n_experts * jnp.sum(me * ce)
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    template=None,
+    mesh=None,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """tokens [B,T] → logits [B,T,vocab] (float32).
+
+    ``template`` (a :class:`~polyaxon_tpu.parallel.StrategyTemplate`) plus
+    ``mesh`` activate logical sharding constraints and select the attention/
+    layer-evaluation path: ring attention when ``template.ring_axis`` is
+    set, the GPipe schedule when ``template.pipeline_axis`` is set, plain
+    scan otherwise.  With a sequence-sharded template, ``positions`` carries
+    each shard's global token positions.
+    """
+    c = cfg
+    rules: AxisRules = template.rules if template is not None else {}
+    ring_axis = template.ring_axis if template is not None else None
+    pipeline_axis = template.pipeline_axis if template is not None else None
+    B, T = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+
+    # Inside the pipeline shard_map all mesh axes are manual: sharding
+    # constraints must be inert there.
+    cmesh = None if pipeline_axis else mesh
+
+    x = params["embed"].astype(c.dtype)[tokens]  # [B,T,D]
+    x = with_logical_constraint(x, ("batch", "seq", None), rules, cmesh)
+
+    def block(x, pos, layer):
+        h = _rmsnorm(x, layer["attn_norm"])
+        q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(h.dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(h.dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(h.dtype))
+        q = _rope(q, pos, c.rope_theta)
+        k = _rope(k, pos, c.rope_theta)
+        # Ulysses switch-point: constraining attn_heads re-shards heads
+        # across the sequence axis (XLA inserts the all-to-all).
+        q = with_logical_constraint(q, ("batch", None, "attn_heads", None), rules, cmesh)
+        k = with_logical_constraint(k, ("batch", None, "attn_heads", None), rules, cmesh)
+        v = with_logical_constraint(v, ("batch", None, "attn_heads", None), rules, cmesh)
+        if ring_axis is not None:
+            from polyaxon_tpu.parallel.ring import ring_attention_sharded
+
+            attn = ring_attention_sharded(
+                q, k, v, mesh, ring_axis, batch_axes=rules.get("batch")
+            )
+        else:
+            attn = _dense_attention(q, k, v, pos, pos)
+        attn = with_logical_constraint(
+            attn, ("batch", "seq", "attn_heads", None), rules, cmesh
+        )
+        x = x + jnp.einsum("bthk,hkd->btd", attn, layer["wo"].astype(h.dtype))
+
+        h = _rmsnorm(x, layer["mlp_norm"])
+        if c.n_experts:
+            y, gates, idx = _moe_mlp(h, layer, c, rules, cmesh)
+            x = x + y
+            return x, (gates, idx)
+        up = jnp.einsum("btd,df->btf", h, layer["wi"].astype(h.dtype))
+        gate = jnp.einsum("btd,df->btf", h, layer["wg"].astype(h.dtype))
+        y = jax.nn.silu(gate) * up
+        y = with_logical_constraint(y, ("batch", "seq", "act_mlp"), rules, cmesh)
+        x = x + jnp.einsum("btf,fd->btd", y, layer["wd"].astype(h.dtype))
+        x = with_logical_constraint(x, ("batch", "seq", None), rules, cmesh)
+        return x, None
+
+    body = jax.checkpoint(block) if c.remat else block
+
+    aux = None
+    if pipeline_axis is not None:
+        if c.n_experts:
+            raise NotImplementedError("pp + MoE composition not supported yet")
+        from polyaxon_tpu.parallel.pipeline import pipeline_scan
+
+        x = pipeline_scan(
+            body,
+            x,
+            positions,
+            params["block"],
+            mesh,
+            axis=pipeline_axis,
+            num_microbatches=template.num_microbatches,
+            batch_axes=rules.get("batch"),
+        )
+    else:
+        x, aux = lax.scan(
+            lambda carry, layer: body(carry, positions, layer), x, params["block"]
+        )
+
+    x = _rmsnorm(x, params["final_norm"])
+    logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(x.dtype))
+    logits = with_logical_constraint(logits, ("batch", "seq", None), rules, cmesh)
+    if c.n_experts and aux is not None:
+        return logits.astype(jnp.float32), aux
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: TransformerConfig,
+    template=None,
+    mesh=None,
+    aux_weight: float = 0.01,
+) -> jax.Array:
+    """Next-token cross-entropy (+ MoE balance loss when configured)."""
+    out = forward(
+        params,
+        batch["tokens"],
+        cfg,
+        template=template,
+        mesh=mesh,
+        positions=batch.get("positions"),
+    )
+    if cfg.n_experts:
+        logits, (gates, idx) = out
+    else:
+        logits = out
+    targets = batch["targets"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is None:
+        loss = jnp.mean(nll)
+    else:
+        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    if cfg.n_experts:
+        loss = loss + aux_weight * jnp.mean(
+            jax.vmap(partial(moe_aux_loss, n_experts=cfg.n_experts))(gates, idx)
+        )
+    return loss

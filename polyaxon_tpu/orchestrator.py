@@ -68,18 +68,19 @@ class Orchestrator:
             heartbeat_ttl=heartbeat_ttl,
         )
         register_scheduler_tasks(self.ctx)
+        from polyaxon_tpu.hpsearch import HPContext, register_hp_tasks
+
+        register_hp_tasks(
+            HPContext(registry=self.registry, bus=self.bus, auditor=self.auditor)
+        )
+        from polyaxon_tpu.polyflow import PipelineContext, register_pipeline_tasks
+
+        register_pipeline_tasks(
+            PipelineContext(
+                registry=self.registry, bus=self.bus, auditor=self.auditor
+            )
+        )
         self._heartbeat_check_interval = heartbeat_check_interval
-        self._register_placeholder_tasks()
-
-    def _register_placeholder_tasks(self) -> None:
-        """Tasks wired by the executor but implemented by later layers
-        (hpsearch, pipelines) register no-ops until those layers attach."""
-        from polyaxon_tpu.workers import HPTasks, PipelineTasks
-
-        for name in (HPTasks.CREATE, HPTasks.START, HPTasks.ITERATE,
-                     PipelineTasks.START, PipelineTasks.CHECK, PipelineTasks.STOP):
-            if not self.bus.has_task(name):
-                self.bus.register(name, lambda **kw: None)
 
     # -- lifecycle ------------------------------------------------------------
     def start(self) -> None:
@@ -137,6 +138,47 @@ class Orchestrator:
 
     def get_run(self, run_id: Union[int, str]) -> Run:
         return self.registry.get_run(run_id)
+
+    def clone_run(self, run_id: int, strategy: str = "restart") -> Run:
+        """Restart / resume / copy a run as a new run.
+
+        Parity: reference restart/resume/copy views
+        (``api/experiments/views.py:329-366``) + ``copy_experiment``
+        (``scheduler/tasks/experiments.py:27-56``). ``resume`` and ``copy``
+        both duplicate outputs+checkpoints into the clone's directories
+        (the clone continues from the last checkpoint); the reference's
+        shared-outputs RESUME is deliberately not reproduced — isolated
+        dirs stay correct when the original is re-run concurrently.
+        """
+        if strategy not in ("restart", "resume", "copy"):
+            raise PolyaxonTPUError(f"Unknown cloning strategy {strategy!r}")
+        orig = self.registry.get_run(run_id)
+        if orig.kind not in (Kinds.EXPERIMENT, Kinds.JOB, Kinds.BUILD):
+            raise PolyaxonTPUError(
+                f"Only experiment/job runs can be cloned, not {orig.kind!r} "
+                "(restart a sweep or pipeline by submitting its spec again)"
+            )
+        # Deliberately NOT propagating group_id: a clone is user-initiated
+        # and must not enter the sweep's wave accounting/concurrency window.
+        run = self.registry.create_run(
+            orig.spec,
+            project=orig.project,
+            name=f"{orig.name or orig.id}-{strategy}",
+            original_id=orig.id,
+            cloning_strategy=strategy,
+            tags=orig.tags,
+        )
+        if orig.code_ref:
+            self.registry.update_run(run.id, code_ref=orig.code_ref)
+        if strategy in ("resume", "copy"):
+            self.layout.copy_outputs(orig.uuid, run.uuid)
+        event = (
+            EventTypes.EXPERIMENT_RESUMED
+            if strategy == "resume"
+            else EventTypes.EXPERIMENT_CREATED
+        )
+        self.auditor.record(event, run_id=run.id)
+        return self.registry.get_run(run.id)
 
     # -- eager driving (tests; service mode doesn't need these) ----------------
     def pump(self, max_wait: float = 0.0) -> int:

@@ -1,0 +1,18 @@
+from polyaxon_tpu.parallel.axes import (
+    AxisRules,
+    logical_to_spec,
+    tree_specs,
+    tree_shardings,
+    with_logical_constraint,
+)
+from polyaxon_tpu.parallel.templates import StrategyTemplate, template_for
+
+__all__ = [
+    "AxisRules",
+    "StrategyTemplate",
+    "logical_to_spec",
+    "template_for",
+    "tree_specs",
+    "tree_shardings",
+    "with_logical_constraint",
+]

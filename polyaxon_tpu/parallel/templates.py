@@ -1,0 +1,171 @@
+"""Parallelism strategies as sharding templates.
+
+Each strategy the spec DSL names (``environment.topology.strategy``) is a
+:class:`StrategyTemplate`: a logical→mesh axis-rule set plus runtime
+switches (ring attention, pipeline schedule).  This is the capability the
+reference implemented as four env-var dialects (``polypod/tensorflow.py:
+193-203`` TF_CONFIG, ``pytorch.py:139-157`` MASTER_ADDR, ``mxnet.py:19-35``
+DMLC, ``horovod.py:143-166`` mpirun) — except those could only express data
+parallelism; here DP/FSDP/TP/PP/SP-ring/Ulysses/EP are first-class because
+a strategy is just an axis mapping consumed by pjit (SURVEY §2.8).
+
+Logical-axis vocabulary (shared with ``polyaxon_tpu.models``):
+
+==============  ============================================================
+``vocab``       embedding table rows / output head columns
+``embed``       the model (residual-stream) dimension of parameters
+``heads``       attention-head dimension of parameters
+``head_dim``    per-head feature dim (never sharded)
+``mlp``         feed-forward hidden dimension of parameters
+``layers``      stacked-layer leading dimension (pipeline stages)
+``experts``     MoE expert dimension
+``batch``       activation batch dimension
+``seq``         activation sequence dimension
+``attn_heads``  activation head dimension *inside* attention (Ulysses
+                switches this to the sequence mesh axis: XLA inserts the
+                all-to-alls)
+==============  ============================================================
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from polyaxon_tpu.exceptions import RuntimeLayerError
+from polyaxon_tpu.parallel.axes import AxisRules
+
+#: Mesh axes over which the *batch* may be sharded (data-like axes).
+DATA_AXES = ("replica", "data", "fsdp")
+
+
+@dataclass(frozen=True)
+class StrategyTemplate:
+    """Everything the runtime needs to apply one parallelism strategy."""
+
+    name: str
+    #: logical axis -> mesh axis (or tuple / None) for params AND activations
+    rules: Dict[str, Any]
+    #: mesh axes sharding the global-batch dimension
+    batch_axes: Tuple[str, ...]
+    #: attention runs the ring kernel over this mesh axis (sp_ring)
+    ring_axis: Optional[str] = None
+    #: layers are pipeline stages over this mesh axis (pp)
+    pipeline_axis: Optional[str] = None
+    #: microbatch count for the pipeline schedule
+    num_microbatches: int = 1
+    options: Dict[str, Any] = field(default_factory=dict)
+
+    def batch_spec(self):
+        from jax.sharding import PartitionSpec
+
+        axes = self.batch_axes
+        if not axes:
+            return PartitionSpec()
+        return PartitionSpec(axes if len(axes) > 1 else axes[0])
+
+
+def _data_axes(mesh_axes: Dict[str, int]) -> Tuple[str, ...]:
+    return tuple(a for a in DATA_AXES if a in mesh_axes and mesh_axes[a] > 1)
+
+
+def template_for(
+    strategy: str,
+    mesh_axes: Dict[str, int],
+    options: Optional[Dict[str, Any]] = None,
+) -> StrategyTemplate:
+    """Resolve a named strategy against a concrete mesh."""
+    options = dict(options or {})
+    data = _data_axes(mesh_axes)
+    batch_rules: Dict[str, Any] = {"batch": data if data else None}
+
+    def fsdp_axis() -> Optional[str]:
+        for a in ("fsdp", "data"):
+            if a in mesh_axes and mesh_axes[a] > 1:
+                return a
+        return None
+
+    if strategy == "ddp":
+        return StrategyTemplate("ddp", batch_rules, data, options=options)
+
+    if strategy == "fsdp":
+        ax = fsdp_axis()
+        rules = {**batch_rules, "embed": ax}
+        return StrategyTemplate("fsdp", rules, data, options=options)
+
+    if strategy == "tp":
+        rules = {
+            **batch_rules,
+            "heads": "tensor",
+            "mlp": "tensor",
+            "vocab": "tensor",
+            "experts": "tensor",
+            "attn_heads": "tensor",
+        }
+        if "tensor" not in mesh_axes:
+            raise RuntimeLayerError("tp strategy needs a 'tensor' mesh axis")
+        return StrategyTemplate("tp", rules, data, options=options)
+
+    if strategy == "tp_dp":
+        if "tensor" not in mesh_axes:
+            raise RuntimeLayerError("tp_dp strategy needs a 'tensor' mesh axis")
+        rules = {
+            **batch_rules,
+            "embed": fsdp_axis(),
+            "heads": "tensor",
+            "mlp": "tensor",
+            "vocab": "tensor",
+            "attn_heads": "tensor",
+        }
+        return StrategyTemplate("tp_dp", rules, data, options=options)
+
+    if strategy == "pp":
+        if "pipeline" not in mesh_axes:
+            raise RuntimeLayerError("pp strategy needs a 'pipeline' mesh axis")
+        rules = {**batch_rules, "layers": "pipeline"}
+        return StrategyTemplate(
+            "pp",
+            rules,
+            data,
+            pipeline_axis="pipeline",
+            num_microbatches=int(options.get("num_microbatches", mesh_axes["pipeline"])),
+            options=options,
+        )
+
+    if strategy == "sp_ring":
+        if "sequence" not in mesh_axes:
+            raise RuntimeLayerError("sp_ring strategy needs a 'sequence' mesh axis")
+        rules = {**batch_rules, "seq": "sequence"}
+        return StrategyTemplate(
+            "sp_ring", rules, data, ring_axis="sequence", options=options
+        )
+
+    if strategy == "ulysses":
+        if "sequence" not in mesh_axes:
+            raise RuntimeLayerError("ulysses strategy needs a 'sequence' mesh axis")
+        # Outside attention the sequence is sharded; inside attention the
+        # heads are — annotating both lets XLA insert the two all-to-alls
+        # (DeepSpeed-Ulysses, expressed as sharding constraints).
+        rules = {**batch_rules, "seq": "sequence", "attn_heads": "sequence"}
+        return StrategyTemplate("ulysses", rules, data, options=options)
+
+    if strategy == "ep":
+        if "expert" not in mesh_axes:
+            raise RuntimeLayerError("ep strategy needs an 'expert' mesh axis")
+        rules = {**batch_rules, "experts": "expert", "embed": fsdp_axis()}
+        return StrategyTemplate("ep", rules, data, options=options)
+
+    if strategy == "custom":
+        rules = dict(options.get("rules", {}))
+        rules.setdefault("batch", data if data else None)
+        return StrategyTemplate(
+            "custom",
+            rules,
+            tuple(options.get("batch_axes", data)),
+            ring_axis=options.get("ring_axis"),
+            pipeline_axis=options.get("pipeline_axis"),
+            num_microbatches=int(options.get("num_microbatches", 1)),
+            options=options,
+        )
+
+    raise RuntimeLayerError(f"Unknown strategy {strategy!r}")

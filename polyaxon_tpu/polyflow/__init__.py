@@ -1,0 +1,9 @@
+from polyaxon_tpu.polyflow.dags import DagError, sort_topologically
+from polyaxon_tpu.polyflow.tasks import PipelineContext, register_pipeline_tasks
+
+__all__ = [
+    "DagError",
+    "PipelineContext",
+    "register_pipeline_tasks",
+    "sort_topologically",
+]

@@ -1,0 +1,147 @@
+"""Pipeline orchestration: op runs scheduled over the task bus.
+
+Parity: reference ``polyflow/`` — Pipeline/OperationRun scheduling
+(``db/models/pipelines.py:112-189``), concurrency check (``:262``), skip /
+upstream-failure propagation, driven by the executor's
+EXPERIMENT_DONE → PIPELINES_CHECK chain instead of celery.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from polyaxon_tpu.auditor import Auditor
+from polyaxon_tpu.db.registry import Run, RunRegistry
+from polyaxon_tpu.events import EventTypes
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.polyflow.dags import DagError, build_dag, sort_topologically
+from polyaxon_tpu.schemas.specifications import ExperimentSpecification, Kinds
+from polyaxon_tpu.workers import PipelineTasks, SchedulerTasks, TaskBus
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PipelineContext:
+    registry: RunRegistry
+    bus: TaskBus
+    auditor: Auditor
+
+
+def _op_spec(pipeline: Run, op: dict) -> ExperimentSpecification:
+    data = {
+        k: v for k, v in op.items() if k not in ("name", "dependencies", "kind")
+    }
+    data["kind"] = Kinds.EXPERIMENT
+    # Pipeline-level declarations are the ops' shared defaults.
+    merged = dict(pipeline.spec.declarations)
+    merged.update(data.get("declarations") or {})
+    data["declarations"] = merged
+    if "environment" not in data and pipeline.spec_data.get("environment"):
+        data["environment"] = pipeline.spec_data["environment"]
+    return ExperimentSpecification.model_validate(data)
+
+
+def register_pipeline_tasks(ctx: PipelineContext) -> None:
+    bus, reg = ctx.bus, ctx.registry
+
+    def _ops(pipeline_id: int) -> Dict[str, Run]:
+        return {r.name: r for r in reg.list_runs(pipeline_id=pipeline_id)}
+
+    @bus.register(PipelineTasks.START)
+    def pipelines_start(pipeline_id: int) -> None:
+        pipeline = reg.get_run(pipeline_id)
+        if pipeline.is_done:
+            return
+        spec = pipeline.spec
+        dag = build_dag(spec.ops)
+        try:
+            sort_topologically(dag)  # cycle check up front
+        except DagError as e:
+            reg.set_status(pipeline_id, S.FAILED, message=str(e))
+            return
+        for op in spec.ops:
+            reg.create_run(
+                _op_spec(pipeline, op),
+                name=op["name"],
+                project=pipeline.project,
+                pipeline_id=pipeline_id,
+                tags=["operation"],
+            )
+        reg.set_status(pipeline_id, S.RUNNING)
+        bus.send(PipelineTasks.CHECK, {"pipeline_id": pipeline_id})
+
+    @bus.register(PipelineTasks.CHECK)
+    def pipelines_check(pipeline_id: int) -> None:
+        pipeline = reg.get_run(pipeline_id)
+        if pipeline.is_done:
+            return
+        spec = pipeline.spec
+        dag = build_dag(spec.ops)
+        ops = _ops(pipeline_id)
+
+        # Upstream-failure propagation: an op whose dependency failed /
+        # stopped / was skipped is skipped (reference skip propagation).
+        changed = True
+        while changed:
+            changed = False
+            for name, deps in dag.items():
+                run = ops.get(name)
+                if run is None or run.status != S.CREATED:
+                    continue
+                dep_runs = [ops[d] for d in deps if d in ops]
+                if any(
+                    d.status in (S.FAILED, S.STOPPED, S.SKIPPED) for d in dep_runs
+                ):
+                    if reg.set_status(
+                        run.id, S.SKIPPED, message="upstream op did not succeed"
+                    ):
+                        ops[name] = reg.get_run(run.id)
+                        ctx.auditor.record(
+                            EventTypes.OPERATION_DONE,
+                            run_id=run.id,
+                            pipeline_id=pipeline_id,
+                            status=S.SKIPPED,
+                        )
+                        changed = True
+
+        running = [r for r in ops.values() if not r.is_done and r.status != S.CREATED]
+        ready = [
+            name
+            for name, deps in dag.items()
+            if ops[name].status == S.CREATED
+            and all(ops[d].status == S.SUCCEEDED for d in deps if d in ops)
+        ]
+        window = (
+            max(0, spec.concurrency - len(running))
+            if spec.concurrency
+            else len(ready)
+        )
+        for name in sorted(ready)[:window]:
+            bus.send(SchedulerTasks.EXPERIMENTS_BUILD, {"run_id": ops[name].id})
+
+        if all(r.is_done for r in ops.values()) and len(ops) == len(dag):
+            status = (
+                S.SUCCEEDED
+                if all(r.status in (S.SUCCEEDED, S.SKIPPED) for r in ops.values())
+                else S.FAILED
+            )
+            if reg.set_status(pipeline_id, status):
+                ctx.auditor.record(
+                    EventTypes.PIPELINE_DONE, pipeline_id=pipeline_id, status=status
+                )
+
+    @bus.register(PipelineTasks.STOP)
+    def pipelines_stop(pipeline_id: int) -> None:
+        for run in reg.list_runs(pipeline_id=pipeline_id):
+            if not run.is_done:
+                if run.status == S.CREATED:
+                    reg.set_status(run.id, S.SKIPPED, message="pipeline stopped")
+                else:
+                    bus.send(SchedulerTasks.EXPERIMENTS_STOP, {"run_id": run.id})
+        pipeline = reg.get_run(pipeline_id)
+        if not pipeline.is_done:
+            reg.set_status(pipeline_id, S.STOPPING)
+            reg.set_status(pipeline_id, S.STOPPED)

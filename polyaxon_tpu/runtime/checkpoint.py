@@ -1,0 +1,88 @@
+"""Sharded checkpoint/restore for training state.
+
+The reference manages only *outputs directories* and delegates model
+checkpointing to user frameworks (SURVEY §5: TF ``model_dir`` pointed at
+the outputs path via TF_CONFIG, ``polypod/tensorflow.py:197-200``).  Here
+checkpointing is first-class: orbax-backed, sharding-aware (each host
+writes its shards, restore honors the target mesh), integrated with the
+run layout's ``checkpoints/`` dir — which the clone strategies
+(resume/copy) duplicate, so a resumed run restores step + optimizer state
+automatically.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, Optional, Union
+
+
+class CheckpointManager:
+    """Thin, typed wrapper over ``orbax.checkpoint.CheckpointManager``."""
+
+    def __init__(
+        self,
+        directory: Union[str, Path],
+        *,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+    ) -> None:
+        import orbax.checkpoint as ocp
+
+        self.directory = Path(directory).resolve()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                create=True,
+            ),
+        )
+
+    def save(
+        self,
+        step: int,
+        params: Any,
+        opt_state: Any,
+        force: bool = False,
+    ) -> bool:
+        """Save training state at ``step``; returns whether a save happened."""
+        import orbax.checkpoint as ocp
+
+        state = {"params": params, "opt_state": opt_state}
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(
+        self,
+        params_template: Any,
+        opt_state_template: Any,
+        step: Optional[int] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Restore onto the templates' shardings; None if no checkpoint.
+
+        Templates are the freshly-initialized (sharded) state — orbax
+        restores each leaf with the template's sharding, so a checkpoint
+        written under one mesh restores correctly onto another.
+        """
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        target = {"params": params_template, "opt_state": opt_state_template}
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(target)
+        )
+        restored["step"] = step
+        return restored
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

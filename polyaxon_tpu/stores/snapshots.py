@@ -93,7 +93,15 @@ def create_snapshot(
         if dest.exists():  # image-exists short-circuit
             shutil.rmtree(staging)
             return ref
-        staging.rename(dest)
+        try:
+            staging.rename(dest)
+        except OSError:
+            # A concurrent builder won the rename race — the snapshot we
+            # wanted now exists; identical content, so just use it.
+            if dest.exists():
+                shutil.rmtree(staging, ignore_errors=True)
+            else:
+                raise
     except BaseException:
         shutil.rmtree(staging, ignore_errors=True)
         raise

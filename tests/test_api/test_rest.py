@@ -1,0 +1,177 @@
+"""REST + WS API surface.
+
+Parity: reference API tests (``tests/test_experiments/test_views``) — CRUD,
+actions, metric ingestion, statuses, log retrieval — against the embedded
+orchestrator with real subprocess gangs.  No async pytest plugin in the
+image, so each test drives an aiohttp TestClient inside ``asyncio.run``.
+"""
+
+import asyncio
+
+import pytest
+
+from polyaxon_tpu.api.app import create_app
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.orchestrator import Orchestrator
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(
+        tmp_path / "plat",
+        monitor_interval=0.05,
+        heartbeat_interval=0.2,
+        heartbeat_ttl=30.0,
+    )
+    yield o
+    o.stop()
+
+
+def drive(orch, coro_fn):
+    """Run an async test body against a TestClient for the app."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        app = create_app(orch)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+async def _wait_done(orch, client, run_id, timeout=60.0):
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        await loop.run_in_executor(None, orch.pump, 0.05)
+        resp = await client.get(f"/api/v1/runs/{run_id}")
+        data = await resp.json()
+        if data["is_done"]:
+            return data
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"run {run_id} not done after {timeout}s")
+
+
+class TestRunsAPI:
+    def test_submit_and_get(self, orch):
+        async def body(client):
+            resp = await client.post(
+                "/api/v1/runs", json={"spec": SPEC, "name": "api-run"}
+            )
+            assert resp.status == 201
+            run = await resp.json()
+            assert run["status"] == S.CREATED and run["name"] == "api-run"
+            got = await (await client.get(f"/api/v1/runs/{run['id']}")).json()
+            assert got["uuid"] == run["uuid"]
+            listed = await (await client.get("/api/v1/runs")).json()
+            assert [r["id"] for r in listed["results"]] == [run["id"]]
+            return True
+
+        assert drive(orch, body)
+
+    def test_run_executes_and_streams_back(self, orch):
+        async def body(client):
+            resp = await client.post("/api/v1/runs", json={"spec": SPEC})
+            run = await resp.json()
+            done = await _wait_done(orch, client, run["id"])
+            assert done["status"] == S.SUCCEEDED
+            statuses = await (
+                await client.get(f"/api/v1/runs/{run['id']}/statuses")
+            ).json()
+            seq = [s["status"] for s in statuses["results"]]
+            assert seq[0] == S.CREATED and seq[-1] == S.SUCCEEDED
+            metrics = await (
+                await client.get(f"/api/v1/runs/{run['id']}/metrics")
+            ).json()
+            assert metrics["results"], "metrics not ingested"
+            return True
+
+        assert drive(orch, body)
+
+    def test_metric_ingestion_endpoint(self, orch):
+        async def body(client):
+            run = await (await client.post("/api/v1/runs", json={"spec": SPEC})).json()
+            resp = await client.post(
+                f"/api/v1/runs/{run['id']}/metrics",
+                json={"values": {"acc": 0.91}, "step": 3},
+            )
+            assert resp.status == 201
+            got = await (await client.get(f"/api/v1/runs/{run['id']}")).json()
+            assert got["last_metric"]["acc"] == 0.91
+            return True
+
+        assert drive(orch, body)
+
+    def test_stop_and_restart_actions(self, orch):
+        async def body(client):
+            run = await (await client.post("/api/v1/runs", json={"spec": SPEC})).json()
+            await _wait_done(orch, client, run["id"])
+            clone = await (
+                await client.post(f"/api/v1/runs/{run['id']}/restart")
+            ).json()
+            assert clone["original_id"] == run["id"]
+            assert clone["cloning_strategy"] == "restart"
+            done = await _wait_done(orch, client, clone["id"])
+            assert done["status"] == S.SUCCEEDED
+            return True
+
+        assert drive(orch, body)
+
+    def test_404(self, orch):
+        async def body(client):
+            resp = await client.get("/api/v1/runs/999")
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)
+
+    def test_health_status(self, orch):
+        async def body(client):
+            resp = await client.get("/api/v1/status")
+            assert resp.status == 200
+            report = await resp.json()
+            assert report["healthy"]
+            assert set(report["checks"]) >= {"registry", "bus", "stores"}
+            return True
+
+        assert drive(orch, body)
+
+    def test_ws_log_tail(self, orch):
+        async def body(client):
+            run = await (await client.post("/api/v1/runs", json={"spec": SPEC})).json()
+
+            async def pump():
+                loop = asyncio.get_event_loop()
+                for _ in range(400):
+                    await loop.run_in_executor(None, orch.pump, 0.05)
+                    if orch.get_run(run["id"]).is_done:
+                        break
+
+            pump_task = asyncio.ensure_future(pump())
+            ws = await client.ws_connect(f"/ws/v1/runs/{run['id']}/logs")
+            lines, done_seen = [], False
+            async for msg in ws:
+                data = msg.json()
+                if data.get("event") == "done":
+                    done_seen = True
+                    break
+                lines.append(data["line"])
+            await ws.close()
+            await pump_task
+            assert done_seen
+            assert any("noop trainer" in l for l in lines)
+            return True
+
+        assert drive(orch, body)

@@ -1,0 +1,53 @@
+"""CLI surface (local mode): run/ps/get/logs/statuses round trip."""
+
+import json
+
+import yaml
+
+from polyaxon_tpu.cli.main import main
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+
+class TestCLI:
+    def test_run_watch_then_inspect(self, tmp_path, capsys):
+        spec_file = tmp_path / "spec.yml"
+        spec_file.write_text(yaml.safe_dump(SPEC))
+        base = str(tmp_path / "home")
+
+        rc = main(
+            ["--base-dir", base, "run", "-f", str(spec_file), "--watch", "--name", "cli-e2e"]
+        )
+        assert rc == 0
+        out = capsys.readouterr()
+        assert "noop trainer" in out.out  # logs streamed
+        assert "succeeded" in out.err  # status lines on stderr
+
+        rc = main(["--base-dir", base, "ps"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "cli-e2e" in out and "succeeded" in out
+
+        rc = main(["--base-dir", base, "get", "1"])
+        assert rc == 0
+        run = json.loads(capsys.readouterr().out)
+        assert run["status"] == "succeeded"
+
+        rc = main(["--base-dir", base, "statuses", "1"])
+        assert rc == 0
+        assert "created" in capsys.readouterr().out
+
+    def test_run_failing_returns_nonzero(self, tmp_path, capsys):
+        spec = dict(SPEC, run={"entrypoint": "polyaxon_tpu.builtins.trainers:failing"})
+        spec_file = tmp_path / "spec.yml"
+        spec_file.write_text(yaml.safe_dump(spec))
+        rc = main(
+            ["--base-dir", str(tmp_path / "home"), "run", "-f", str(spec_file), "-w"]
+        )
+        assert rc == 1

@@ -1,0 +1,142 @@
+"""End-to-end hyperparameter sweeps through the orchestrator.
+
+Parity: reference stack §3.3 (SURVEY.md) — group create → suggestions →
+trial experiments → concurrency-windowed waves → iterate → group done.
+Trials run as real subprocess gangs on the single-process CPU backend.
+"""
+
+import pytest
+
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.orchestrator import Orchestrator
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(
+        tmp_path / "plat",
+        monitor_interval=0.05,
+        heartbeat_interval=0.2,
+        heartbeat_ttl=30.0,
+    )
+    yield o
+    o.stop()
+
+
+def group_spec(hptuning):
+    return {
+        "kind": "group",
+        "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:metric_probe"},
+        "environment": {
+            "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+        },
+        "hptuning": hptuning,
+    }
+
+
+@pytest.mark.e2e
+class TestHPSearchFlow:
+    def test_random_search_sweep(self, orch):
+        group = orch.submit(
+            group_spec(
+                {
+                    "matrix": {"lr": {"uniform": [0, 1]}},
+                    "concurrency": 2,
+                    "random_search": {"n_experiments": 4, "seed": 5},
+                }
+            )
+        )
+        done = orch.wait(group.id, timeout=120)
+        assert done.status == S.SUCCEEDED
+        trials = orch.registry.list_runs(group_id=group.id)
+        assert len(trials) == 4
+        assert all(t.status == S.SUCCEEDED for t in trials)
+        assert all("score" in t.last_metric for t in trials)
+
+    def test_grid_search_sweep(self, orch):
+        group = orch.submit(
+            group_spec(
+                {
+                    "matrix": {"lr": {"values": [0.1, 0.5, 0.9]}},
+                    "concurrency": 3,
+                    "grid_search": {},
+                }
+            )
+        )
+        done = orch.wait(group.id, timeout=120)
+        assert done.status == S.SUCCEEDED
+        trials = orch.registry.list_runs(group_id=group.id)
+        assert sorted(t.spec.declarations["lr"] for t in trials) == [0.1, 0.5, 0.9]
+
+    def test_hyperband_sweep_runs_brackets(self, orch):
+        group = orch.submit(
+            group_spec(
+                {
+                    "matrix": {"lr": {"uniform": [0, 1]}},
+                    "concurrency": 4,
+                    "hyperband": {
+                        "max_iterations": 4,
+                        "eta": 2,
+                        "resource": {"name": "epochs", "optimization": "maximize"},
+                        "metric": {"name": "score", "optimization": "maximize"},
+                        "seed": 2,
+                    },
+                }
+            )
+        )
+        done = orch.wait(group.id, timeout=300)
+        assert done.status == S.SUCCEEDED
+        iterations = orch.registry.get_iterations(group.id)
+        # max_iterations=4, eta=2 → s_max=2: three brackets, the first two
+        # with in-bracket reduction steps.
+        assert len(iterations) >= 3
+        trials = orch.registry.list_runs(group_id=group.id)
+        assert all(t.is_done for t in trials)
+        # reduced waves resume the top configs with a larger budget
+        budgets = {t.spec.declarations.get("epochs") for t in trials}
+        assert len(budgets) >= 2
+
+    def test_bo_sweep_improves(self, orch):
+        group = orch.submit(
+            group_spec(
+                {
+                    "matrix": {"lr": {"uniform": [0, 1]}},
+                    "concurrency": 3,
+                    "bo": {
+                        "n_initial_trials": 3,
+                        "n_iterations": 2,
+                        "metric": {"name": "score", "optimization": "maximize"},
+                        "seed": 1,
+                    },
+                }
+            )
+        )
+        done = orch.wait(group.id, timeout=300)
+        assert done.status == S.SUCCEEDED
+        trials = orch.registry.list_runs(group_id=group.id)
+        # 3 seed trials + 2 BO rounds of 1
+        assert len(trials) == 5
+        assert all(t.status == S.SUCCEEDED for t in trials)
+
+    def test_early_stopping_stops_sweep(self, orch):
+        group = orch.submit(
+            group_spec(
+                {
+                    "matrix": {"lr": {"values": [0.7, 0.1, 0.2, 0.3, 0.4, 0.5]}},
+                    "concurrency": 1,
+                    "grid_search": {},
+                    "early_stopping": [
+                        {
+                            "metric": {"name": "score", "optimization": "maximize"},
+                            "value": -0.001,
+                        }
+                    ],
+                }
+            )
+        )
+        done = orch.wait(group.id, timeout=120)
+        assert done.status == S.SUCCEEDED
+        trials = orch.registry.list_runs(group_id=group.id)
+        finished = [t for t in trials if t.status == S.SUCCEEDED]
+        # lr=0.7 hits the threshold immediately; later waves never start.
+        assert len(finished) < 6

@@ -1,0 +1,213 @@
+"""Suggestion-engine math, parity-grade.
+
+Model: reference ``tests/test_experiment_groups/test_search_managers.py:
+199-964`` — hyperband bracket counts, grid cardinality, random determinism,
+BO space featurization and a concrete optimization run.
+"""
+
+import numpy as np
+import pytest
+
+from polyaxon_tpu.hpsearch.search_managers import (
+    BOSearchManager,
+    GridSearchManager,
+    HyperbandSearchManager,
+    RandomSearchManager,
+    SearchError,
+    SearchSpace,
+    get_search_manager,
+)
+from polyaxon_tpu.schemas.hptuning import HPTuningConfig
+
+
+def hpt(**kwargs) -> HPTuningConfig:
+    return HPTuningConfig.model_validate(kwargs)
+
+
+class TestGrid:
+    def test_cartesian_product(self):
+        cfg = hpt(
+            matrix={"lr": {"values": [0.1, 0.2]}, "units": {"range": [10, 30, 10]}},
+            grid_search={},
+        )
+        suggestions = GridSearchManager(cfg).get_suggestions()
+        assert len(suggestions) == 4
+        assert {"lr": 0.1, "units": 10} in suggestions
+        assert {"lr": 0.2, "units": 20} in suggestions
+
+    def test_n_experiments_caps(self):
+        cfg = hpt(
+            matrix={"lr": {"values": [1, 2, 3, 4, 5]}},
+            grid_search={"n_experiments": 3},
+        )
+        assert len(GridSearchManager(cfg).get_suggestions()) == 3
+
+    def test_continuous_rejected(self):
+        cfg = hpt(matrix={"lr": {"uniform": [0, 1]}}, grid_search={})
+        with pytest.raises(SearchError):
+            GridSearchManager(cfg).get_suggestions()
+
+
+class TestRandom:
+    def test_count_and_determinism(self):
+        cfg = hpt(
+            matrix={"lr": {"uniform": [0, 1]}, "act": {"values": ["relu", "gelu"]}},
+            random_search={"n_experiments": 10, "seed": 7},
+        )
+        a = RandomSearchManager(cfg).get_suggestions()
+        b = RandomSearchManager(cfg).get_suggestions()
+        assert len(a) == 10
+        assert a == b  # seeded
+        assert all(0 <= s["lr"] <= 1 and s["act"] in ("relu", "gelu") for s in a)
+
+    def test_json_native_types(self):
+        cfg = hpt(
+            matrix={"lr": {"uniform": [0, 1]}},
+            random_search={"n_experiments": 2, "seed": 0},
+        )
+        for s in RandomSearchManager(cfg).get_suggestions():
+            assert isinstance(s["lr"], float) and not isinstance(s["lr"], np.floating)
+
+
+class TestHyperband:
+    """Bracket math mirrors the reference's concrete example:
+    max_iterations=81, eta=3 → s_max=4, B=405, n_configs per bracket
+    [81, 34, 15, 8, 5] (hyperband paper table / reference tests)."""
+
+    @pytest.fixture()
+    def manager(self):
+        cfg = hpt(
+            matrix={"lr": {"uniform": [0, 1]}},
+            hyperband={
+                "max_iterations": 81,
+                "eta": 3,
+                "resource": {"name": "epochs", "optimization": "maximize"},
+                "metric": {"name": "loss", "optimization": "minimize"},
+                "seed": 1,
+            },
+        )
+        return HyperbandSearchManager(cfg)
+
+    def test_bracket_constants(self, manager):
+        assert manager.s_max == 4
+        assert manager.B == 405
+
+    def test_n_configs_per_bracket(self, manager):
+        # iteration 0..4 → brackets s=4..0
+        assert [manager.get_n_configs(manager.get_bracket(i)) for i in range(5)] == [
+            81, 34, 15, 8, 5,
+        ]
+
+    def test_resources_per_bracket(self, manager):
+        got = [manager.get_resources_for_iteration(i) for i in range(5)]
+        assert got == [1, 3, 9, 27, 81]
+
+    def test_configs_to_keep(self, manager):
+        # Bracket s=4 (81 configs): keep 27 after step 0, 9 after step 1...
+        assert manager.get_n_config_to_keep_for_iteration(0, 0) == 27
+        assert manager.get_n_config_to_keep_for_iteration(0, 1) == 9
+        assert manager.get_n_config_to_keep_for_iteration(0, 2) == 3
+        assert manager.get_n_config_to_keep_for_iteration(0, 3) == 1
+
+    def test_should_reduce_then_reschedule(self, manager):
+        assert manager.should_reduce_configs(0, 0)  # inside bracket 4
+        assert not manager.should_reduce_configs(0, 4)  # bracket exhausted
+        assert manager.should_reschedule(0, 4)  # next bracket exists
+        assert not manager.should_reschedule(4, 0)  # last bracket (s=0) done
+
+    def test_suggestions_inject_resource(self, manager):
+        suggestions = manager.get_suggestions({"iteration": 1})
+        assert len(suggestions) == 34
+        assert all(s["epochs"] == 3 for s in suggestions)
+
+    def test_reduce_configs_keeps_topk_minimize(self, manager):
+        configs = [{"lr": i / 10} for i in range(9)]
+        metrics = [9, 1, 5, 3, 7, 2, 8, 4, 6]
+        survivors = manager.reduce_configs(1, 0, configs, metrics)
+        # bracket for iteration 1 is s=3: 34-config bracket keeps
+        # floor(9*3^0/3)=3 here (n_suggestions taken from the given list)
+        k = manager.get_n_config_to_keep(9, 0)
+        assert len(survivors) == k
+        assert [s["lr"] for s in survivors] == [0.1, 0.5, 0.3]
+        assert all(s["epochs"] == 9 for s in survivors)  # resource grew by eta
+
+
+class TestBO:
+    def test_space_roundtrip(self):
+        cfg = hpt(
+            matrix={
+                "lr": {"uniform": [0.001, 0.1]},
+                "units": {"values": [32, 64, 128]},
+                "act": {"values": ["relu", "tanh"]},
+            },
+            bo={
+                "n_initial_trials": 3,
+                "n_iterations": 2,
+                "metric": {"name": "acc", "optimization": "maximize"},
+            },
+        )
+        space = SearchSpace(cfg.matrix)
+        s = {"lr": 0.01, "units": 64, "act": "tanh"}
+        vec = space.to_vector(s)
+        back = space.to_suggestion(vec)
+        assert back["units"] == 64 and back["act"] == "tanh"
+        assert back["lr"] == pytest.approx(0.01)
+
+    def test_initial_round_is_random_seeded(self):
+        cfg = hpt(
+            matrix={"lr": {"uniform": [0, 1]}},
+            bo={
+                "n_initial_trials": 4,
+                "n_iterations": 2,
+                "metric": {"name": "acc", "optimization": "maximize"},
+                "seed": 3,
+            },
+        )
+        m = BOSearchManager(cfg)
+        assert m.get_suggestions() == m.get_suggestions()
+        assert len(m.get_suggestions()) == 4
+
+    def test_concrete_optimization_moves_toward_optimum(self):
+        # f(lr) = -(lr - 0.7)^2, observed on a coarse grid; the acquisition
+        # step must propose near 0.7 (the reference's "concrete example").
+        cfg = hpt(
+            matrix={"lr": {"uniform": [0, 1]}},
+            bo={
+                "n_initial_trials": 5,
+                "n_iterations": 3,
+                "metric": {"name": "score", "optimization": "maximize"},
+                "seed": 0,
+                "utility_function": {
+                    "acquisition_function": "ei",
+                    "n_warmup": 400,
+                    "n_iter": 5,
+                },
+            },
+        )
+        m = BOSearchManager(cfg)
+        configs = [{"lr": v} for v in (0.0, 0.25, 0.5, 0.75, 1.0)]
+        metrics = [-((c["lr"] - 0.7) ** 2) for c in configs]
+        (next_point,) = m.get_suggestions({"configs": configs, "metrics": metrics})
+        assert 0.5 < next_point["lr"] < 0.9, next_point
+
+    def test_minimize_negates(self):
+        cfg = hpt(
+            matrix={"lr": {"uniform": [0, 1]}},
+            bo={
+                "n_initial_trials": 3,
+                "n_iterations": 2,
+                "metric": {"name": "loss", "optimization": "minimize"},
+                "seed": 0,
+            },
+        )
+        m = BOSearchManager(cfg)
+        configs = [{"lr": v} for v in (0.1, 0.5, 0.9)]
+        metrics = [(c["lr"] - 0.3) ** 2 for c in configs]  # min at 0.3
+        (nxt,) = m.get_suggestions({"configs": configs, "metrics": metrics})
+        assert 0.0 <= nxt["lr"] <= 0.7
+
+
+class TestDispatch:
+    def test_get_search_manager(self):
+        cfg = hpt(matrix={"a": {"values": [1]}}, random_search={"n_experiments": 1})
+        assert isinstance(get_search_manager(cfg), RandomSearchManager)

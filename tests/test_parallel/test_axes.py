@@ -1,0 +1,89 @@
+"""Logical-axis → PartitionSpec mapping (pure, no devices needed)."""
+
+import pytest
+
+from polyaxon_tpu.exceptions import RuntimeLayerError
+from polyaxon_tpu.parallel import logical_to_spec, template_for, tree_specs
+
+
+class TestLogicalToSpec:
+    def test_basic_mapping(self):
+        from jax.sharding import PartitionSpec as P
+
+        spec = logical_to_spec(("embed", "mlp"), {"mlp": "tensor"})
+        assert spec == P(None, "tensor")
+
+    def test_trailing_nones_trimmed(self):
+        from jax.sharding import PartitionSpec as P
+
+        assert logical_to_spec(("embed", "mlp"), {}) == P()
+
+    def test_missing_mesh_axis_degrades_to_replication(self):
+        from jax.sharding import PartitionSpec as P
+
+        spec = logical_to_spec(("embed",), {"embed": "fsdp"}, {"data": 8})
+        assert spec == P()
+
+    def test_axis_used_once(self):
+        # The same mesh axis cannot shard two dims of one tensor.
+        from jax.sharding import PartitionSpec as P
+
+        spec = logical_to_spec(
+            ("embed", "mlp"), {"embed": "data", "mlp": "data"}, {"data": 8}
+        )
+        assert spec == P("data")
+
+    def test_tuple_target(self):
+        from jax.sharding import PartitionSpec as P
+
+        spec = logical_to_spec(("batch",), {"batch": ("replica", "data")})
+        assert spec == P(("replica", "data"))
+
+    def test_tree_specs_maps_leaves(self):
+        from jax.sharding import PartitionSpec as P
+
+        tree = {"a": ("embed", "mlp"), "nested": {"b": ("vocab",)}}
+        specs = tree_specs(tree, {"mlp": "tensor", "vocab": "tensor"})
+        assert specs["a"] == P(None, "tensor")
+        assert specs["nested"]["b"] == P("tensor")
+
+
+class TestTemplates:
+    def test_ddp_replicates_params(self):
+        t = template_for("ddp", {"data": 8})
+        assert t.batch_axes == ("data",)
+        assert "embed" not in t.rules
+
+    def test_fsdp_shards_embed(self):
+        t = template_for("fsdp", {"data": 4, "fsdp": 2})
+        assert t.rules["embed"] == "fsdp"
+        assert set(t.batch_axes) == {"data", "fsdp"}
+
+    def test_fsdp_falls_back_to_data_axis(self):
+        t = template_for("fsdp", {"data": 8})
+        assert t.rules["embed"] == "data"
+
+    def test_tp_requires_tensor_axis(self):
+        with pytest.raises(RuntimeLayerError):
+            template_for("tp", {"data": 8})
+
+    def test_pp_defaults_microbatches_to_stages(self):
+        t = template_for("pp", {"data": 2, "pipeline": 4})
+        assert t.pipeline_axis == "pipeline"
+        assert t.num_microbatches == 4
+
+    def test_ulysses_switches_heads_to_sequence(self):
+        t = template_for("ulysses", {"data": 2, "sequence": 4})
+        assert t.rules["seq"] == "sequence"
+        assert t.rules["attn_heads"] == "sequence"
+
+    def test_unknown_strategy(self):
+        with pytest.raises(RuntimeLayerError):
+            template_for("3d-chess", {"data": 8})
+
+    def test_custom_passthrough(self):
+        t = template_for(
+            "custom", {"data": 2, "tensor": 4}, {"rules": {"mlp": "tensor"}}
+        )
+        assert t.rules["mlp"] == "tensor"
+        assert t.batch_axes == ("data",)

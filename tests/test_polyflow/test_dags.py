@@ -1,0 +1,36 @@
+"""DAG utilities (pure)."""
+
+import pytest
+
+from polyaxon_tpu.polyflow.dags import DagError, build_dag, downstream, sort_topologically
+
+
+class TestDag:
+    def test_toposort_orders_dependencies_first(self):
+        dag = build_dag(
+            [
+                {"name": "train", "dependencies": ["prep"]},
+                {"name": "prep"},
+                {"name": "eval", "dependencies": ["train"]},
+                {"name": "report", "dependencies": ["eval", "prep"]},
+            ]
+        )
+        order = sort_topologically(dag)
+        assert order.index("prep") < order.index("train") < order.index("eval")
+        assert order.index("report") > order.index("eval")
+
+    def test_cycle_detected(self):
+        dag = {"a": {"b"}, "b": {"a"}}
+        with pytest.raises(DagError):
+            sort_topologically(dag)
+
+    def test_downstream_transitive(self):
+        dag = build_dag(
+            [
+                {"name": "a"},
+                {"name": "b", "dependencies": ["a"]},
+                {"name": "c", "dependencies": ["b"]},
+                {"name": "d"},
+            ]
+        )
+        assert downstream(dag, "a") == {"b", "c"}
