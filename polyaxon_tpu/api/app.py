@@ -48,7 +48,11 @@ def run_to_dict(run: Run) -> Dict[str, Any]:
     }
 
 
-def create_app(orch: Orchestrator):
+def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
+    """``auth_token`` enables bearer-token access control (reference
+    ``scopes/`` permission classes + ephemeral/internal tokens, collapsed
+    to one shared-secret scheme); ``/api/v1/status`` stays open for health
+    probes, like the reference's ``/status`` endpoint."""
     from aiohttp import WSMsgType, web
 
     routes = web.RouteTableDef()
@@ -62,6 +66,12 @@ def create_app(orch: Orchestrator):
                 text=json.dumps({"error": f"run {request.match_info['run_id']} not found"}),
                 content_type="application/json",
             )
+
+    @routes.get("/")
+    async def dashboard(request):
+        from polyaxon_tpu.api.dashboard import DASHBOARD_HTML
+
+        return web.Response(text=DASHBOARD_HTML, content_type="text/html")
 
     @routes.get(f"{API_PREFIX}/status")
     async def status(request):
@@ -91,15 +101,25 @@ def create_app(orch: Orchestrator):
     async def list_runs(request):
         q = request.rel_url.query
         statuses = q.getall("status", []) or None
+        limit = int(q.get("limit", 100))
+        offset = int(q.get("offset", 0))
+        # The DSL filter must see the full candidate set BEFORE pagination,
+        # or matches past the first page silently vanish.
         runs = reg.list_runs(
             kind=q.get("kind"),
             project=q.get("project"),
             group_id=int(q["group_id"]) if "group_id" in q else None,
             pipeline_id=int(q["pipeline_id"]) if "pipeline_id" in q else None,
             statuses=statuses,
-            limit=int(q.get("limit", 100)),
-            offset=int(q.get("offset", 0)),
         )
+        if "q" in q:  # search DSL, e.g. q=status:running,metric.loss:<0.5
+            from polyaxon_tpu.query import QueryError, apply_query
+
+            try:
+                runs = apply_query(runs, q["q"])
+            except QueryError as e:
+                return web.json_response({"error": str(e)}, status=400)
+        runs = runs[offset : offset + limit]
         return web.json_response({"results": [run_to_dict(r) for r in runs]})
 
     @routes.get(f"{API_PREFIX}/runs/{{run_id}}")
@@ -211,7 +231,19 @@ def create_app(orch: Orchestrator):
             request, lambda rid, cur: reg.get_metrics(rid, since_id=cur)
         )
 
-    app = web.Application()
+    @web.middleware
+    async def auth_middleware(request, handler):
+        # "/" (the static dashboard shell — no data in it) and the health
+        # endpoint stay open; the dashboard's API fetches carry the bearer
+        # token the user supplies once via ?token=.
+        open_paths = ("/", f"{API_PREFIX}/status")
+        if auth_token and request.path not in open_paths:
+            supplied = request.headers.get("Authorization", "")
+            if supplied != f"Bearer {auth_token}":
+                return web.json_response({"error": "unauthorized"}, status=401)
+        return await handler(request)
+
+    app = web.Application(middlewares=[auth_middleware] if auth_token else [])
     app.add_routes(routes)
     app["orchestrator"] = orch
     return app
@@ -222,13 +254,18 @@ def serve(
     host: str = "127.0.0.1",
     port: int = 8000,
     orch: Optional[Orchestrator] = None,
+    auth_token: Optional[str] = None,
 ) -> None:
     """Run the service: orchestrator loop in a thread + aiohttp in the main loop."""
+    import os
+
     from aiohttp import web
 
     orch = orch or Orchestrator(base_dir)
     orch.start()
-    app = create_app(orch)
+    app = create_app(
+        orch, auth_token=auth_token or os.environ.get("POLYAXON_TPU_AUTH_TOKEN")
+    )
     try:
         web.run_app(app, host=host, port=port, print=logger.info)
     finally:

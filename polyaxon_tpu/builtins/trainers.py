@@ -142,8 +142,17 @@ def lm_train(ctx: Context) -> None:
 
     t0 = time.time()
     loss = None
+    from polyaxon_tpu.tracking.profiling import StepProfiler
+
+    profiler = StepProfiler(
+        ctx.outputs_path or ".",
+        start_step=int(ctx.get_param("profile_start", -1)),
+        num_steps=int(ctx.get_param("profile_steps", 0)),
+    )
+
     metrics = None
     for i in range(start_step, steps):
+        profiler.on_step(i)
         params, opt_state, metrics = ts.step(params, opt_state, batch, key)
         # Only sync to host on logging steps — a float() every step would
         # serialize dispatch and understate throughput.
@@ -155,6 +164,7 @@ def lm_train(ctx: Context) -> None:
             )
         if ckpt is not None:
             ckpt.save(i, params, opt_state)
+    profiler.close()
     loss = float(metrics["loss"]) if metrics is not None else None
     if ckpt is not None:
         ckpt.wait_until_finished()

@@ -25,17 +25,21 @@ DEFAULT_BASE = os.environ.get("POLYAXON_TPU_HOME", "~/.polyaxon_tpu")
 class RemoteClient:
     """Thin urllib client for the REST API (no extra deps in the CLI path)."""
 
-    def __init__(self, host: str) -> None:
+    def __init__(self, host: str, token: Optional[str] = None) -> None:
         self.base = host.rstrip("/")
         if not self.base.startswith("http"):
             self.base = f"http://{self.base}"
+        self.token = token or os.environ.get("POLYAXON_TPU_AUTH_TOKEN")
 
     def _request(self, method: str, path: str, body: Optional[dict] = None) -> Any:
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(
             f"{self.base}{path}",
             method=method,
             data=json.dumps(body).encode() if body is not None else None,
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         with urllib.request.urlopen(req) as resp:
             return json.loads(resp.read() or "{}")
@@ -48,7 +52,9 @@ class RemoteClient:
         )
 
     def list(self, **query):
-        qs = "&".join(f"{k}={v}" for k, v in query.items() if v is not None)
+        from urllib.parse import urlencode
+
+        qs = urlencode({k: v for k, v in query.items() if v is not None})
         return self._request("GET", f"/api/v1/runs?{qs}")["results"]
 
     def get(self, run_id):
@@ -87,9 +93,12 @@ class LocalClient:
         runs = self.orch.registry.list_runs(
             project=query.get("project"),
             kind=query.get("kind"),
-            limit=int(query.get("limit") or 100),
         )
-        return [self._to_dict(r) for r in runs]
+        if query.get("q"):
+            from polyaxon_tpu.query import apply_query
+
+            runs = apply_query(runs, query["q"])
+        return [self._to_dict(r) for r in runs[: int(query.get("limit") or 100)]]
 
     def get(self, run_id):
         self.orch.pump()
@@ -120,7 +129,7 @@ class LocalClient:
 
 def _client(args):
     if args.host:
-        return RemoteClient(args.host)
+        return RemoteClient(args.host, token=getattr(args, "token", None))
     return LocalClient(args.base_dir)
 
 
@@ -161,6 +170,9 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--host", help="API server address (remote mode)")
     parser.add_argument(
+        "--token", help="API bearer token (or POLYAXON_TPU_AUTH_TOKEN)"
+    )
+    parser.add_argument(
         "--base-dir", default=DEFAULT_BASE, help="platform state dir (local mode)"
     )
     sub = parser.add_subparsers(dest="command", required=True)
@@ -178,6 +190,9 @@ def main(argv=None) -> int:
     p_ps.add_argument("--project")
     p_ps.add_argument("--kind")
     p_ps.add_argument("--limit", type=int, default=50)
+    p_ps.add_argument(
+        "-q", "--query", help='filter DSL, e.g. "status:running,metric.loss:<0.5"'
+    )
 
     p_get = sub.add_parser("get", help="show one run as json")
     p_get.add_argument("run_id")
@@ -221,7 +236,12 @@ def main(argv=None) -> int:
             return 0
         if args.command == "ps":
             _print_runs(
-                client.list(project=args.project, kind=args.kind, limit=args.limit)
+                client.list(
+                    project=args.project,
+                    kind=args.kind,
+                    limit=args.limit,
+                    q=args.query,
+                )
             )
             return 0
         if args.command == "get":

@@ -1,0 +1,4 @@
+from polyaxon_tpu.conf.options import Option, OptionStores, OPTIONS, option_by_key
+from polyaxon_tpu.conf.service import ConfService
+
+__all__ = ["ConfService", "Option", "OptionStores", "OPTIONS", "option_by_key"]

@@ -1,0 +1,70 @@
+"""Typed option registry.
+
+Parity: reference ``options/option.py:13-40`` (``Option`` with key/type/
+default/store) and the ~40 registry modules under ``options/registry/``
+(scheduler intervals, heartbeats, groups chunking, TPU keys
+``options/registry/k8s.py:20-23``).  Collapsed to one module: the platform
+has far fewer knobs because celery/k8s/redis are gone — what remains are
+the scheduler cadences, restart policy bounds, store paths, and bench/
+mesh defaults.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+
+class OptionStores:
+    ENV = "env"  # POLYAXON_TPU_<KEY>
+    DB = "db"  # registry options table (cluster-editable at runtime)
+    DEFAULT = "default"
+
+
+@dataclass(frozen=True)
+class Option:
+    key: str
+    typing: type
+    default: Any
+    description: str = ""
+    #: resolution order, first hit wins
+    stores: Tuple[str, ...] = (OptionStores.DB, OptionStores.ENV, OptionStores.DEFAULT)
+
+    @property
+    def env_var(self) -> str:
+        return "POLYAXON_TPU_" + self.key.upper().replace(".", "_")
+
+    def coerce(self, raw: Any) -> Any:
+        if raw is None or isinstance(raw, self.typing):
+            return raw
+        if self.typing is bool:
+            return str(raw).lower() in ("1", "true", "yes", "on")
+        return self.typing(raw)
+
+
+_ALL = [
+    Option("scheduler.monitor_interval", float, 0.2,
+           "gang poll cadence (reference Intervals.EXPERIMENTS_SYNC=30s analog)"),
+    Option("scheduler.heartbeat_ttl", float, 600.0,
+           "no-heartbeat window before a run is declared zombie"),
+    Option("scheduler.heartbeat_check_interval", float, 60.0,
+           "zombie-check cron cadence (reference beat: 600s)"),
+    Option("scheduler.terminal_grace", float, 10.0,
+           "grace before force-stopping a logically-done gang"),
+    Option("worker.heartbeat_interval", float, 5.0,
+           "in-process heartbeat cadence (reference sidecar poll: 2s)"),
+    Option("spawner.default_accelerator", str, "cpu",
+           "topology.accelerator default for specs that omit it"),
+    Option("groups.max_concurrency", int, 64,
+           "upper bound on a sweep's concurrency setting"),
+    Option("restarts.max_allowed", int, 10,
+           "upper bound on restart_policy.max_restarts"),
+    Option("logs.retention_days", float, 30.0, "activity/log cleanup horizon"),
+    Option("api.page_size", int, 100, "default list page size"),
+]
+
+OPTIONS: Dict[str, Option] = {o.key: o for o in _ALL}
+
+
+def option_by_key(key: str) -> Optional[Option]:
+    return OPTIONS.get(key)

@@ -1,0 +1,75 @@
+"""Layered config resolution with a TTL cache.
+
+Parity: reference ``conf/service.py:6-18`` + ``conf/handlers/`` — options
+resolve through their store order (DB option table → env var → default),
+DB writes take effect cluster-wide at runtime, reads are cached with a TTL.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from polyaxon_tpu.conf.options import Option, OptionStores, option_by_key
+from polyaxon_tpu.exceptions import PolyaxonTPUError
+
+
+class ConfError(PolyaxonTPUError):
+    pass
+
+
+class ConfService:
+    def __init__(self, registry=None, cache_ttl: float = 60.0) -> None:
+        #: RunRegistry (for the DB store) — optional so schema-only tools
+        #: can resolve env/default options without a database.
+        self.registry = registry
+        self.cache_ttl = cache_ttl
+        self._cache: Dict[str, Tuple[float, Any]] = {}
+
+    def _option(self, key: str) -> Option:
+        opt = option_by_key(key)
+        if opt is None:
+            raise ConfError(f"Unknown option {key!r}")
+        return opt
+
+    def get(self, key: str) -> Any:
+        hit = self._cache.get(key)
+        if hit is not None and time.time() - hit[0] < self.cache_ttl:
+            return hit[1]
+        opt = self._option(key)
+        value: Any = None
+        for store in opt.stores:
+            if store == OptionStores.DB and self.registry is not None:
+                raw = self.registry.get_option(opt.key)
+                if raw is not None:
+                    value = opt.coerce(raw)
+                    break
+            elif store == OptionStores.ENV:
+                raw = os.environ.get(opt.env_var)
+                if raw is not None:
+                    value = opt.coerce(raw)
+                    break
+            elif store == OptionStores.DEFAULT:
+                value = opt.default
+                break
+        self._cache[key] = (time.time(), value)
+        return value
+
+    def set(self, key: str, value: Any) -> None:
+        """Write to the DB store (runtime-mutable, like the reference's
+        cluster options)."""
+        opt = self._option(key)
+        if self.registry is None:
+            raise ConfError("No registry attached; cannot persist options")
+        self.registry.set_option(opt.key, opt.coerce(value))
+        self._cache.pop(key, None)
+
+    def unset(self, key: str) -> None:
+        opt = self._option(key)
+        if self.registry is not None:
+            self.registry.delete_option(opt.key)
+        self._cache.pop(key, None)
+
+    def invalidate(self) -> None:
+        self._cache.clear()

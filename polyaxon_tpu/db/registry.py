@@ -658,6 +658,26 @@ class RunRegistry:
             for r in rows
         ]
 
+    # -- retention cleanup ----------------------------------------------------
+    def clean_old_rows(self, older_than_seconds: float, now: Optional[float] = None) -> Dict[str, int]:
+        """Delete activity/log rows past the retention horizon for DONE runs.
+
+        Parity: the reference's beat cleaners (``crons/tasks/cleaning.py``,
+        activity-log & notification cleanup, archived deletion).
+        """
+        now = now or time.time()
+        cutoff = now - older_than_seconds
+        with self._lock, self._conn() as conn:
+            act = conn.execute(
+                "DELETE FROM activity WHERE created_at < ?", (cutoff,)
+            ).rowcount
+            logs = conn.execute(
+                """DELETE FROM logs WHERE created_at < ? AND run_id IN
+                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
+                (cutoff, cutoff),
+            ).rowcount
+        return {"activity": act, "logs": logs}
+
     # -- options (DB-backed conf store) ---------------------------------------
     def set_option(self, key: str, value: Any) -> None:
         with self._lock, self._conn() as conn:

@@ -1,0 +1,92 @@
+"""In-worker resource telemetry sampler.
+
+Parity: reference ``monitor_resources/`` — the per-node DaemonSet reading
+docker stats + ``polyaxon_gpustat.query()`` (NVML) and publishing to Redis
+for the streams layer (``monitor_resources/monitor.py:30-120``).
+TPU-native: each gang process samples itself (psutil process stats) and its
+local accelerator (``device.memory_stats()`` from the PJRT client — the
+libtpu telemetry path), reporting through the same reports channel as
+metrics; rows land in the registry prefixed ``sys/`` so the WS metric tail
+streams them live.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+
+def sample_process() -> Dict[str, float]:
+    """CPU / memory of the calling process (psutil if present)."""
+    out: Dict[str, float] = {}
+    try:
+        import psutil
+
+        p = psutil.Process()
+        with p.oneshot():
+            out["sys/cpu_percent"] = p.cpu_percent(interval=None)
+            out["sys/rss_mb"] = p.memory_info().rss / 1e6
+            out["sys/threads"] = float(p.num_threads())
+    except Exception:
+        try:
+            import resource
+
+            out["sys/rss_mb"] = (
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e3
+            )
+        except Exception:
+            pass
+    return out
+
+
+def sample_devices() -> Dict[str, float]:
+    """Per-local-device HBM usage from the PJRT client, if initialized."""
+    out: Dict[str, float] = {}
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            stats = d.memory_stats() or {}
+            in_use = stats.get("bytes_in_use")
+            limit = stats.get("bytes_limit")
+            if in_use is not None:
+                out[f"sys/hbm{d.id}_mb"] = in_use / 1e6
+            if in_use is not None and limit:
+                out[f"sys/hbm{d.id}_frac"] = in_use / limit
+    except Exception:
+        pass
+    return out
+
+
+class ResourceSampler:
+    """Background thread reporting resource samples at an interval."""
+
+    def __init__(self, reporter, interval: float = 10.0) -> None:
+        self.reporter = reporter
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample_once(self) -> Dict[str, Any]:
+        values = sample_process()
+        values.update(sample_devices())
+        return values
+
+    def start(self) -> None:
+        if self._thread is not None or self.interval <= 0:
+            return
+
+        def loop() -> None:
+            while not self._stop.wait(self.interval):
+                values = self.sample_once()
+                if values:
+                    self.reporter.resources(values)
+
+        self._thread = threading.Thread(target=loop, name="resources", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

@@ -60,7 +60,7 @@ class GangWatcher:
     def _apply(self, handle: GangHandle, process_id: int, event: dict) -> None:
         etype = event.get("type")
         run_id = handle.run_id
-        if etype == "metric":
+        if etype in ("metric", "resources"):
             self.registry.add_metric(run_id, event.get("values") or {}, step=event.get("step"))
         elif etype == "log":
             self.registry.add_log(run_id, event.get("line", ""), process_id=process_id)

@@ -1,0 +1,9 @@
+from polyaxon_tpu.notifier.service import Notifier
+from polyaxon_tpu.notifier.actions import (
+    Action,
+    CallbackAction,
+    LogAction,
+    WebhookAction,
+)
+
+__all__ = ["Action", "CallbackAction", "LogAction", "Notifier", "WebhookAction"]

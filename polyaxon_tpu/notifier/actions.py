@@ -1,0 +1,89 @@
+"""Notification actions.
+
+Parity: reference ``notifier/actions/`` + ``actions/registry/webhooks/``
+(Slack/Discord/HipChat/Mattermost/PagerDuty webhook senders + email).  The
+provider-specific payload dialects collapse to one generic JSON webhook
+with a payload-shaping hook (a Slack shaper is included as the worked
+example); the in-process ``CallbackAction`` replaces email for embedded
+deployments.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+from typing import Any, Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+Payload = Dict[str, Any]
+
+
+class Action:
+    """One notification sink. Subclasses implement ``_execute``."""
+
+    name = "action"
+
+    def execute(self, payload: Payload) -> bool:
+        try:
+            self._execute(payload)
+            return True
+        except Exception:
+            # Notification failure must never break orchestration.
+            logger.exception("Notification action %s failed", self.name)
+            return False
+
+    def _execute(self, payload: Payload) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class LogAction(Action):
+    name = "log"
+
+    def __init__(self, level: int = logging.INFO) -> None:
+        self.level = level
+
+    def _execute(self, payload: Payload) -> None:
+        logger.log(self.level, "event %s: %s", payload.get("event_type"), payload)
+
+
+class CallbackAction(Action):
+    name = "callback"
+
+    def __init__(self, fn: Callable[[Payload], None]) -> None:
+        self.fn = fn
+
+    def _execute(self, payload: Payload) -> None:
+        self.fn(payload)
+
+
+def slack_shaper(payload: Payload) -> Payload:
+    """Shape a platform event as a Slack webhook message."""
+    event = payload.get("event_type", "event")
+    ctx = {k: v for k, v in payload.items() if k != "event_type"}
+    detail = ", ".join(f"{k}={v}" for k, v in sorted(ctx.items()))
+    return {"text": f":robot_face: polyaxon-tpu *{event}* {detail}"}
+
+
+class WebhookAction(Action):
+    name = "webhook"
+
+    def __init__(
+        self,
+        url: str,
+        shaper: Optional[Callable[[Payload], Payload]] = None,
+        timeout: float = 5.0,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.url = url
+        self.shaper = shaper
+        self.timeout = timeout
+        self.headers = {"Content-Type": "application/json", **(headers or {})}
+
+    def _execute(self, payload: Payload) -> None:
+        body = self.shaper(payload) if self.shaper else payload
+        req = urllib.request.Request(
+            self.url, data=json.dumps(body, default=str).encode(), headers=self.headers
+        )
+        urllib.request.urlopen(req, timeout=self.timeout)

@@ -1,0 +1,33 @@
+"""Notifier: auditor subscriber fanning events to actions.
+
+Parity: reference ``notifier/service.py`` — consumes the EVENTS_NOTIFY fan
+-out and dispatches to configured actions, filtered per event type.  Here
+it subscribes to the auditor directly (the celery hop collapses away).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+from polyaxon_tpu.events import Event
+from polyaxon_tpu.notifier.actions import Action
+
+
+class Notifier:
+    """Subscribe to an :class:`~polyaxon_tpu.auditor.Auditor`."""
+
+    def __init__(
+        self,
+        actions: Sequence[Action],
+        event_types: Optional[Iterable[str]] = None,
+    ) -> None:
+        self.actions: List[Action] = list(actions)
+        #: None = all events; else a whitelist
+        self.event_types = set(event_types) if event_types is not None else None
+
+    def __call__(self, event: Event) -> None:
+        if self.event_types is not None and event.event_type not in self.event_types:
+            return
+        payload = {"event_type": event.event_type, **event.context}
+        for action in self.actions:
+            action.execute(payload)

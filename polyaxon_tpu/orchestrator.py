@@ -41,18 +41,69 @@ class Orchestrator:
         base_dir: Union[str, Path],
         *,
         time_scale: float = 1.0,
-        monitor_interval: float = 0.2,
-        heartbeat_interval: float = 5.0,
-        heartbeat_ttl: float = 600.0,
-        heartbeat_check_interval: float = 60.0,
+        monitor_interval: Optional[float] = None,
+        heartbeat_interval: Optional[float] = None,
+        heartbeat_ttl: Optional[float] = None,
+        heartbeat_check_interval: Optional[float] = None,
     ) -> None:
         self.base_dir = Path(base_dir)
         self.layout = StoreLayout(self.base_dir)
         self.registry = RunRegistry(self.base_dir / "registry.db")
+        from polyaxon_tpu.conf import ConfService
+
+        # Explicit arguments win; otherwise options resolve through the
+        # conf stores (DB option table -> env -> default).
+        self.conf = ConfService(self.registry)
+        conf = self.conf
+        monitor_interval = (
+            monitor_interval
+            if monitor_interval is not None
+            else conf.get("scheduler.monitor_interval")
+        )
+        heartbeat_interval = (
+            heartbeat_interval
+            if heartbeat_interval is not None
+            else conf.get("worker.heartbeat_interval")
+        )
+        heartbeat_ttl = (
+            heartbeat_ttl
+            if heartbeat_ttl is not None
+            else conf.get("scheduler.heartbeat_ttl")
+        )
+        heartbeat_check_interval = (
+            heartbeat_check_interval
+            if heartbeat_check_interval is not None
+            else conf.get("scheduler.heartbeat_check_interval")
+        )
         self.bus = TaskBus(time_scale=time_scale)
         self.auditor = Auditor(self.registry)
         self.executor = ExecutorHandlers(self.bus)
         self.auditor.subscribe(self.executor)
+        import os as _os
+
+        webhook = _os.environ.get("POLYAXON_TPU_WEBHOOK_URL")
+        if webhook:
+            # Opt-in done/failed notifications (reference notifier/actions).
+            from polyaxon_tpu.notifier import Notifier, WebhookAction
+            from polyaxon_tpu.notifier.actions import slack_shaper
+
+            shaper = (
+                slack_shaper
+                if _os.environ.get("POLYAXON_TPU_WEBHOOK_KIND") == "slack"
+                else None
+            )
+            self.auditor.subscribe(
+                Notifier(
+                    [WebhookAction(webhook, shaper=shaper)],
+                    event_types=[
+                        EventTypes.EXPERIMENT_SUCCEEDED,
+                        EventTypes.EXPERIMENT_FAILED,
+                        EventTypes.EXPERIMENT_ZOMBIE,
+                        EventTypes.GROUP_DONE,
+                        EventTypes.PIPELINE_DONE,
+                    ],
+                )
+            )
         self.spawner = LocalGangSpawner(
             self.layout, heartbeat_interval=heartbeat_interval
         )
@@ -66,6 +117,7 @@ class Orchestrator:
             watcher=self.watcher,
             monitor_interval=monitor_interval,
             heartbeat_ttl=heartbeat_ttl,
+            terminal_grace=conf.get("scheduler.terminal_grace"),
         )
         register_scheduler_tasks(self.ctx)
         from polyaxon_tpu.hpsearch import HPContext, register_hp_tasks
@@ -85,6 +137,11 @@ class Orchestrator:
     # -- lifecycle ------------------------------------------------------------
     def start(self) -> None:
         self.bus.add_cron(CronTasks.HEARTBEAT_CHECK, self._heartbeat_check_interval)
+        self.bus.add_cron(
+            CronTasks.CLEAN_ACTIVITY,
+            3600.0,
+            {"retention_seconds": self.conf.get("logs.retention_days") * 86400.0},
+        )
         self.bus.start()
 
     def stop(self) -> None:

@@ -1,0 +1,72 @@
+"""Apply parsed query conditions to registry runs.
+
+Parity: reference ``QueryBuilder.build`` (``query/builder.py:18-31``) and
+the per-entity query managers — there conditions compile to Django ORM
+filters; here the registry's polymorphic run rows (with JSON
+``last_metric``/``declarations``/``tags`` payloads) are filtered in
+process, which keeps one code path for plain columns and JSON fields.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Optional, Sequence
+
+from polyaxon_tpu.db.registry import Run
+from polyaxon_tpu.query.parser import Condition, QueryError, parse_query
+
+#: plain run attributes addressable in queries
+_FIELDS = {
+    "id", "uuid", "kind", "name", "project", "status", "group_id",
+    "pipeline_id", "original_id", "restarts", "created_at", "started_at",
+    "finished_at",
+}
+
+
+def _resolve(run: Run, field: str) -> Any:
+    if field in _FIELDS:
+        return getattr(run, field)
+    if field.startswith("metric."):
+        return run.last_metric.get(field.split(".", 1)[1])
+    if field.startswith("declarations.") or field.startswith("params."):
+        return run.spec_data.get("declarations", {}).get(field.split(".", 1)[1])
+    if field == "tags":
+        return run.tags
+    raise QueryError(
+        f"Unknown query field {field!r} (plain fields: {sorted(_FIELDS)}; "
+        "JSON fields: metric.<name>, declarations.<name>, tags)"
+    )
+
+
+def _matches(run: Run, cond: Condition) -> bool:
+    actual = _resolve(run, cond.field)
+    if cond.field == "tags":
+        values = cond.value if isinstance(cond.value, list) else [cond.value]
+        result = any(v in (actual or []) for v in values)
+    elif actual is None:
+        result = False
+    elif cond.op == "eq":
+        result = actual == cond.value
+    elif cond.op == "in":
+        result = actual in cond.value
+    elif cond.op == "range":
+        lo, hi = cond.value
+        result = lo <= actual <= hi
+    else:
+        try:
+            result = {
+                "gt": actual > cond.value,
+                "gte": actual >= cond.value,
+                "lt": actual < cond.value,
+                "lte": actual <= cond.value,
+            }[cond.op]
+        except TypeError:
+            result = False
+    return not result if cond.negated else result
+
+
+def apply_query(
+    runs: Iterable[Run], query: Optional[str] = None, conditions: Optional[Sequence[Condition]] = None
+) -> List[Run]:
+    """Filter runs by a query string (AND of all its conditions)."""
+    conds = list(conditions or []) or parse_query(query)
+    return [r for r in runs if all(_matches(r, c) for c in conds)]

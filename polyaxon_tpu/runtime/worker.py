@@ -48,6 +48,10 @@ def _configure_jax_env(info) -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        if info.num_processes > 1:
+            # Cross-process CPU collectives need an explicit backend; gloo
+            # plays the role ICI/DCN transports play on real slices.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 
 def _init_distributed(info) -> bool:
@@ -79,6 +83,14 @@ def main() -> int:
     reporter = Reporter(paths.report_file(info.process_id), info.process_id)
     reporter.status("starting")
     reporter.start_heartbeat(info.heartbeat_interval)
+    from polyaxon_tpu.monitor.resources import ResourceSampler
+
+    # NOT started yet: the sampler thread touches jax.local_devices(),
+    # which would initialize the backend and race jax.distributed below.
+    sampler = ResourceSampler(
+        reporter,
+        interval=float(os.environ.get("POLYAXON_TPU_RESOURCE_INTERVAL", "10")),
+    )
 
     try:
         _configure_jax_env(info)
@@ -98,6 +110,7 @@ def main() -> int:
         if run_cfg.cmd is not None:
             # Shell command path: the distributed bootstrap belongs to the
             # command itself (it can read the same env contract).
+            sampler.start()
             reporter.status("running")
             rc = _run_cmd(
                 run_cfg.cmd,
@@ -112,6 +125,7 @@ def main() -> int:
 
         # Python entrypoint path: managed distributed world + mesh.
         distributed = _init_distributed(info)
+        sampler.start()
         import jax
 
         from polyaxon_tpu.runtime.mesh import build_mesh
@@ -153,6 +167,7 @@ def main() -> int:
         reporter.error(e)
         raise
     finally:
+        sampler.stop()
         reporter.close()
 
 

@@ -226,6 +226,12 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
         reg.set_status(run_id, S.STOPPED)
         _record_done(ctx, run_id, S.STOPPED)
 
+    @bus.register(CronTasks.CLEAN_ACTIVITY)
+    def clean_activity(retention_seconds: float = 30 * 86400.0) -> None:
+        removed = reg.clean_old_rows(retention_seconds)
+        if any(removed.values()):
+            logger.info("Retention cleanup removed %s", removed)
+
     @bus.register(CronTasks.HEARTBEAT_CHECK)
     def heartbeat_check() -> None:
         for run in reg.zombie_runs(ctx.heartbeat_ttl):

@@ -1,0 +1,3 @@
+from polyaxon_tpu.stats.backends import MemoryStats, NoOpStats, StatsBackend, StatsdStats
+
+__all__ = ["MemoryStats", "NoOpStats", "StatsBackend", "StatsdStats"]

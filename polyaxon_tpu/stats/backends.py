@@ -1,0 +1,87 @@
+"""Pluggable operational-metrics backends.
+
+Parity: reference ``stats/`` (``statsd.py:7``, ``datadog.py:11``, noop) —
+counters/gauges/timings for the control plane itself (task throughput,
+gang spawn latency).  The statsd backend speaks the plain UDP protocol
+with no dependency; the memory backend is for tests and the /status page.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, List, Tuple
+
+
+class StatsBackend:
+    def incr(self, key: str, value: int = 1) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def gauge(self, key: str, value: float) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def timing(self, key: str, seconds: float) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    @contextmanager
+    def timed(self, key: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timing(key, time.perf_counter() - t0)
+
+
+class NoOpStats(StatsBackend):
+    def incr(self, key: str, value: int = 1) -> None:
+        pass
+
+    def gauge(self, key: str, value: float) -> None:
+        pass
+
+    def timing(self, key: str, seconds: float) -> None:
+        pass
+
+
+class MemoryStats(StatsBackend):
+    """In-process aggregation (tests + health/status introspection)."""
+
+    def __init__(self) -> None:
+        self.counters: Dict[str, int] = defaultdict(int)
+        self.gauges: Dict[str, float] = {}
+        self.timings: Dict[str, List[float]] = defaultdict(list)
+
+    def incr(self, key: str, value: int = 1) -> None:
+        self.counters[key] += value
+
+    def gauge(self, key: str, value: float) -> None:
+        self.gauges[key] = value
+
+    def timing(self, key: str, seconds: float) -> None:
+        self.timings[key].append(seconds)
+
+
+class StatsdStats(StatsBackend):
+    """Plain statsd-over-UDP (fire and forget, never raises)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125, prefix: str = "polyaxon_tpu") -> None:
+        self.addr: Tuple[str, int] = (host, port)
+        self.prefix = prefix
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def _send(self, payload: str) -> None:
+        try:
+            self._sock.sendto(f"{self.prefix}.{payload}".encode(), self.addr)
+        except OSError:
+            pass
+
+    def incr(self, key: str, value: int = 1) -> None:
+        self._send(f"{key}:{value}|c")
+
+    def gauge(self, key: str, value: float) -> None:
+        self._send(f"{key}:{value}|g")
+
+    def timing(self, key: str, seconds: float) -> None:
+        self._send(f"{key}:{seconds * 1000:.2f}|ms")

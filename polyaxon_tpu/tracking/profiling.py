@@ -1,0 +1,59 @@
+"""Per-step tracing/profiling hooks.
+
+The reference has no tracer at all (SURVEY §5: observability = StatsD
+counters + Sentry) — real per-step device profiling is a TPU-first
+addition: a windowed ``jax.profiler`` trace (xplane) written into the
+run's managed outputs dir, viewable with xprof/tensorboard, plus
+annotation helpers for named trace spans.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Union
+
+
+class StepProfiler:
+    """Capture a jax.profiler trace for steps [start, start+num_steps)."""
+
+    def __init__(
+        self,
+        outputs_dir: Union[str, Path],
+        start_step: int = -1,
+        num_steps: int = 0,
+    ) -> None:
+        self.trace_dir = str(Path(outputs_dir) / "profile")
+        self.start_step = start_step
+        self.num_steps = num_steps
+        self._active = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.num_steps > 0 and self.start_step >= 0
+
+    def on_step(self, step: int) -> None:
+        """Call once per train step (before dispatch)."""
+        if not self.enabled:
+            return
+        import jax
+
+        if not self._active and step == self.start_step:
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+        elif self._active and step >= self.start_step + self.num_steps:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+def annotate(name: str):
+    """Named trace span context manager (no-op cost when not tracing)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

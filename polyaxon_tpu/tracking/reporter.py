@@ -52,6 +52,10 @@ class Reporter:
     def heartbeat(self) -> None:
         self._emit("heartbeat")
 
+    def resources(self, values: Dict[str, Any]) -> None:
+        """Telemetry samples (cpu/rss/HBM) — streamed like metrics."""
+        self._emit("resources", values=values)
+
     def error(self, exc: BaseException) -> None:
         self._emit(
             "status",

@@ -175,3 +175,51 @@ class TestRunsAPI:
             return True
 
         assert drive(orch, body)
+
+
+class TestAuthAndDashboard:
+    def test_auth_required_when_token_set(self, orch):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def runner():
+            app = create_app(orch, auth_token="sekret")
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                assert (await client.get("/api/v1/runs")).status == 401
+                ok = await client.get(
+                    "/api/v1/runs", headers={"Authorization": "Bearer sekret"}
+                )
+                assert ok.status == 200
+                # health stays open for probes
+                assert (await client.get("/api/v1/status")).status == 200
+            finally:
+                await client.close()
+            return True
+
+        assert asyncio.run(runner())
+
+    def test_dashboard_served(self, orch):
+        async def body(client):
+            resp = await client.get("/")
+            assert resp.status == 200
+            html = await resp.text()
+            assert "polyaxon-tpu" in html and "/api/v1/runs" in html
+            return True
+
+        assert drive(orch, body)
+
+    def test_query_filter_param(self, orch):
+        async def body(client):
+            await client.post("/api/v1/runs", json={"spec": SPEC, "name": "x"})
+            resp = await client.get("/api/v1/runs?q=status:created")
+            assert len((await resp.json())["results"]) == 1
+            resp = await client.get("/api/v1/runs?q=status:running")
+            assert (await resp.json())["results"] == []
+            resp = await client.get("/api/v1/runs?q=bogus")
+            assert resp.status == 400
+            return True
+
+        assert drive(orch, body)

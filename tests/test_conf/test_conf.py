@@ -1,0 +1,53 @@
+"""Conf/options resolution: DB > env > default, TTL cache, typing."""
+
+import pytest
+
+from polyaxon_tpu.conf import ConfService, OPTIONS
+from polyaxon_tpu.conf.service import ConfError
+from polyaxon_tpu.db.registry import RunRegistry
+
+
+@pytest.fixture()
+def reg(tmp_path):
+    r = RunRegistry(tmp_path / "r.db")
+    yield r
+    r.close()
+
+
+class TestConf:
+    def test_default_resolution(self, reg):
+        conf = ConfService(reg)
+        assert conf.get("scheduler.heartbeat_ttl") == 600.0
+
+    def test_env_overrides_default(self, reg, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_SCHEDULER_HEARTBEAT_TTL", "42.5")
+        conf = ConfService(reg)
+        assert conf.get("scheduler.heartbeat_ttl") == 42.5
+
+    def test_db_overrides_env(self, reg, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_SCHEDULER_HEARTBEAT_TTL", "42.5")
+        conf = ConfService(reg)
+        conf.set("scheduler.heartbeat_ttl", 99)
+        assert conf.get("scheduler.heartbeat_ttl") == 99.0  # coerced to float
+
+    def test_cache_and_invalidate(self, reg):
+        conf = ConfService(reg, cache_ttl=3600)
+        assert conf.get("api.page_size") == 100
+        reg.set_option("api.page_size", 5)
+        assert conf.get("api.page_size") == 100  # cached
+        conf.invalidate()
+        assert conf.get("api.page_size") == 5
+
+    def test_unknown_key_raises(self, reg):
+        with pytest.raises(ConfError):
+            ConfService(reg).get("no.such.option")
+
+    def test_unset_restores_fallback(self, reg):
+        conf = ConfService(reg)
+        conf.set("api.page_size", 7)
+        assert conf.get("api.page_size") == 7
+        conf.unset("api.page_size")
+        assert conf.get("api.page_size") == 100
+
+    def test_registry_covers_scheduler_knobs(self):
+        assert {"scheduler.monitor_interval", "scheduler.heartbeat_ttl"} <= set(OPTIONS)

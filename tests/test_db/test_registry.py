@@ -211,3 +211,31 @@ class TestConcurrency:
             assert other.get_run(run.id).status == S.RUNNING
         finally:
             other.close()
+
+
+class TestRetentionCleanup:
+    def test_clean_old_rows(self, tmp_path):
+        import time as _time
+
+        from polyaxon_tpu.db.registry import RunRegistry
+
+        reg = RunRegistry(tmp_path / "clean.db")
+        spec = {"kind": "experiment", "run": {"entrypoint": "x:y"}}
+        old = reg.create_run(spec, name="old")
+        live = reg.create_run(spec, name="live")
+        now = _time.time()
+        reg.add_log(old.id, "ancient", created_at=now - 100)
+        reg.add_log(live.id, "ancient but run not done", created_at=now - 100)
+        reg.record_activity("e.old", {})
+        # finish the old run in the past
+        for s in ("scheduled", "starting", "running", "succeeded"):
+            reg.set_status(old.id, s)
+        with reg._lock, reg._conn() as conn:  # age the finish time
+            conn.execute(
+                "UPDATE runs SET finished_at = ? WHERE id = ?", (now - 100, old.id)
+            )
+        removed = reg.clean_old_rows(50, now=now)
+        assert removed["logs"] == 1  # only the done run's old log
+        assert reg.get_logs(old.id) == []
+        assert len(reg.get_logs(live.id)) == 1
+        reg.close()

@@ -1,0 +1,93 @@
+"""Notifier + stats + resource-sampler units."""
+
+import logging
+
+from polyaxon_tpu.events import Event, EventTypes
+from polyaxon_tpu.monitor.resources import ResourceSampler, sample_process
+from polyaxon_tpu.notifier import CallbackAction, LogAction, Notifier, WebhookAction
+from polyaxon_tpu.notifier.actions import slack_shaper
+from polyaxon_tpu.stats import MemoryStats, NoOpStats
+
+
+class TestNotifier:
+    def test_callback_receives_payload(self):
+        got = []
+        n = Notifier([CallbackAction(got.append)])
+        n(Event(event_type=EventTypes.EXPERIMENT_FAILED, context={"run_id": 7}))
+        assert got == [{"event_type": EventTypes.EXPERIMENT_FAILED, "run_id": 7}]
+
+    def test_event_type_filter(self):
+        got = []
+        n = Notifier(
+            [CallbackAction(got.append)],
+            event_types=[EventTypes.EXPERIMENT_FAILED],
+        )
+        n(Event(event_type=EventTypes.EXPERIMENT_SUCCEEDED, context={"run_id": 1}))
+        assert got == []
+        n(Event(event_type=EventTypes.EXPERIMENT_FAILED, context={"run_id": 2}))
+        assert len(got) == 1
+
+    def test_action_failure_is_swallowed(self):
+        def boom(payload):
+            raise RuntimeError("sink down")
+
+        got = []
+        n = Notifier([CallbackAction(boom), CallbackAction(got.append)])
+        n(Event(event_type=EventTypes.EXPERIMENT_DONE, context={}))
+        assert len(got) == 1  # second action still ran
+
+    def test_webhook_failure_returns_false(self):
+        a = WebhookAction("http://127.0.0.1:1/unroutable", timeout=0.2)
+        assert a.execute({"event_type": "x"}) is False
+
+    def test_slack_shaper(self):
+        msg = slack_shaper({"event_type": "experiment.failed", "run_id": 3})
+        assert "experiment.failed" in msg["text"] and "run_id=3" in msg["text"]
+
+    def test_log_action(self, caplog):
+        with caplog.at_level(logging.INFO):
+            LogAction().execute({"event_type": "e"})
+        assert any("e" in r.message or "e" in str(r.args) for r in caplog.records)
+
+
+class TestStats:
+    def test_memory_backend_aggregates(self):
+        s = MemoryStats()
+        s.incr("tasks")
+        s.incr("tasks", 2)
+        s.gauge("pending", 4.0)
+        with s.timed("spawn"):
+            pass
+        assert s.counters["tasks"] == 3
+        assert s.gauges["pending"] == 4.0
+        assert len(s.timings["spawn"]) == 1
+
+    def test_noop_is_silent(self):
+        s = NoOpStats()
+        s.incr("x")
+        s.gauge("y", 1)
+        with s.timed("z"):
+            pass
+
+
+class TestResources:
+    def test_sample_process_has_rss(self):
+        values = sample_process()
+        assert values.get("sys/rss_mb", 0) > 0
+
+    def test_sampler_reports(self):
+        class Rec:
+            def __init__(self):
+                self.rows = []
+
+            def resources(self, values):
+                self.rows.append(values)
+
+        rec = Rec()
+        s = ResourceSampler(rec, interval=0.05)
+        s.start()
+        import time
+
+        time.sleep(0.2)
+        s.stop()
+        assert rec.rows and "sys/rss_mb" in rec.rows[0]

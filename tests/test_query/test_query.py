@@ -1,0 +1,75 @@
+"""Query DSL: parser + filtering over registry runs.
+
+Parity: reference query tests over ``query/builder.py:18-31`` /
+``query/parser.py`` grammar.
+"""
+
+import pytest
+
+from polyaxon_tpu.db.registry import RunRegistry
+from polyaxon_tpu.query import QueryError, apply_query, parse_query
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "x:y"},
+    "declarations": {"lr": 0.1},
+}
+
+
+class TestParser:
+    def test_basic_forms(self):
+        conds = parse_query("status:running, metric.loss:<0.5, id:1..10, kind:~job, tags:a|b")
+        by_field = {c.field: c for c in conds}
+        assert by_field["status"].op == "eq" and by_field["status"].value == "running"
+        assert by_field["metric.loss"].op == "lt" and by_field["metric.loss"].value == 0.5
+        assert by_field["id"].op == "range" and by_field["id"].value == (1, 10)
+        assert by_field["kind"].negated
+        assert by_field["tags"].op == "in" and by_field["tags"].value == ["a", "b"]
+
+    def test_empty_is_no_conditions(self):
+        assert parse_query(None) == [] and parse_query("  ") == []
+
+    def test_malformed_raises(self):
+        with pytest.raises(QueryError):
+            parse_query("statusrunning")
+        with pytest.raises(QueryError):
+            parse_query("status:")
+
+
+class TestApply:
+    @pytest.fixture()
+    def runs(self, tmp_path):
+        reg = RunRegistry(tmp_path / "r.db")
+        a = reg.create_run(SPEC, name="a", tags=["prod"])
+        b = reg.create_run(SPEC, name="b", tags=["dev"])
+        reg.set_status(b.id, "scheduled")
+        reg.set_status(b.id, "starting")
+        reg.set_status(b.id, "running")
+        reg.add_metric(a.id, {"loss": 0.2})
+        reg.add_metric(b.id, {"loss": 0.9})
+        out = reg.list_runs()
+        yield out
+        reg.close()
+
+    def test_filter_status(self, runs):
+        got = apply_query(runs, "status:running")
+        assert [r.name for r in got] == ["b"]
+
+    def test_filter_metric_comparison(self, runs):
+        got = apply_query(runs, "metric.loss:<0.5")
+        assert [r.name for r in got] == ["a"]
+
+    def test_filter_declarations(self, runs):
+        assert len(apply_query(runs, "declarations.lr:0.1")) == 2
+        assert apply_query(runs, "declarations.lr:>0.5") == []
+
+    def test_filter_tags_and_negation(self, runs):
+        assert [r.name for r in apply_query(runs, "tags:prod")] == ["a"]
+        assert [r.name for r in apply_query(runs, "status:~running")] == ["a"]
+
+    def test_and_semantics(self, runs):
+        assert apply_query(runs, "status:running, metric.loss:<0.5") == []
+
+    def test_unknown_field(self, runs):
+        with pytest.raises(QueryError):
+            apply_query(runs, "nonsense:1")
