@@ -115,6 +115,49 @@ def main() -> None:
     peak = PEAK_FLOPS.get(dev.device_kind, 197e12) * jax.local_device_count()
     mfu = model_flops_per_s / peak if on_tpu else 0.0
 
+    # North-star #2 (BASELINE.md): hpsearch trials/hour — a real sweep
+    # through the orchestrator (create → waves → iterate), workers as
+    # subprocess gangs. Orchestration throughput, not model compute.
+    trials_per_hour = None
+    try:
+        import tempfile
+
+        from polyaxon_tpu.orchestrator import Orchestrator
+
+        orch = Orchestrator(
+            tempfile.mkdtemp(), monitor_interval=0.05, heartbeat_interval=1.0
+        )
+        try:
+            t0 = time.perf_counter()
+            group = orch.submit(
+                {
+                    "kind": "group",
+                    "run": {
+                        "entrypoint": "polyaxon_tpu.builtins.trainers:metric_probe"
+                    },
+                    "environment": {
+                        "topology": {
+                            "accelerator": "cpu-1",
+                            "num_devices": 1,
+                            "num_hosts": 1,
+                        }
+                    },
+                    "hptuning": {
+                        "matrix": {"lr": {"uniform": [0, 1]}},
+                        "concurrency": 2,
+                        "random_search": {"n_experiments": 6, "seed": 0},
+                    },
+                }
+            )
+            done = orch.wait(group.id, timeout=300)
+            sweep_dt = time.perf_counter() - t0
+            if done.status == "succeeded":
+                trials_per_hour = 6 / sweep_dt * 3600
+        finally:
+            orch.stop()
+    except Exception:
+        pass
+
     baseline_path = Path(__file__).parent / "BENCH_BASELINE.json"
     vs_baseline = 1.0
     if on_tpu:
@@ -139,6 +182,9 @@ def main() -> None:
                 "final_loss": round(float(metrics["loss"]), 4),
                 "device": dev.device_kind,
                 "n_params": n_params,
+                "hpsearch_trials_per_hour": (
+                    round(trials_per_hour) if trials_per_hour else None
+                ),
             }
         )
     )

@@ -103,23 +103,27 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         statuses = q.getall("status", []) or None
         limit = int(q.get("limit", 100))
         offset = int(q.get("offset", 0))
-        # The DSL filter must see the full candidate set BEFORE pagination,
-        # or matches past the first page silently vanish.
+        # With a DSL filter the full candidate set is fetched (the filter
+        # must run BEFORE pagination or matches past the first page
+        # vanish); without one, pagination pushes down to SQL.
+        has_query = "q" in q
         runs = reg.list_runs(
             kind=q.get("kind"),
             project=q.get("project"),
             group_id=int(q["group_id"]) if "group_id" in q else None,
             pipeline_id=int(q["pipeline_id"]) if "pipeline_id" in q else None,
             statuses=statuses,
+            limit=None if has_query else limit,
+            offset=0 if has_query else offset,
         )
-        if "q" in q:  # search DSL, e.g. q=status:running,metric.loss:<0.5
+        if has_query:  # search DSL, e.g. q=status:running,metric.loss:<0.5
             from polyaxon_tpu.query import QueryError, apply_query
 
             try:
                 runs = apply_query(runs, q["q"])
             except QueryError as e:
                 return web.json_response({"error": str(e)}, status=400)
-        runs = runs[offset : offset + limit]
+            runs = runs[offset : offset + limit]
         return web.json_response({"results": [run_to_dict(r) for r in runs]})
 
     @routes.get(f"{API_PREFIX}/runs/{{run_id}}")

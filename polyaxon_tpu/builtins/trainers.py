@@ -43,6 +43,82 @@ def flaky_once(ctx: Context) -> None:
     ctx.log_metrics(recovered=1.0)
 
 
+def cnn_train(ctx: Context) -> None:
+    """Train the CNN image classifier (the CIFAR-10 quick-start shape).
+
+    Synthetic class-conditional images (deterministic from the seed) so the
+    distributed benchmark isolates compute+collectives from IO; the model
+    learns them, so accuracy rises — the learnability check the quick-start
+    provides.  Params: steps, batch, image_size, classes, lr, and channels.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from polyaxon_tpu.models import cnn
+    from polyaxon_tpu.parallel import template_for
+    from polyaxon_tpu.runtime.train import build_train_step
+
+    steps = int(ctx.get_param("steps", 20))
+    batch_size = int(ctx.get_param("batch", 64))
+    image_size = int(ctx.get_param("image_size", 32))
+    n_classes = int(ctx.get_param("classes", 10))
+    lr = float(ctx.get_param("lr", 1e-3))
+    channels = tuple(ctx.get_param("channels", (64, 128, 256)))
+    cfg = cnn.CNNConfig(
+        image_size=image_size, n_classes=n_classes, channels=channels
+    )
+
+    mesh = ctx.mesh
+    if mesh is None:
+        from polyaxon_tpu.runtime.mesh import build_mesh
+
+        mesh = build_mesh({"data": jax.device_count()})
+    template = template_for(ctx.strategy, dict(mesh.shape), ctx.strategy_options)
+
+    ts = build_train_step(
+        loss_fn=lambda p, b: cnn.loss_fn(p, b, cfg),
+        init_fn=lambda k: cnn.init_params(k, cfg),
+        axes_tree=cnn.param_axes(cfg),
+        optimizer=optax.adamw(lr),
+        mesh=mesh,
+        template=template,
+    )
+    key = jax.random.PRNGKey(ctx.seed or 0)
+    params, opt_state = ts.init(key)
+
+    # Class-conditional synthetic images: class k = noisy template k.
+    rng = np.random.default_rng(ctx.seed or 0)
+    templates = rng.normal(size=(n_classes, image_size, image_size, 3)).astype(
+        np.float32
+    )
+    labels = rng.integers(0, n_classes, batch_size)
+    images = templates[labels] + 0.3 * rng.normal(
+        size=(batch_size, image_size, image_size, 3)
+    ).astype(np.float32)
+    batch = ts.place_batch(
+        {"images": jnp.asarray(images), "labels": jnp.asarray(labels)}
+    )
+
+    acc_fn = jax.jit(lambda p, b: cnn.accuracy(p, b, cfg))
+    t0 = time.time()
+    metrics = None
+    for i in range(steps):
+        params, opt_state, metrics = ts.step(params, opt_state, batch, key)
+        if ctx.is_leader and (i % 10 == 0 or i == steps - 1):
+            ctx.log_metrics(step=i, loss=float(metrics["loss"]))
+    dt = time.time() - t0
+    if ctx.is_leader:
+        acc = float(acc_fn(params, batch))
+        ips = steps * batch_size / dt
+        ctx.log_metrics(step=steps, accuracy=acc, images_per_s=ips)
+        ctx.log_text(
+            f"cnn_train done: {steps} steps, strategy={template.name}, "
+            f"loss {float(metrics['loss']):.4f}, acc {acc:.3f}, {ips:.0f} img/s"
+        )
+
+
 def metric_probe(ctx: Context) -> None:
     """Report a deterministic metric of the hyperparams (hpsearch probe).
 

@@ -220,7 +220,12 @@ def main(argv=None) -> int:
     if args.command == "serve":
         from polyaxon_tpu.api.app import serve
 
-        serve(str(Path(args.base_dir).expanduser()), host=args.bind, port=args.port)
+        serve(
+            str(Path(args.base_dir).expanduser()),
+            host=args.bind,
+            port=args.port,
+            auth_token=args.token,
+        )
         return 0
 
     client = _client(args)

@@ -1,0 +1,120 @@
+"""Image-classifier CNN: the quick-start CIFAR/MNIST workload family.
+
+Parity: the reference's north-star workloads are its quick-start tutorials
+(TF MNIST / CIFAR-10 distributed — ``docs/guides/training-cifar10.md``,
+BASELINE.md configs); the platform itself ships no models.  Here the
+workload is first-class: a pure-JAX conv net (NHWC, bf16 matmul-heavy
+conv + dense head) sharing the logical-axis vocabulary so the same
+dp/fsdp templates apply.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class CNNConfig:
+    image_size: int = 32
+    in_channels: int = 3
+    channels: Tuple[int, ...] = (64, 128, 256)
+    n_classes: int = 10
+    dense_dim: int = 256
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def n_params(self) -> int:
+        total = 0
+        cin = self.in_channels
+        for cout in self.channels:
+            total += 3 * 3 * cin * cout + cout
+            cin = cout
+        spatial = self.image_size // (2 ** len(self.channels))
+        flat = spatial * spatial * self.channels[-1]
+        total += flat * self.dense_dim + self.dense_dim
+        total += self.dense_dim * self.n_classes + self.n_classes
+        return total
+
+
+def param_axes(cfg: CNNConfig) -> Dict[str, Any]:
+    """Logical axes: conv output channels / dense hidden map to ``embed``
+    so the fsdp template shards them; everything else replicates."""
+    axes: Dict[str, Any] = {}
+    for i in range(len(cfg.channels)):
+        axes[f"conv{i}"] = {"w": (None, None, None, "embed"), "b": ("embed",)}
+    axes["dense"] = {"w": (None, "embed"), "b": ("embed",)}
+    axes["head"] = {"w": ("embed", "vocab"), "b": ("vocab",)}
+    return axes
+
+
+def init_params(key: jax.Array, cfg: CNNConfig) -> Dict[str, Any]:
+    params: Dict[str, Any] = {}
+    keys = jax.random.split(key, len(cfg.channels) + 2)
+    cin = cfg.in_channels
+    for i, cout in enumerate(cfg.channels):
+        fan_in = 3 * 3 * cin
+        params[f"conv{i}"] = {
+            "w": jax.random.normal(keys[i], (3, 3, cin, cout), cfg.param_dtype)
+            * (2.0 / fan_in) ** 0.5,
+            "b": jnp.zeros((cout,), cfg.param_dtype),
+        }
+        cin = cout
+    spatial = cfg.image_size // (2 ** len(cfg.channels))
+    flat = spatial * spatial * cfg.channels[-1]
+    params["dense"] = {
+        "w": jax.random.normal(keys[-2], (flat, cfg.dense_dim), cfg.param_dtype)
+        * (2.0 / flat) ** 0.5,
+        "b": jnp.zeros((cfg.dense_dim,), cfg.param_dtype),
+    }
+    params["head"] = {
+        "w": jax.random.normal(keys[-1], (cfg.dense_dim, cfg.n_classes), cfg.param_dtype)
+        * cfg.dense_dim**-0.5,
+        "b": jnp.zeros((cfg.n_classes,), cfg.param_dtype),
+    }
+    return params
+
+
+def forward(params: Dict[str, Any], images: jax.Array, cfg: CNNConfig) -> jax.Array:
+    """images [B,H,W,C] → logits [B,n_classes] (float32)."""
+    x = images.astype(cfg.dtype)
+    for i in range(len(cfg.channels)):
+        layer = params[f"conv{i}"]
+        x = lax.conv_general_dilated(
+            x,
+            layer["w"].astype(cfg.dtype),
+            window_strides=(1, 1),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + layer["b"].astype(cfg.dtype)
+        x = jax.nn.relu(x)
+        x = lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+        )
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(
+        x @ params["dense"]["w"].astype(cfg.dtype) + params["dense"]["b"].astype(cfg.dtype)
+    )
+    logits = x @ params["head"]["w"].astype(cfg.dtype) + params["head"]["b"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(
+    params: Dict[str, Any], batch: Dict[str, jax.Array], cfg: CNNConfig
+) -> jax.Array:
+    logits = forward(params, batch["images"], cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["labels"][:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def accuracy(
+    params: Dict[str, Any], batch: Dict[str, jax.Array], cfg: CNNConfig
+) -> jax.Array:
+    logits = forward(params, batch["images"], cfg)
+    return jnp.mean((jnp.argmax(logits, -1) == batch["labels"]).astype(jnp.float32))

@@ -16,18 +16,20 @@ import threading
 from typing import Any, Dict, Optional
 
 
-def sample_process() -> Dict[str, float]:
-    """CPU / memory of the calling process (psutil if present)."""
+def sample_process(pid: Optional[int] = None) -> Dict[str, float]:
+    """CPU / memory of the given (default: calling) process."""
     out: Dict[str, float] = {}
     try:
         import psutil
 
-        p = psutil.Process()
+        p = psutil.Process(pid)
         with p.oneshot():
             out["sys/cpu_percent"] = p.cpu_percent(interval=None)
             out["sys/rss_mb"] = p.memory_info().rss / 1e6
             out["sys/threads"] = float(p.num_threads())
     except Exception:
+        if pid is not None:
+            return out  # target process gone; report nothing rather than self
         try:
             import resource
 
@@ -64,11 +66,15 @@ class ResourceSampler:
     def __init__(self, reporter, interval: float = 10.0) -> None:
         self.reporter = reporter
         self.interval = interval
+        #: When set, sample this pid instead of the calling process — the
+        #: shell-command path points this at the user's subprocess, so
+        #: telemetry reflects the workload, not the idle wrapper.
+        self.pid: Optional[int] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def sample_once(self) -> Dict[str, Any]:
-        values = sample_process()
+        values = sample_process(self.pid)
         values.update(sample_devices())
         return values
 

@@ -24,6 +24,11 @@ class Action:
     """One notification sink. Subclasses implement ``_execute``."""
 
     name = "action"
+    #: True = the notifier dispatches this action on a background thread —
+    #: required for network sinks, which must never stall the task-bus
+    #: thread that records events (the reference offloaded these to a
+    #: celery worker hop).
+    async_dispatch = False
 
     def execute(self, payload: Payload) -> bool:
         try:
@@ -68,6 +73,7 @@ def slack_shaper(payload: Payload) -> Payload:
 
 class WebhookAction(Action):
     name = "webhook"
+    async_dispatch = True
 
     def __init__(
         self,

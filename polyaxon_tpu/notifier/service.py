@@ -7,6 +7,7 @@ it subscribes to the auditor directly (the celery hop collapses away).
 
 from __future__ import annotations
 
+import threading
 from typing import Iterable, List, Optional, Sequence
 
 from polyaxon_tpu.events import Event
@@ -14,7 +15,12 @@ from polyaxon_tpu.notifier.actions import Action
 
 
 class Notifier:
-    """Subscribe to an :class:`~polyaxon_tpu.auditor.Auditor`."""
+    """Subscribe to an :class:`~polyaxon_tpu.auditor.Auditor`.
+
+    Actions flagged ``async_dispatch`` (network sinks) run on daemon
+    threads so a slow/unreachable endpoint can't stall the bus thread
+    recording the event.
+    """
 
     def __init__(
         self,
@@ -30,4 +36,12 @@ class Notifier:
             return
         payload = {"event_type": event.event_type, **event.context}
         for action in self.actions:
-            action.execute(payload)
+            if action.async_dispatch:
+                threading.Thread(
+                    target=action.execute,
+                    args=(payload,),
+                    name=f"notify-{action.name}",
+                    daemon=True,
+                ).start()
+            else:
+                action.execute(payload)

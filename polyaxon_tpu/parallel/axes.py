@@ -36,7 +36,7 @@ def logical_to_spec(axes: Sequence[str], rules: AxisRules, mesh_axes=None):
     entries = []
     used: set = set()
     for name in axes:
-        target = rules.get(name)
+        target = rules.get(name) if name is not None else None
         if target is None:
             entries.append(None)
             continue
@@ -63,7 +63,10 @@ def tree_specs(axes_tree: Any, rules: AxisRules, mesh_axes=None):
     return jax.tree.map(
         lambda axes: logical_to_spec(axes, rules, mesh_axes),
         axes_tree,
-        is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(e, str) for e in x),
+        # A leaf is one tensor's logical-axes tuple; entries may be None
+        # (explicitly-replicated dims).
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(e is None or isinstance(e, str) for e in x),
     )
 
 

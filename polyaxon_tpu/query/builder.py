@@ -50,7 +50,10 @@ def _matches(run: Run, cond: Condition) -> bool:
         result = actual in cond.value
     elif cond.op == "range":
         lo, hi = cond.value
-        result = lo <= actual <= hi
+        try:
+            result = lo <= actual <= hi
+        except TypeError:
+            result = False
     else:
         try:
             result = {

@@ -13,7 +13,9 @@ Parity: reference ``query/parser.py`` + condition types
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass
+from datetime import datetime
 from typing import Any, List, Optional, Tuple
 
 from polyaxon_tpu.exceptions import PolyaxonTPUError
@@ -32,6 +34,9 @@ class Condition:
     negated: bool = False
 
 
+_DATE_RE = re.compile(r"^\d{4}-\d{2}-\d{2}([T ].+)?$")
+
+
 def _coerce(raw: str) -> Any:
     raw = raw.strip()
     try:
@@ -45,6 +50,12 @@ def _coerce(raw: str) -> Any:
     lowered = raw.lower()
     if lowered in ("true", "false"):
         return lowered == "true"
+    if _DATE_RE.match(raw):
+        # Date comparisons target epoch-float columns (created_at, ...).
+        try:
+            return datetime.fromisoformat(raw).timestamp()
+        except ValueError:
+            pass
     return raw
 
 

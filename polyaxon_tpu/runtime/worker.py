@@ -68,9 +68,13 @@ def _init_distributed(info) -> bool:
     return True
 
 
-def _run_cmd(cmd: str, env: dict, cwd: str) -> int:
-    proc = subprocess.run(cmd, shell=True, env=env, cwd=cwd)
-    return proc.returncode
+def _run_cmd(cmd: str, env: dict, cwd: str, sampler=None) -> int:
+    proc = subprocess.Popen(cmd, shell=True, env=env, cwd=cwd)
+    if sampler is not None:
+        # Telemetry must describe the workload, not this idle wrapper.
+        sampler.pid = proc.pid
+        sampler.start()
+    return proc.wait()
 
 
 def main() -> int:
@@ -110,12 +114,12 @@ def main() -> int:
         if run_cfg.cmd is not None:
             # Shell command path: the distributed bootstrap belongs to the
             # command itself (it can read the same env contract).
-            sampler.start()
             reporter.status("running")
             rc = _run_cmd(
                 run_cfg.cmd,
                 env=dict(os.environ),
                 cwd=str(code_dir if code_dir.exists() else paths.root),
+                sampler=sampler,
             )
             if rc == 0:
                 reporter.status("succeeded")

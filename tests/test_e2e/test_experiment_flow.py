@@ -149,3 +149,67 @@ class TestExperimentFlow:
         assert done.status == S.STOPPED
         history = [s["status"] for s in orch.registry.get_statuses(run.id)]
         assert S.STOPPING in history
+
+
+@pytest.mark.e2e
+class TestCNNWorkload:
+    def test_cnn_distributed_learns(self, orch):
+        # The CIFAR-10 quick-start shape (BASELINE.md north-star config):
+        # conv net, data-parallel over the virtual slice.
+        run = orch.submit(
+            spec_for(
+                "cnn_train",
+                devices=4,
+                declarations={
+                    "steps": 25,
+                    "batch": 32,
+                    "image_size": 16,
+                    "classes": 4,
+                    "channels": [8, 16],
+                    "lr": 3e-3,
+                },
+                seed=3,
+            ),
+            name="cnn-e2e",
+        )
+        done = orch.wait(run.id, timeout=180)
+        assert done.status == S.SUCCEEDED, orch.registry.get_logs(run.id)
+        assert done.last_metric["accuracy"] > 0.5  # learned the templates
+        assert done.last_metric["images_per_s"] > 0
+
+
+@pytest.mark.e2e
+class TestZombieDetection:
+    def test_heartbeatless_run_is_failed_by_cron(self, tmp_path):
+        # Parity: reference zombie cron (crons/tasks/heartbeats.py +
+        # scheduler/tasks/experiments.py:111-120). Heartbeats disabled →
+        # the run goes RUNNING with no pulse → the cron declares it zombie,
+        # kills the gang, and fails the run.
+        import time as _time
+
+        from polyaxon_tpu.workers import CronTasks
+
+        orch = Orchestrator(
+            tmp_path / "plat",
+            monitor_interval=0.1,
+            heartbeat_interval=0.0,  # no worker heartbeats at all
+            heartbeat_ttl=1.0,
+        )
+        try:
+            run = orch.submit(spec_for("sleepy", declarations={"seconds": 120}))
+            for _ in range(300):
+                orch.pump(max_wait=0.1)
+                if orch.get_run(run.id).status == S.RUNNING:
+                    break
+            assert orch.get_run(run.id).status == S.RUNNING
+            _time.sleep(1.2)  # let the (absent) heartbeat go stale
+            orch.bus.send(CronTasks.HEARTBEAT_CHECK, {})
+            done = orch.wait(run.id, timeout=30)
+            assert done.status == S.FAILED
+            statuses = orch.registry.get_statuses(run.id)
+            assert any("zombie" in (s["message"] or "") for s in statuses)
+            assert orch.registry.get_activities("experiment.zombie")
+            handle = orch.ctx.gangs.get(run.id)
+            assert handle is None or handle.all_exited
+        finally:
+            orch.stop()

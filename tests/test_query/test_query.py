@@ -73,3 +73,21 @@ class TestApply:
     def test_unknown_field(self, runs):
         with pytest.raises(QueryError):
             apply_query(runs, "nonsense:1")
+
+
+class TestDateAndRangeEdges:
+    def test_date_comparison_coerces_to_epoch(self, tmp_path):
+        from datetime import datetime
+
+        from polyaxon_tpu.query.parser import parse_query
+
+        (cond,) = parse_query("created_at:>=2020-01-01")
+        assert cond.value == datetime.fromisoformat("2020-01-01").timestamp()
+
+    def test_noncomparable_range_matches_nothing(self, tmp_path):
+        reg = RunRegistry(tmp_path / "r.db")
+        reg.create_run(SPEC, name="a")
+        runs = reg.list_runs()
+        # string bounds against a float column: no crash, no match
+        assert apply_query(runs, "created_at:a..b") == []
+        reg.close()
