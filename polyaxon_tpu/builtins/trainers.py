@@ -109,8 +109,10 @@ def cnn_train(ctx: Context) -> None:
         if ctx.is_leader and (i % 10 == 0 or i == steps - 1):
             ctx.log_metrics(step=i, loss=float(metrics["loss"]))
     dt = time.time() - t0
+    # Every process must join the (global-array) accuracy computation —
+    # leader-only dispatch would deadlock multi-host gangs.
+    acc = float(acc_fn(params, batch))
     if ctx.is_leader:
-        acc = float(acc_fn(params, batch))
         ips = steps * batch_size / dt
         ctx.log_metrics(step=steps, accuracy=acc, images_per_s=ips)
         ctx.log_text(

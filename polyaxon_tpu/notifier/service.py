@@ -30,6 +30,7 @@ class Notifier:
         self.actions: List[Action] = list(actions)
         #: None = all events; else a whitelist
         self.event_types = set(event_types) if event_types is not None else None
+        self._inflight: List[threading.Thread] = []
 
     def __call__(self, event: Event) -> None:
         if self.event_types is not None and event.event_type not in self.event_types:
@@ -37,11 +38,24 @@ class Notifier:
         payload = {"event_type": event.event_type, **event.context}
         for action in self.actions:
             if action.async_dispatch:
-                threading.Thread(
+                t = threading.Thread(
                     target=action.execute,
                     args=(payload,),
                     name=f"notify-{action.name}",
                     daemon=True,
-                ).start()
+                )
+                t.start()
+                self._inflight = [x for x in self._inflight if x.is_alive()]
+                self._inflight.append(t)
             else:
                 action.execute(payload)
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Wait for in-flight async notifications (call before exit, or the
+        terminal-event webhook dies with the process)."""
+        import time
+
+        deadline = time.time() + timeout
+        for t in self._inflight:
+            t.join(timeout=max(0.0, deadline - time.time()))
+        self._inflight = [x for x in self._inflight if x.is_alive()]

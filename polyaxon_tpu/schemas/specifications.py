@@ -160,6 +160,14 @@ class ServiceSpecification(BaseSpecification):
             raise ValueError(f"Expected kind=notebook|tensorboard, got {v!r}")
         return v
 
+    def resolved_run(self) -> RunConfig:
+        """Run section with declarations interpolated (same contract as
+        experiments — services routinely template their serving port)."""
+        if self.run is None:
+            raise ValueError(f"Service spec {self.kind!r} has no run section")
+        data = self.run.model_dump()
+        return RunConfig.model_validate(interpolate(data, self.declarations))
+
 
 class GroupSpecification(BaseSpecification):
     """An hptuning sweep over an experiment template.

@@ -140,6 +140,9 @@ class LocalGangSpawner:
                     stdout=log_fh,
                     stderr=subprocess.STDOUT,
                     cwd=str(paths.root),
+                    # Own process group: stop() must take down the whole
+                    # tree (shell-command runs spawn sh → user process).
+                    start_new_session=True,
                 )
                 log_fh.close()  # child holds the fd
                 handle.processes[process_id] = proc
@@ -149,15 +152,27 @@ class LocalGangSpawner:
         return handle
 
     def stop(self, handle: GangHandle, grace: float = 5.0) -> None:
-        """Terminate the gang: SIGTERM, wait ``grace``, then SIGKILL."""
+        """Terminate the gang (whole process groups): SIGTERM, wait
+        ``grace``, then SIGKILL."""
+        import signal
+
+        def signal_group(proc: subprocess.Popen, sig: int) -> None:
+            try:
+                os.killpg(proc.pid, sig)  # pgid == pid (start_new_session)
+            except (ProcessLookupError, PermissionError, OSError):
+                try:
+                    proc.send_signal(sig)
+                except (ProcessLookupError, OSError):
+                    pass
+
         for proc in handle.processes.values():
             if proc.poll() is None:
-                proc.terminate()
+                signal_group(proc, signal.SIGTERM)
         deadline = time.time() + grace
         for proc in handle.processes.values():
             remaining = max(0.0, deadline - time.time())
             try:
                 proc.wait(timeout=remaining)
             except subprocess.TimeoutExpired:
-                proc.kill()
+                signal_group(proc, signal.SIGKILL)
                 proc.wait(timeout=5.0)

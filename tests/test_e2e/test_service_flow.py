@@ -1,0 +1,75 @@
+"""Long-running service runs (notebook / tensorboard kinds).
+
+Parity: reference ``polypod/notebook.py:35`` / ``tensorboard.py:32`` —
+plugin deployments that stay RUNNING until stopped.  Here a service is a
+gang whose command serves until the platform stops it.
+"""
+
+import socket
+import urllib.request
+
+import pytest
+
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.orchestrator import Orchestrator
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(
+        tmp_path / "plat",
+        monitor_interval=0.1,
+        heartbeat_interval=0.5,
+        heartbeat_ttl=60.0,
+    )
+    yield o
+    o.stop()
+
+
+@pytest.mark.e2e
+class TestServiceFlow:
+    def test_service_runs_until_stopped(self, orch):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        run = orch.submit(
+            {
+                "kind": "notebook",
+                "run": {"cmd": "python -m http.server {{port}} --bind 127.0.0.1"},
+                "declarations": {"port": port},
+                "environment": {
+                    "topology": {
+                        "accelerator": "cpu-1",
+                        "num_devices": 1,
+                        "num_hosts": 1,
+                    }
+                },
+            },
+            name="svc",
+        )
+        # Drive until the HTTP server answers — the service is genuinely up.
+        served = False
+        for _ in range(300):
+            orch.pump(max_wait=0.1)
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/", timeout=0.3
+                ) as resp:
+                    served = resp.status == 200
+                    break
+            except OSError:
+                continue
+        assert served, orch.registry.get_logs(run.id)
+        # the monitor may not have ingested the "running" report yet
+        for _ in range(100):
+            orch.pump(max_wait=0.1)
+            if orch.get_run(run.id).status == S.RUNNING:
+                break
+        assert orch.get_run(run.id).status == S.RUNNING
+
+        orch.stop_run(run.id)
+        done = orch.wait(run.id, timeout=30)
+        assert done.status == S.STOPPED
+        # the server is actually gone
+        with pytest.raises(OSError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=0.5)
