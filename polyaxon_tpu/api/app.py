@@ -58,6 +58,18 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
     routes = web.RouteTableDef()
     reg: RunRegistry = orch.registry
 
+    def _int_param(request, name: str, default: Optional[int] = None) -> Optional[int]:
+        raw = request.rel_url.query.get(name)
+        if raw is None:
+            return default
+        try:
+            return int(raw)
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": f"query param {name!r} must be an integer"}),
+                content_type="application/json",
+            )
+
     def _run_or_404(request) -> Run:
         try:
             return reg.get_run(int(request.match_info["run_id"]))
@@ -101,8 +113,8 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
     async def list_runs(request):
         q = request.rel_url.query
         statuses = q.getall("status", []) or None
-        limit = int(q.get("limit", 100))
-        offset = int(q.get("offset", 0))
+        limit = _int_param(request, "limit", 100)
+        offset = _int_param(request, "offset", 0)
         # With a DSL filter the full candidate set is fetched (the filter
         # must run BEFORE pagination or matches past the first page
         # vanish); without one, pagination pushes down to SQL.
@@ -110,8 +122,8 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         runs = reg.list_runs(
             kind=q.get("kind"),
             project=q.get("project"),
-            group_id=int(q["group_id"]) if "group_id" in q else None,
-            pipeline_id=int(q["pipeline_id"]) if "pipeline_id" in q else None,
+            group_id=_int_param(request, "group_id"),
+            pipeline_id=_int_param(request, "pipeline_id"),
             statuses=statuses,
             limit=None if has_query else limit,
             offset=0 if has_query else offset,
@@ -163,7 +175,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
     @routes.get(f"{API_PREFIX}/runs/{{run_id}}/metrics")
     async def get_metrics(request):
         run = _run_or_404(request)
-        since = int(request.rel_url.query.get("since_id", 0))
+        since = _int_param(request, "since_id", 0)
         return web.json_response({"results": reg.get_metrics(run.id, since_id=since)})
 
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/metrics")
@@ -177,11 +189,10 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
     @routes.get(f"{API_PREFIX}/runs/{{run_id}}/logs")
     async def get_logs(request):
         run = _run_or_404(request)
-        q = request.rel_url.query
         rows = reg.get_logs(
             run.id,
-            since_id=int(q.get("since_id", 0)),
-            limit=int(q["limit"]) if "limit" in q else None,
+            since_id=_int_param(request, "since_id", 0),
+            limit=_int_param(request, "limit"),
         )
         return web.json_response({"results": rows})
 
@@ -242,8 +253,15 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         # token the user supplies once via ?token=.
         open_paths = ("/", f"{API_PREFIX}/status")
         if auth_token and request.path not in open_paths:
+            import hmac
+
             supplied = request.headers.get("Authorization", "")
-            if supplied != f"Bearer {auth_token}":
+            # Compare bytes: compare_digest(str, str) raises on non-ASCII,
+            # which would turn a garbage header into a 500 instead of a 401.
+            expected = f"Bearer {auth_token}".encode()
+            if not hmac.compare_digest(
+                supplied.encode("utf-8", "surrogateescape"), expected
+            ):
                 return web.json_response({"error": "unauthorized"}, status=401)
         return await handler(request)
 

@@ -51,6 +51,10 @@ _ALL = [
            "zombie-check cron cadence (reference beat: 600s)"),
     Option("scheduler.terminal_grace", float, 10.0,
            "grace before force-stopping a logically-done gang"),
+    Option("scheduler.monitor_failure_streak", int, 25,
+           "consecutive monitor-poll failures before a run is failed"),
+    Option("scheduler.queued_redispatch_ttl", float, 60.0,
+           "age before a run stranded in QUEUED is re-dispatched"),
     Option("worker.heartbeat_interval", float, 5.0,
            "in-process heartbeat cadence (reference sidecar poll: 2s)"),
     Option("spawner.default_accelerator", str, "cpu",

@@ -547,6 +547,24 @@ class RunRegistry:
             if run.lifecycle.needs_heartbeat(run.status)
         ]
 
+    def stale_queued_runs(
+        self, ttl_seconds: float, now: Optional[float] = None
+    ) -> List[Run]:
+        """Runs stuck in QUEUED past ``ttl_seconds`` since their last write.
+
+        The QUEUED dispatch mark trades the old re-dispatch self-healing for
+        debounce; if the dispatched build/start task is ever dropped (task
+        error — the bus dead-letters non-Retry exceptions), the run would
+        otherwise sit QUEUED forever with the group/pipeline waiting on it.
+        The cron re-dispatches these.
+        """
+        now = now or time.time()
+        rows = self._conn().execute(
+            "SELECT * FROM runs WHERE status = ? AND ? - updated_at > ?",
+            (S.QUEUED, now, ttl_seconds),
+        ).fetchall()
+        return list(map(_row_to_run, rows))
+
     # -- iterations (hpsearch) ------------------------------------------------
     def create_iteration(self, group_id: int, data: Dict[str, Any]) -> int:
         now = time.time()

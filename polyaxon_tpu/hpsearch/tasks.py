@@ -136,7 +136,15 @@ def register_hp_tasks(ctx: HPContext) -> None:
         pending = [t for t in trials if t.status == S.CREATED]
         window = max(0, hptuning.concurrency - len(running))
         for t in pending[:window]:
-            bus.send(SchedulerTasks.EXPERIMENTS_BUILD, {"run_id": t.id})
+            # Mark the trial dispatched BEFORE sending: a trial sitting in
+            # the bus queue must not look pending to the next HP_START
+            # (every EXPERIMENT_DONE fires one) or back-to-back waves
+            # double-dispatch it. The reference debounced this with Redis
+            # GroupChecks (``hpsearch/tasks/base.py:93-104``); a QUEUED
+            # status is the single-process equivalent and also feeds the
+            # dashboard.
+            if reg.set_status(t.id, S.QUEUED):
+                bus.send(SchedulerTasks.EXPERIMENTS_BUILD, {"run_id": t.id})
         if not pending and not running:
             bus.send(HPTasks.ITERATE, {"group_id": group_id})
 
@@ -155,14 +163,18 @@ def register_hp_tasks(ctx: HPContext) -> None:
         data = iteration["data"] if iteration else {}
         trial_ids = data.get("trial_ids", [])
         id_to_run = {t.id: t for t in trials}
-        wave_runs = [id_to_run[i] for i in trial_ids if i in id_to_run]
 
         if algo == SearchAlgorithms.HYPERBAND:
             assert isinstance(manager, HyperbandSearchManager)
             it = data.get("iteration", 0)
             bi = data.get("bracket_iteration", 0)
             metric = hptuning.hyperband.metric
-            metrics = [_metric_value(r, metric.name) for r in wave_runs]
+            # Aligned to trial_ids (None placeholders for vanished runs) so
+            # reduce_configs zips each config with ITS trial's metric.
+            metrics = [
+                _metric_value(id_to_run[i], metric.name) if i in id_to_run else None
+                for i in trial_ids
+            ]
             configs = data.get("configs", [])
             if manager.should_reduce_configs(it, bi):
                 survivors = manager.reduce_configs(it, bi, configs, metrics)

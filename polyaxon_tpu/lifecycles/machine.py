@@ -20,6 +20,7 @@ class StatusOptions:
 
     CREATED = "created"
     RESUMING = "resuming"
+    QUEUED = "queued"
     BUILDING = "building"
     SCHEDULED = "scheduled"
     UNSCHEDULABLE = "unschedulable"

@@ -21,10 +21,11 @@ from polyaxon_tpu.lifecycles.machine import LifeCycle, StatusOptions
 
 S = StatusOptions
 
-#: Experiments: full machine incl. BUILDING (code snapshot) and RESUMING.
+#: Experiments: full machine incl. QUEUED (dispatched into the build→start
+#: chain or awaiting device admission), BUILDING (code snapshot), RESUMING.
 ExperimentLifeCycle = LifeCycle(
     pending=(S.CREATED, S.RESUMING),
-    preparing=(S.BUILDING,),
+    preparing=(S.QUEUED, S.BUILDING),
     running=(S.SCHEDULED, S.STARTING, S.RUNNING, S.STOPPING),
     done=(S.SUCCEEDED, S.FAILED, S.UPSTREAM_FAILED, S.STOPPED, S.SKIPPED),
     transient=(S.WARNING, S.UNKNOWN, S.UNSCHEDULABLE),

@@ -92,4 +92,5 @@ class WebhookAction(Action):
         req = urllib.request.Request(
             self.url, data=json.dumps(body, default=str).encode(), headers=self.headers
         )
-        urllib.request.urlopen(req, timeout=self.timeout)
+        with urllib.request.urlopen(req, timeout=self.timeout):
+            pass

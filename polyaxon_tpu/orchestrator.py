@@ -118,6 +118,8 @@ class Orchestrator:
             monitor_interval=monitor_interval,
             heartbeat_ttl=heartbeat_ttl,
             terminal_grace=conf.get("scheduler.terminal_grace"),
+            monitor_failure_streak=conf.get("scheduler.monitor_failure_streak"),
+            queued_redispatch_ttl=conf.get("scheduler.queued_redispatch_ttl"),
         )
         register_scheduler_tasks(self.ctx)
         from polyaxon_tpu.hpsearch import HPContext, register_hp_tasks

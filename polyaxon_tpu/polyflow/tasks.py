@@ -120,7 +120,10 @@ def register_pipeline_tasks(ctx: PipelineContext) -> None:
             else len(ready)
         )
         for name in sorted(ready)[:window]:
-            bus.send(SchedulerTasks.EXPERIMENTS_BUILD, {"run_id": ops[name].id})
+            # QUEUED before send: back-to-back CHECKs (one per OPERATION_DONE)
+            # must not double-dispatch an op still sitting in the bus queue.
+            if reg.set_status(ops[name].id, S.QUEUED):
+                bus.send(SchedulerTasks.EXPERIMENTS_BUILD, {"run_id": ops[name].id})
 
         if all(r.is_done for r in ops.values()) and len(ops) == len(dag):
             status = (

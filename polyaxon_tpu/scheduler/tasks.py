@@ -47,6 +47,10 @@ class SchedulerContext:
     #: How long a logically-done gang may keep live members before the
     #: spawner forces them down (survivors hung in collectives).
     terminal_grace: float = 10.0
+    #: Consecutive monitor-poll failures before the run is failed outright.
+    monitor_failure_streak: int = 25
+    #: How long a run may sit in QUEUED before the cron re-dispatches it.
+    queued_redispatch_ttl: float = 60.0
 
 
 def _record_done(ctx: SchedulerContext, run_id: int, status: str) -> None:
@@ -149,7 +153,7 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             # sustained failure streak and fail the run explicitly.
             logger.exception("Monitor poll failed for run %s", run_id)
             handle.monitor_failures += 1
-            if handle.monitor_failures >= 25:
+            if handle.monitor_failures >= ctx.monitor_failure_streak:
                 ctx.gangs.pop(run_id, None)
                 ctx.spawner.stop(handle)
                 reg.set_status(run_id, S.FAILED, message="monitor failed repeatedly")
@@ -167,16 +171,26 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
         if rollup in (S.SUCCEEDED, S.FAILED, S.SKIPPED) and not handle.all_exited:
             # Gang is logically done but members are still alive — typically
             # a survivor blocked in a collective on a dead peer. Give the
-            # gang a grace window to drain, then force it down; otherwise the
-            # run would sit RUNNING forever (the survivor keeps heartbeating,
-            # so the zombie cron can't catch it either).
-            now = time.time()
+            # gang a grace window to drain, then escalate TERM → KILL across
+            # monitor ticks (never a blocking wait — a 5s spawner grace per
+            # stuck gang would stall every other task on the bus thread);
+            # otherwise the run would sit RUNNING forever (the survivor
+            # keeps heartbeating, so the zombie cron can't catch it either).
+            import signal
+
+            now = time.monotonic()
             if handle.terminal_since is None:
                 handle.terminal_since = now
-            if now - handle.terminal_since < ctx.terminal_grace:
-                _reschedule_monitor(run_id)
-                return
-            ctx.spawner.stop(handle)
+            # Grace windows ride the bus clock: time_scale compresses them
+            # in tests exactly like every countdown.
+            grace = ctx.terminal_grace * ctx.bus.time_scale
+            elapsed = now - handle.terminal_since
+            if elapsed >= 2 * grace:
+                ctx.spawner.signal_gang(handle, signal.SIGKILL)
+            elif elapsed >= grace:
+                ctx.spawner.signal_gang(handle, signal.SIGTERM)
+            _reschedule_monitor(run_id)
+            return
         if rollup in (S.SUCCEEDED, S.FAILED, S.SKIPPED):
             # One final ingest now that every process flushed and exited.
             ctx.watcher.ingest(handle)
@@ -234,6 +248,13 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
 
     @bus.register(CronTasks.HEARTBEAT_CHECK)
     def heartbeat_check() -> None:
+        # Heal runs stranded in QUEUED (their dispatched build/start task was
+        # dead-lettered): re-enter the chain. EXPERIMENTS_BUILD/START are
+        # idempotent under the lifecycle gate, so a re-dispatch can't
+        # double-start a gang.
+        for run in reg.stale_queued_runs(ctx.queued_redispatch_ttl):
+            logger.warning("Re-dispatching run %s stranded in queued", run.id)
+            bus.send(SchedulerTasks.EXPERIMENTS_BUILD, {"run_id": run.id})
         for run in reg.zombie_runs(ctx.heartbeat_ttl):
             ctx.auditor.record(EventTypes.EXPERIMENT_ZOMBIE, run_id=run.id)
             handle = ctx.gangs.pop(run.id, None)

@@ -151,28 +151,36 @@ class LocalGangSpawner:
             raise SpawnerError(f"Failed to launch gang for run {run.id}: {e}") from e
         return handle
 
+    @staticmethod
+    def _signal_group(proc: subprocess.Popen, sig: int) -> None:
+        try:
+            os.killpg(proc.pid, sig)  # pgid == pid (start_new_session)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                proc.send_signal(sig)
+            except (ProcessLookupError, OSError):
+                pass
+
+    def signal_gang(self, handle: GangHandle, sig: int) -> None:
+        """Signal every live process group without waiting — the monitor's
+        kill-escalation path, which must never block the task-bus thread."""
+        for proc in handle.processes.values():
+            if proc.poll() is None:
+                self._signal_group(proc, sig)
+
     def stop(self, handle: GangHandle, grace: float = 5.0) -> None:
         """Terminate the gang (whole process groups): SIGTERM, wait
         ``grace``, then SIGKILL."""
         import signal
 
-        def signal_group(proc: subprocess.Popen, sig: int) -> None:
-            try:
-                os.killpg(proc.pid, sig)  # pgid == pid (start_new_session)
-            except (ProcessLookupError, PermissionError, OSError):
-                try:
-                    proc.send_signal(sig)
-                except (ProcessLookupError, OSError):
-                    pass
-
         for proc in handle.processes.values():
             if proc.poll() is None:
-                signal_group(proc, signal.SIGTERM)
+                self._signal_group(proc, signal.SIGTERM)
         deadline = time.time() + grace
         for proc in handle.processes.values():
             remaining = max(0.0, deadline - time.time())
             try:
                 proc.wait(timeout=remaining)
             except subprocess.TimeoutExpired:
-                signal_group(proc, signal.SIGKILL)
+                self._signal_group(proc, signal.SIGKILL)
                 proc.wait(timeout=5.0)

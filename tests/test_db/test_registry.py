@@ -142,6 +142,19 @@ class TestHeartbeats:
         assert reg.zombie_runs(ttl_seconds=10) == []
 
 
+class TestStaleQueued:
+    def test_stale_queued_runs(self, reg):
+        run = make_run(reg)
+        assert reg.stale_queued_runs(ttl_seconds=0.0) == []  # not queued
+        reg.set_status(run.id, S.QUEUED)
+        assert reg.stale_queued_runs(ttl_seconds=3600.0) == []  # fresh
+        # Probe with a future clock instead of sleeping.
+        future = __import__("time").time() + 7200.0
+        assert [r.id for r in reg.stale_queued_runs(3600.0, now=future)] == [run.id]
+        reg.set_status(run.id, S.SCHEDULED)
+        assert reg.stale_queued_runs(3600.0, now=future) == []
+
+
 class TestIterations:
     def test_lifecycle(self, reg):
         n1 = reg.create_iteration(5, {"bracket": 0})

@@ -213,3 +213,29 @@ class TestZombieDetection:
             assert handle is None or handle.all_exited
         finally:
             orch.stop()
+
+    def test_stranded_queued_run_is_redispatched_by_cron(self, tmp_path):
+        # The QUEUED dispatch mark removes the old CREATED re-dispatch
+        # self-healing; the cron must recover a run whose dispatched
+        # build/start task was dead-lettered.
+        import time as _time
+
+        from polyaxon_tpu.workers import CronTasks
+
+        orch = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        try:
+            # A run stranded in QUEUED with nothing in the bus queue —
+            # exactly the state after a dead-lettered dispatch.
+            run = orch.registry.create_run(
+                __import__("polyaxon_tpu.schemas", fromlist=["PolyaxonFile"])
+                .PolyaxonFile.load(spec_for("noop"))
+                .specification
+            )
+            orch.registry.set_status(run.id, S.QUEUED)
+            orch.ctx.queued_redispatch_ttl = 0.0
+            _time.sleep(0.01)
+            orch.bus.send(CronTasks.HEARTBEAT_CHECK, {})
+            done = orch.wait(run.id, timeout=60)
+            assert done.status == S.SUCCEEDED
+        finally:
+            orch.stop()

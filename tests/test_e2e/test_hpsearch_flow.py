@@ -36,7 +36,7 @@ def group_spec(hptuning):
 
 @pytest.mark.e2e
 class TestHPSearchFlow:
-    def test_random_search_sweep(self, orch):
+    def test_random_search_sweep(self, orch, caplog):
         group = orch.submit(
             group_spec(
                 {
@@ -52,6 +52,12 @@ class TestHPSearchFlow:
         assert len(trials) == 4
         assert all(t.status == S.SUCCEEDED for t in trials)
         assert all("score" in t.last_metric for t in trials)
+        # The QUEUED dispatch mark must prevent back-to-back HP_STARTs from
+        # double-dispatching a trial (the r2 'not schedulable' noise).
+        assert not [r for r in caplog.records if "not schedulable" in r.message]
+        # Every trial passed through the QUEUED dispatch mark.
+        for t in trials:
+            assert S.QUEUED in [row["status"] for row in orch.registry.get_statuses(t.id)]
 
     def test_grid_search_sweep(self, orch):
         group = orch.submit(

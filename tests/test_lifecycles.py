@@ -53,6 +53,17 @@ class TestExperimentLifeCycle:
     def test_runs_cannot_be_born_resuming(self):
         assert not ExperimentLifeCycle.can_transition(None, S.RESUMING)
 
+    def test_queued_dispatch_mark(self):
+        # QUEUED marks a trial/op handed to the build→start chain (or held
+        # for device admission): entered from pending, never re-entered from
+        # the running phase, and the chain continues through it.
+        assert ExperimentLifeCycle.can_transition(S.CREATED, S.QUEUED)
+        assert ExperimentLifeCycle.can_transition(S.QUEUED, S.BUILDING)
+        assert ExperimentLifeCycle.can_transition(S.QUEUED, S.SCHEDULED)
+        assert ExperimentLifeCycle.can_transition(S.QUEUED, S.STOPPING)
+        assert not ExperimentLifeCycle.can_transition(S.RUNNING, S.QUEUED)
+        assert not ExperimentLifeCycle.can_transition(S.SCHEDULED, S.QUEUED)
+
     def test_no_backward_motion_in_running_phase(self):
         # VERDICT r1: SCHEDULED is not reachable from RUNNING.
         assert not ExperimentLifeCycle.can_transition(S.RUNNING, S.SCHEDULED)
