@@ -59,6 +59,17 @@ _ALL = [
            "in-process heartbeat cadence (reference sidecar poll: 2s)"),
     Option("spawner.default_accelerator", str, "cpu",
            "topology.accelerator default for specs that omit it"),
+    Option("spawner.backend", str, "local",
+           "gang transport: local (subprocesses) or ssh (TPU-VM hosts)"),
+    Option("spawner.hosts", str, "",
+           "comma-separated worker host addresses for the ssh backend "
+           "(slice order: worker 0 first — it hosts the coordinator)"),
+    Option("spawner.ssh_user", str, "", "ssh login user ('' = current user)"),
+    Option("spawner.ssh_identity_file", str, "", "ssh private key path"),
+    Option("spawner.remote_python", str, "python3",
+           "python interpreter on worker hosts"),
+    Option("spawner.coordinator_port_base", int, 8476,
+           "base of the 512-wide jax.distributed coordinator port range"),
     Option("groups.max_concurrency", int, 64,
            "upper bound on a sweep's concurrency setting"),
     Option("restarts.max_allowed", int, 10,

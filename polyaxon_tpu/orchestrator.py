@@ -30,7 +30,6 @@ from polyaxon_tpu.monitor import GangWatcher
 from polyaxon_tpu.schemas import PolyaxonFile
 from polyaxon_tpu.schemas.specifications import BaseSpecification, Kinds
 from polyaxon_tpu.scheduler.tasks import SchedulerContext, register_scheduler_tasks
-from polyaxon_tpu.spawner import LocalGangSpawner
 from polyaxon_tpu.stores import StoreLayout
 from polyaxon_tpu.workers import CronTasks, SchedulerTasks, TaskBus
 
@@ -104,8 +103,10 @@ class Orchestrator:
                     ],
                 )
             )
-        self.spawner = LocalGangSpawner(
-            self.layout, heartbeat_interval=heartbeat_interval
+        from polyaxon_tpu.spawner import spawner_from_conf
+
+        self.spawner = spawner_from_conf(
+            self.layout, conf, heartbeat_interval=heartbeat_interval
         )
         self.watcher = GangWatcher(self.registry)
         self.ctx = SchedulerContext(

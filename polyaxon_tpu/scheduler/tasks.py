@@ -24,7 +24,7 @@ from polyaxon_tpu.events import EventTypes
 from polyaxon_tpu.exceptions import PolyaxonTPUError
 from polyaxon_tpu.lifecycles import StatusOptions as S
 from polyaxon_tpu.monitor import GangWatcher
-from polyaxon_tpu.spawner import GangHandle, LocalGangSpawner
+from polyaxon_tpu.spawner import GangHandle, GangSpawner
 from polyaxon_tpu.stores import StoreLayout, create_snapshot
 from polyaxon_tpu.workers import CronTasks, SchedulerTasks, TaskBus
 
@@ -37,7 +37,7 @@ class SchedulerContext:
     bus: TaskBus
     auditor: Auditor
     layout: StoreLayout
-    spawner: LocalGangSpawner
+    spawner: GangSpawner
     watcher: GangWatcher
     #: Live gang handles keyed by run id (the reference keeps equivalent
     #: state in k8s; a single-service control plane keeps it in-process).
@@ -177,6 +177,7 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             # otherwise the run would sit RUNNING forever (the survivor
             # keeps heartbeating, so the zombie cron can't catch it either).
             import signal
+            import threading
 
             now = time.monotonic()
             if handle.terminal_since is None:
@@ -185,10 +186,25 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             # in tests exactly like every countdown.
             grace = ctx.terminal_grace * ctx.bus.time_scale
             elapsed = now - handle.terminal_since
-            if elapsed >= 2 * grace:
-                ctx.spawner.signal_gang(handle, signal.SIGKILL)
-            elif elapsed >= grace:
-                ctx.spawner.signal_gang(handle, signal.SIGTERM)
+
+            def _signal_off_thread(sig: int) -> None:
+                # Each stage fires once, on its own thread: the ssh
+                # transport's signal is a network round-trip that must not
+                # stall the single bus thread (and must not be re-sent
+                # every monitor tick).
+                threading.Thread(
+                    target=ctx.spawner.signal_gang,
+                    args=(handle, sig),
+                    name=f"gang-signal-{run_id}",
+                    daemon=True,
+                ).start()
+
+            if elapsed >= 2 * grace and not handle.kill_sent:
+                handle.kill_sent = True
+                _signal_off_thread(signal.SIGKILL)
+            elif elapsed >= grace and not handle.term_sent:
+                handle.term_sent = True
+                _signal_off_thread(signal.SIGTERM)
             _reschedule_monitor(run_id)
             return
         if rollup in (S.SUCCEEDED, S.FAILED, S.SKIPPED):
@@ -259,7 +275,17 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             ctx.auditor.record(EventTypes.EXPERIMENT_ZOMBIE, run_id=run.id)
             handle = ctx.gangs.pop(run.id, None)
             if handle is not None:
-                ctx.spawner.stop(handle)
+                # Off-thread: a zombie usually means an unreachable host,
+                # where an ssh-transport stop would hold the bus thread for
+                # the full grace + connect timeouts.
+                import threading
+
+                threading.Thread(
+                    target=ctx.spawner.stop,
+                    args=(handle,),
+                    name=f"zombie-stop-{run.id}",
+                    daemon=True,
+                ).start()
             reg.set_status(
                 run.id, S.FAILED, message=f"zombie: no heartbeat in {ctx.heartbeat_ttl}s"
             )

@@ -1,3 +1,20 @@
-from polyaxon_tpu.spawner.local import GangHandle, LocalGangSpawner
+from polyaxon_tpu.spawner.local import GangHandle, GangSpawner, LocalGangSpawner
+from polyaxon_tpu.spawner.remote import RemoteGangSpawner, spawner_from_conf
+from polyaxon_tpu.spawner.transport import (
+    LocalExecTransport,
+    ProcessRef,
+    SSHTransport,
+    Transport,
+)
 
-__all__ = ["GangHandle", "LocalGangSpawner"]
+__all__ = [
+    "GangHandle",
+    "GangSpawner",
+    "LocalGangSpawner",
+    "RemoteGangSpawner",
+    "spawner_from_conf",
+    "Transport",
+    "LocalExecTransport",
+    "SSHTransport",
+    "ProcessRef",
+]

@@ -1,14 +1,15 @@
-"""Local-subprocess gang spawner.
+"""Gang spawner: N host processes for one accelerator slice.
 
 Parity: reference ``polypod/experiment.py`` — ``ExperimentSpawner`` builds
 pods+services per replica, injects rendezvous env, and starts/stops the
 experiment (``start_experiment`` :350-357, pod creation :160-244).
 TPU-native: a *gang* is N host processes for one accelerator slice; the
-spawner launches them as local subprocesses (the dev/test backend — a
-TPU-VM ssh backend slots in behind the same interface), injecting the
-coordinator/process-id/mesh env contract that replaces TF_CONFIG.  Each
-process's stdout/stderr stream to per-process log files; the reporting
-channel is the run's ``reports/`` dir.
+spawner launches ``runtime.worker`` once per host through a
+:class:`~polyaxon_tpu.spawner.transport.Transport` (local subprocesses for
+dev/test, ssh for real TPU-VM slices), injecting the coordinator/process-id/
+mesh env contract that replaces TF_CONFIG.  Each process's stdout/stderr
+stream to per-process log files; the reporting channel is the run's
+``reports/`` dir on the shared store layout.
 """
 
 from __future__ import annotations
@@ -16,19 +17,26 @@ from __future__ import annotations
 import json
 import os
 import socket
-import subprocess
 import sys
 import time
 from pathlib import Path
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from polyaxon_tpu.compiler import GangPlan
 from polyaxon_tpu.db.registry import Run
 from polyaxon_tpu.exceptions import SpawnerError
 from polyaxon_tpu.runtime.env import gang_env
+from polyaxon_tpu.spawner.transport import (
+    LocalExecTransport,
+    ProcessRef,
+    Transport,
+    terminate_refs,
+)
 from polyaxon_tpu.stores.layout import RunPaths, StoreLayout
 from polyaxon_tpu.stores.snapshots import materialize_snapshot
+
+LOOPBACK_HOSTS = ("127.0.0.1", "localhost", "::1")
 
 
 def _free_port() -> int:
@@ -45,7 +53,7 @@ class GangHandle:
     run_uuid: str
     plan: GangPlan
     paths: RunPaths
-    processes: Dict[int, subprocess.Popen] = field(default_factory=dict)
+    processes: Dict[int, ProcessRef] = field(default_factory=dict)
     #: Byte offsets into each process's report file (watcher tail cursor).
     report_offsets: Dict[int, int] = field(default_factory=dict)
     started_at: float = field(default_factory=time.time)
@@ -54,18 +62,162 @@ class GangHandle:
     #: When the gang's roll-up first went terminal while members were still
     #: alive (scheduler grace-window bookkeeping).
     terminal_since: Optional[float] = None
+    #: Escalation bookkeeping: each signal stage fires once per attempt
+    #: (re-signalling every monitor tick would hammer ssh hosts).
+    term_sent: bool = False
+    kill_sent: bool = False
 
     def poll(self) -> Dict[int, Optional[int]]:
         """process_id -> exit code (None while running)."""
-        return {pid: proc.poll() for pid, proc in self.processes.items()}
+        return {pid: ref.poll() for pid, ref in self.processes.items()}
 
     @property
     def all_exited(self) -> bool:
         return all(code is not None for code in self.poll().values())
 
 
-class LocalGangSpawner:
-    """Launches gangs as local subprocesses of ``runtime.worker``."""
+class GangSpawner:
+    """Launches gangs of ``runtime.worker`` processes through a transport.
+
+    ``hosts`` is the worker host pool: process ``i`` lands on
+    ``hosts[i % len(hosts)]`` (one worker per TPU-VM host in the standard
+    slice layout).  The coordinator address is ``hosts[0]`` — routable by
+    every gang member, which is what ``jax.distributed.initialize`` needs.
+    """
+
+    def __init__(
+        self,
+        layout: StoreLayout,
+        *,
+        transport: Optional[Transport] = None,
+        hosts: Optional[List[str]] = None,
+        heartbeat_interval: float = 5.0,
+        python: Optional[str] = None,
+        coordinator_port_base: int = 8476,
+    ) -> None:
+        self.layout = layout
+        self.transport = transport or LocalExecTransport()
+        self.hosts = hosts or ["127.0.0.1"]
+        self.heartbeat_interval = heartbeat_interval
+        self.python = python or sys.executable
+        self.coordinator_port_base = coordinator_port_base
+
+    # -- host / coordinator assignment ---------------------------------------
+    def host_for(self, process_id: int) -> str:
+        return self.hosts[process_id % len(self.hosts)]
+
+    def _coordinator(self, run: Run, plan: GangPlan) -> Optional[str]:
+        if plan.num_hosts <= 1:
+            return None
+        head = self.host_for(0)
+        if head in LOOPBACK_HOSTS:
+            # Local gangs can grab an ephemeral port safely (same machine).
+            return f"{head}:{_free_port()}"
+        # Remote heads need a port the control plane can pick WITHOUT asking
+        # the host; derive it from the run id so concurrent gangs on a
+        # shared pool diverge.
+        return f"{head}:{self.coordinator_port_base + run.id % 512}"
+
+    # -- env contract ---------------------------------------------------------
+    def _process_env(
+        self,
+        run: Run,
+        plan: GangPlan,
+        paths: RunPaths,
+        process_id: int,
+        coordinator: Optional[str],
+    ) -> Dict[str, Optional[str]]:
+        """Env overrides for one gang process (None = unset on the host)."""
+        env: Dict[str, Optional[str]] = {}
+        if plan.accelerator.startswith("cpu"):
+            # CPU gangs must not attach to a site-installed TPU plugin
+            # (sitecustomize-style PJRT registration keyed on these vars
+            # would pin the worker to the real chip). The prefix strip
+            # happens transport-side ON THE HOST — a remote worker's own
+            # env can't be enumerated from here (see ``cpu_unset_prefixes``
+            # in :meth:`start`).
+            env["TPU_SKIP_MDS_QUERY"] = None
+            env["JAX_PLATFORMS"] = "cpu"
+        env.update(plan.env_vars)
+        # The worker runs with cwd=run_dir; make sure it can import this
+        # package even when it isn't pip-installed (dev/test checkouts) by
+        # prepending the package parent to PYTHONPATH — after the spec's
+        # env_vars so a user PYTHONPATH augments rather than clobbers it.
+        pkg_parent = str(Path(__file__).resolve().parents[2])
+        inherited_pp = env.get("PYTHONPATH") or os.environ.get("PYTHONPATH")
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (pkg_parent, inherited_pp) if p
+        )
+        env.update(
+            gang_env(
+                run_id=run.id,
+                run_uuid=run.uuid,
+                run_dir=str(paths.root),
+                spec_path=str(paths.spec_path),
+                process_id=process_id,
+                num_processes=plan.num_hosts,
+                coordinator=coordinator,
+                devices_per_host=plan.devices_per_host,
+                accelerator=plan.accelerator,
+                mesh_axes=plan.mesh_axes,
+                strategy=plan.strategy,
+                strategy_options=plan.strategy_options,
+                heartbeat_interval=self.heartbeat_interval,
+                seed=run.spec.environment.seed,
+            )
+        )
+        return env
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self, run: Run, plan: GangPlan) -> GangHandle:
+        """Create the run dir, write the spec, launch all gang processes."""
+        paths = self.layout.run_paths(run.uuid).ensure()
+        paths.spec_path.write_text(json.dumps(run.spec_data))
+        if run.code_ref:
+            materialize_snapshot(run.code_ref, self.layout.snapshots_dir, paths.code)
+
+        coordinator = self._coordinator(run, plan)
+        handle = GangHandle(
+            run_id=run.id, run_uuid=run.uuid, plan=plan, paths=paths
+        )
+        cpu_unset_prefixes = (
+            ("PALLAS_AXON_", "AXON_") if plan.accelerator.startswith("cpu") else ()
+        )
+        try:
+            for process_id in range(plan.num_hosts):
+                env = self._process_env(run, plan, paths, process_id, coordinator)
+                log_path = paths.log_file(process_id)
+                rc_path = log_path.with_suffix(".rc")
+                ref = self.transport.launch(
+                    self.host_for(process_id),
+                    [self.python, "-m", "polyaxon_tpu.runtime.worker"],
+                    env,
+                    cwd=str(paths.root),
+                    log_path=log_path,
+                    rc_path=rc_path,
+                    unset_prefixes=cpu_unset_prefixes,
+                )
+                handle.processes[process_id] = ref
+        except Exception as e:
+            self.stop(handle)
+            raise SpawnerError(f"Failed to launch gang for run {run.id}: {e}") from e
+        return handle
+
+    def signal_gang(self, handle: GangHandle, sig: int) -> None:
+        """Signal every live process group without waiting — the monitor's
+        kill-escalation path, which must never block the task-bus thread."""
+        for ref in handle.processes.values():
+            if ref.poll() is None:
+                ref.signal(sig)
+
+    def stop(self, handle: GangHandle, grace: float = 5.0) -> None:
+        """Terminate the gang (whole process groups): SIGTERM, wait
+        ``grace``, then SIGKILL."""
+        terminate_refs(handle.processes, grace=grace)
+
+
+class LocalGangSpawner(GangSpawner):
+    """The dev/test backend: gangs as local subprocesses (loopback pool)."""
 
     def __init__(
         self,
@@ -74,113 +226,10 @@ class LocalGangSpawner:
         heartbeat_interval: float = 5.0,
         python: Optional[str] = None,
     ) -> None:
-        self.layout = layout
-        self.heartbeat_interval = heartbeat_interval
-        self.python = python or sys.executable
-
-    def start(self, run: Run, plan: GangPlan) -> GangHandle:
-        """Create the run dir, write the spec, launch all gang processes."""
-        paths = self.layout.run_paths(run.uuid).ensure()
-        paths.spec_path.write_text(json.dumps(run.spec_data))
-        if run.code_ref:
-            materialize_snapshot(run.code_ref, self.layout.snapshots_dir, paths.code)
-
-        coordinator = (
-            f"127.0.0.1:{_free_port()}" if plan.num_hosts > 1 else None
+        super().__init__(
+            layout,
+            transport=LocalExecTransport(),
+            hosts=["127.0.0.1"],
+            heartbeat_interval=heartbeat_interval,
+            python=python,
         )
-        handle = GangHandle(
-            run_id=run.id, run_uuid=run.uuid, plan=plan, paths=paths
-        )
-        seed = run.spec.environment.seed
-        try:
-            for process_id in range(plan.num_hosts):
-                env = dict(os.environ)
-                if plan.accelerator.startswith("cpu"):
-                    # CPU gangs must not attach to a site-installed TPU
-                    # plugin (sitecustomize-style PJRT registration keyed on
-                    # these vars would pin the worker to the real chip).
-                    for key in list(env):
-                        if key.startswith(("PALLAS_AXON_", "AXON_")) or key == "TPU_SKIP_MDS_QUERY":
-                            env.pop(key)
-                    env["JAX_PLATFORMS"] = "cpu"
-                env.update(plan.env_vars)
-                # The worker runs with cwd=run_dir; make sure it can import
-                # this package even when it isn't pip-installed (dev/test
-                # checkouts) by prepending the package parent to PYTHONPATH —
-                # after the spec's env_vars so a user PYTHONPATH augments
-                # rather than clobbers it.
-                pkg_parent = str(Path(__file__).resolve().parents[2])
-                env["PYTHONPATH"] = os.pathsep.join(
-                    p for p in (pkg_parent, env.get("PYTHONPATH")) if p
-                )
-                env.update(
-                    gang_env(
-                        run_id=run.id,
-                        run_uuid=run.uuid,
-                        run_dir=str(paths.root),
-                        spec_path=str(paths.spec_path),
-                        process_id=process_id,
-                        num_processes=plan.num_hosts,
-                        coordinator=coordinator,
-                        devices_per_host=plan.devices_per_host,
-                        accelerator=plan.accelerator,
-                        mesh_axes=plan.mesh_axes,
-                        strategy=plan.strategy,
-                        strategy_options=plan.strategy_options,
-                        heartbeat_interval=self.heartbeat_interval,
-                        seed=seed,
-                    )
-                )
-                log_path = paths.log_file(process_id)
-                log_path.parent.mkdir(parents=True, exist_ok=True)
-                log_fh = open(log_path, "ab")
-                proc = subprocess.Popen(
-                    [self.python, "-m", "polyaxon_tpu.runtime.worker"],
-                    env=env,
-                    stdout=log_fh,
-                    stderr=subprocess.STDOUT,
-                    cwd=str(paths.root),
-                    # Own process group: stop() must take down the whole
-                    # tree (shell-command runs spawn sh → user process).
-                    start_new_session=True,
-                )
-                log_fh.close()  # child holds the fd
-                handle.processes[process_id] = proc
-        except Exception as e:
-            self.stop(handle)
-            raise SpawnerError(f"Failed to launch gang for run {run.id}: {e}") from e
-        return handle
-
-    @staticmethod
-    def _signal_group(proc: subprocess.Popen, sig: int) -> None:
-        try:
-            os.killpg(proc.pid, sig)  # pgid == pid (start_new_session)
-        except (ProcessLookupError, PermissionError, OSError):
-            try:
-                proc.send_signal(sig)
-            except (ProcessLookupError, OSError):
-                pass
-
-    def signal_gang(self, handle: GangHandle, sig: int) -> None:
-        """Signal every live process group without waiting — the monitor's
-        kill-escalation path, which must never block the task-bus thread."""
-        for proc in handle.processes.values():
-            if proc.poll() is None:
-                self._signal_group(proc, sig)
-
-    def stop(self, handle: GangHandle, grace: float = 5.0) -> None:
-        """Terminate the gang (whole process groups): SIGTERM, wait
-        ``grace``, then SIGKILL."""
-        import signal
-
-        for proc in handle.processes.values():
-            if proc.poll() is None:
-                self._signal_group(proc, signal.SIGTERM)
-        deadline = time.time() + grace
-        for proc in handle.processes.values():
-            remaining = max(0.0, deadline - time.time())
-            try:
-                proc.wait(timeout=remaining)
-            except subprocess.TimeoutExpired:
-                self._signal_group(proc, signal.SIGKILL)
-                proc.wait(timeout=5.0)

@@ -1,0 +1,127 @@
+"""RemoteGangSpawner e2e: the full orchestration chain over the ssh
+transport (stub sshd = run the payload locally), plus conf-driven backend
+selection.
+
+Proves the remote contract end-to-end: launch through ssh, exit codes over
+the shared-run-dir rc channel, report ingestion, stop via remote group
+kill — the reference's remote-pod chain (``polypod/experiment.py:160-244``,
+``:350-357``) on TPU-VM semantics.
+"""
+
+import os
+import sys
+
+import pytest
+
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.orchestrator import Orchestrator
+from polyaxon_tpu.spawner import (
+    LocalGangSpawner,
+    RemoteGangSpawner,
+    spawner_from_conf,
+)
+
+
+@pytest.fixture()
+def stub_ssh(tmp_path_factory, monkeypatch):
+    bin_dir = tmp_path_factory.mktemp("stub-bin")
+    stub = bin_dir / "ssh"
+    stub.write_text('#!/bin/sh\nfor last; do :; done\nexec sh -c "$last"\n')
+    stub.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bin_dir}{os.pathsep}{os.environ['PATH']}")
+    return stub
+
+
+@pytest.fixture()
+def remote_orch(tmp_path, stub_ssh):
+    orch = Orchestrator(tmp_path / "plat", monitor_interval=0.1, heartbeat_interval=0.2)
+    spawner = RemoteGangSpawner(
+        orch.layout,
+        hosts=["tpu-worker-0"],
+        python=sys.executable,
+        heartbeat_interval=0.2,
+    )
+    orch.spawner = orch.ctx.spawner = spawner
+    yield orch
+    orch.stop()
+
+
+def spec_for(entrypoint, **declarations):
+    return {
+        "kind": "experiment",
+        "run": {"entrypoint": f"polyaxon_tpu.builtins.trainers:{entrypoint}"},
+        "declarations": declarations,
+        "environment": {
+            "topology": {"accelerator": "cpu", "num_devices": 2, "num_hosts": 1}
+        },
+    }
+
+
+@pytest.mark.e2e
+class TestRemoteGangSpawnerFlow:
+    def test_run_succeeds_over_ssh_transport(self, remote_orch):
+        run = remote_orch.submit(spec_for("noop"))
+        done = remote_orch.wait(run.id, timeout=90)
+        assert done.status == S.SUCCEEDED, remote_orch.registry.get_logs(run.id)
+        assert done.last_metric["done"] == 1.0
+        # Liveness came from the rc-file channel, not a local Popen.
+        procs = remote_orch.registry.get_processes(run.id)
+        assert procs[0]["exit_code"] == 0
+
+    def test_failure_exit_code_rides_rc_channel(self, remote_orch):
+        run = remote_orch.submit(spec_for("failing"))
+        done = remote_orch.wait(run.id, timeout=90)
+        assert done.status == S.FAILED
+        procs = remote_orch.registry.get_processes(run.id)
+        assert procs[0]["exit_code"] not in (None, 0)
+
+    def test_stop_kills_remote_session(self, remote_orch):
+        run = remote_orch.submit(spec_for("sleepy", seconds=120))
+        for _ in range(400):
+            remote_orch.pump(max_wait=0.1)
+            if remote_orch.get_run(run.id).status == S.RUNNING:
+                break
+        assert remote_orch.get_run(run.id).status == S.RUNNING
+        remote_orch.stop_run(run.id)
+        done = remote_orch.wait(run.id, timeout=30)
+        assert done.status == S.STOPPED
+        handle_refs = [
+            h for h in (remote_orch.ctx.gangs.get(run.id),) if h is not None
+        ]
+        assert not handle_refs or handle_refs[0].all_exited
+
+
+class TestSpawnerFromConf:
+    def test_default_is_local(self, tmp_path):
+        orch = Orchestrator(tmp_path / "plat")
+        try:
+            assert isinstance(orch.spawner, LocalGangSpawner)
+        finally:
+            orch.stop()
+
+    def test_ssh_backend_requires_hosts(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_SPAWNER_BACKEND", "ssh")
+        with pytest.raises(ValueError, match="spawner.hosts"):
+            Orchestrator(tmp_path / "plat")
+
+    def test_ssh_backend_builds_remote_spawner(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_SPAWNER_BACKEND", "ssh")
+        monkeypatch.setenv("POLYAXON_TPU_SPAWNER_HOSTS", "tpu-w0, tpu-w1")
+        orch = Orchestrator(tmp_path / "plat")
+        try:
+            assert isinstance(orch.spawner, RemoteGangSpawner)
+            assert orch.spawner.hosts == ["tpu-w0", "tpu-w1"]
+            # Remote head → deterministic routable coordinator, not loopback.
+            class _R:  # minimal Run stand-in for the port derivation
+                id = 7
+
+            from polyaxon_tpu.compiler import GangPlan
+
+            plan = GangPlan(
+                num_hosts=2, devices_per_host=8, mesh_axes={"data": 16},
+                strategy="ddp",
+            )
+            coord = orch.spawner._coordinator(_R(), plan)
+            assert coord is not None and coord.startswith("tpu-w0:")
+        finally:
+            orch.stop()
