@@ -207,6 +207,38 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         run = _run_or_404(request)
         return web.json_response({"results": reg.get_processes(run.id)})
 
+    # -- devices (accelerator inventory) --------------------------------------
+    @routes.get(f"{API_PREFIX}/devices")
+    async def list_devices(request):
+        # Cluster inventory (reference nodes API, ``api/nodes/``).
+        return web.json_response({"results": reg.list_devices()})
+
+    @routes.post(f"{API_PREFIX}/devices")
+    async def register_device(request):
+        body = await request.json()
+        try:
+            device = orch.register_device(
+                body["name"],
+                body["accelerator"],
+                int(body["chips"]),
+                num_hosts=int(body.get("num_hosts", 1)),
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": f"device needs name/accelerator/chips: {e}"}, status=400
+            )
+        return web.json_response(device, status=201)
+
+    @routes.delete(f"{API_PREFIX}/devices/{{name}}")
+    async def remove_device(request):
+        removed = reg.remove_device(request.match_info["name"])
+        if not removed:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "no such device"}),
+                content_type="application/json",
+            )
+        return web.json_response({"ok": True})
+
     # -- live streaming (WS) --------------------------------------------------
     async def _ws_tail(request, fetch, poll: float = 0.5):
         """Generic WS tail loop: push new rows until the run is done."""

@@ -74,6 +74,24 @@ class RemoteClient:
     def statuses(self, run_id):
         return self._request("GET", f"/api/v1/runs/{run_id}/statuses")["results"]
 
+    def list_devices(self):
+        return self._request("GET", "/api/v1/devices")["results"]
+
+    def register_device(self, name, accelerator, chips, num_hosts):
+        return self._request(
+            "POST",
+            "/api/v1/devices",
+            {
+                "name": name,
+                "accelerator": accelerator,
+                "chips": chips,
+                "num_hosts": num_hosts,
+            },
+        )
+
+    def remove_device(self, name):
+        return self._request("DELETE", f"/api/v1/devices/{name}")
+
 
 class LocalClient:
     """Embedded-orchestrator backend (creates it lazily, pumps eagerly)."""
@@ -119,6 +137,17 @@ class LocalClient:
     def statuses(self, run_id):
         self.orch.pump()
         return self.orch.registry.get_statuses(int(run_id))
+
+    def list_devices(self):
+        return self.orch.registry.list_devices()
+
+    def register_device(self, name, accelerator, chips, num_hosts):
+        return self.orch.register_device(name, accelerator, chips, num_hosts=num_hosts)
+
+    def remove_device(self, name):
+        if not self.orch.registry.remove_device(name):
+            raise SystemExit(f"no device named {name!r}")
+        return {"ok": True}
 
     def pump(self, max_wait: float) -> None:
         self.orch.pump(max_wait=max_wait)
@@ -211,6 +240,17 @@ def main(argv=None) -> int:
     p_statuses = sub.add_parser("statuses", help="status history")
     p_statuses.add_argument("run_id")
 
+    p_dev = sub.add_parser("devices", help="accelerator inventory (admission)")
+    dev_sub = p_dev.add_subparsers(dest="devices_command", required=True)
+    dev_sub.add_parser("list", help="show registered slices and holders")
+    p_dev_add = dev_sub.add_parser("add", help="register a slice")
+    p_dev_add.add_argument("name")
+    p_dev_add.add_argument("--accelerator", required=True, help="e.g. v5e-8")
+    p_dev_add.add_argument("--chips", type=int, required=True)
+    p_dev_add.add_argument("--hosts", type=int, default=1)
+    p_dev_rm = dev_sub.add_parser("remove", help="drop a slice")
+    p_dev_rm.add_argument("name")
+
     p_serve = sub.add_parser("serve", help="run the API service")
     p_serve.add_argument("--port", type=int, default=8000)
     p_serve.add_argument("--bind", default="127.0.0.1")
@@ -271,6 +311,26 @@ def main(argv=None) -> int:
             for s in client.statuses(args.run_id):
                 msg = f"  {s['message']}" if s.get("message") else ""
                 print(f"{s['created_at']:.1f}  {s['status']}{msg}")
+            return 0
+        if args.command == "devices":
+            if args.devices_command == "list":
+                fmt = "{:>4}  {:16}  {:10}  {:>6}  {:>6}  {:}"
+                print(fmt.format("ID", "NAME", "ACCEL", "CHIPS", "HOSTS", "HELD BY"))
+                for d in client.list_devices():
+                    print(
+                        fmt.format(
+                            d["id"], d["name"], d["accelerator"], d["chips"],
+                            d["num_hosts"], d["run_id"] or "-",
+                        )
+                    )
+            elif args.devices_command == "add":
+                d = client.register_device(
+                    args.name, args.accelerator, args.chips, args.hosts
+                )
+                print(json.dumps(d, indent=2, default=str))
+            elif args.devices_command == "remove":
+                client.remove_device(args.name)
+                print("removed", file=sys.stderr)
             return 0
     finally:
         if isinstance(client, LocalClient):

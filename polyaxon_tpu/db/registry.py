@@ -142,7 +142,26 @@ CREATE TABLE IF NOT EXISTS options (
     key TEXT PRIMARY KEY,
     value TEXT NOT NULL
 );
+
+CREATE TABLE IF NOT EXISTS devices (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT UNIQUE NOT NULL,
+    accelerator TEXT NOT NULL,
+    chips INTEGER NOT NULL,
+    num_hosts INTEGER NOT NULL DEFAULT 1,
+    run_id INTEGER,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_devices_family ON devices (accelerator);
 """
+
+
+def accelerator_family(accelerator: str) -> str:
+    """``v5e-16`` → ``v5e``; ``cpu``/``cpu-1`` → ``cpu`` — the platform
+    generation a gang can actually run on (chips aren't fungible across
+    generations the way the reference's ``NodeGPU`` count was)."""
+    return accelerator.split("-", 1)[0]
 
 
 @dataclass
@@ -390,7 +409,9 @@ class RunRegistry:
             if not lifecycle.can_transition(row["status"], status):
                 return False
             started_at = row["started_at"]
-            if started_at is None and lifecycle.is_running(status):
+            # Strictly the running phase: QUEUED/BUILDING time is waiting
+            # (admission, snapshots), not runtime.
+            if started_at is None and status in lifecycle.RUNNING_STATUS:
                 started_at = now
             finished_at = now if lifecycle.is_done(status) else None
             conn.execute(
@@ -564,6 +585,137 @@ class RunRegistry:
             (S.QUEUED, now, ttl_seconds),
         ).fetchall()
         return list(map(_row_to_run, rows))
+
+    # -- devices (accelerator inventory + gang admission) ---------------------
+    # Parity: reference ``db/models/nodes.py`` (ClusterNode/NodeGPU) +
+    # k8s-delegated placement. TPU-native: the schedulable unit is a whole
+    # accelerator SLICE (chips within a slice share ICI and can't be split
+    # across jax.distributed worlds), so the inventory is slices and
+    # admission is acquire/release of one slice per gang.
+
+    def register_device(
+        self,
+        name: str,
+        accelerator: str,
+        chips: int,
+        num_hosts: int = 1,
+    ) -> Dict[str, Any]:
+        """Add (or update) a slice in the inventory. Registering any device
+        of a family turns admission control ON for that family."""
+        now = time.time()
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO devices (name, accelerator, chips, num_hosts,
+                                        created_at, updated_at)
+                   VALUES (?, ?, ?, ?, ?, ?)
+                   ON CONFLICT(name) DO UPDATE SET
+                     accelerator = excluded.accelerator,
+                     chips = excluded.chips,
+                     num_hosts = excluded.num_hosts,
+                     updated_at = excluded.updated_at""",
+                (name, accelerator, chips, num_hosts, now, now),
+            )
+        return self.get_device(name)
+
+    def get_device(self, name: str) -> Dict[str, Any]:
+        row = self._conn().execute(
+            "SELECT * FROM devices WHERE name = ?", (name,)
+        ).fetchone()
+        if row is None:
+            raise RegistryError(f"No device named {name!r}")
+        return dict(row)
+
+    def list_devices(self) -> List[Dict[str, Any]]:
+        rows = self._conn().execute(
+            "SELECT * FROM devices ORDER BY accelerator, chips, name"
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    def remove_device(self, name: str) -> bool:
+        with self._lock, self._conn() as conn:
+            cur = conn.execute("DELETE FROM devices WHERE name = ?", (name,))
+        return cur.rowcount > 0
+
+    def acquire_device(
+        self, run_id: int, accelerator: str, chips: int
+    ) -> Optional[Dict[str, Any]]:
+        """Claim the smallest free slice of the accelerator's family with at
+        least ``chips`` chips.
+
+        Returns the claimed slice row; ``None`` when the family has
+        inventory but no fitting slice is free (caller queues the run); or
+        ``{"unmanaged": True}`` when the family has NO registered inventory
+        at all (admission control off — every run admitted).  Idempotent per
+        run: a re-dispatched start re-uses the already-held slice.
+        """
+        with self._lock, self._conn() as conn:
+            conn.execute("BEGIN IMMEDIATE")
+            held = conn.execute(
+                "SELECT * FROM devices WHERE run_id = ?", (run_id,)
+            ).fetchone()
+            if held is not None:
+                # Flagged so a duplicate dispatch knows it did NOT newly
+                # claim anything (and must not release on its failure path).
+                return {**dict(held), "already_held": True}
+            managed, free_clause, free_params = self._family_fit(
+                conn, accelerator, chips
+            )
+            if managed == 0:
+                return {"unmanaged": True}
+            row = conn.execute(
+                f"""SELECT * FROM devices WHERE {free_clause}
+                    ORDER BY chips ASC, id ASC LIMIT 1""",
+                free_params,
+            ).fetchone()
+            if row is None:
+                return None
+            conn.execute(
+                "UPDATE devices SET run_id = ?, updated_at = ? WHERE id = ?",
+                (run_id, time.time(), row["id"]),
+            )
+            return {**dict(row), "run_id": run_id}
+
+    def release_devices(self, run_id: int) -> int:
+        """Free every slice held by ``run_id``; returns how many were held."""
+        with self._lock, self._conn() as conn:
+            cur = conn.execute(
+                "UPDATE devices SET run_id = NULL, updated_at = ? WHERE run_id = ?",
+                (time.time(), run_id),
+            )
+        return cur.rowcount
+
+    @staticmethod
+    def _family_fit(
+        conn: sqlite3.Connection, accelerator: str, chips: int
+    ) -> Tuple[int, str, Tuple[Any, ...]]:
+        """Family matching shared by acquire and the free count (they MUST
+        agree or hp_start dispatches trials that then fail admission).
+
+        Exact-name-or-dash-prefix: family ``v5e`` matches ``v5e`` and
+        ``v5e-*`` but never ``v5`` → ``v5e-8`` (prefix LIKE would) —
+        cross-generation chips aren't fungible.
+        """
+        family = accelerator_family(accelerator)
+        family_clause = "(accelerator = ? OR accelerator LIKE ? ESCAPE '\\')"
+        like = family.replace("\\", "\\\\").replace("%", "\\%").replace("_", "\\_")
+        family_params = (family, like + "-%")
+        managed = conn.execute(
+            f"SELECT COUNT(*) AS n FROM devices WHERE {family_clause}",
+            family_params,
+        ).fetchone()["n"]
+        free_clause = f"run_id IS NULL AND {family_clause} AND chips >= ?"
+        return managed, free_clause, (*family_params, chips)
+
+    def free_slice_count(self, accelerator: str, chips: int) -> Optional[int]:
+        """Free fitting slices for a family; None = family unmanaged
+        (no inventory registered → admission control off)."""
+        conn = self._conn()
+        managed, free_clause, free_params = self._family_fit(conn, accelerator, chips)
+        if managed == 0:
+            return None
+        return conn.execute(
+            f"SELECT COUNT(*) AS n FROM devices WHERE {free_clause}", free_params
+        ).fetchone()["n"]
 
     # -- iterations (hpsearch) ------------------------------------------------
     def create_iteration(self, group_id: int, data: Dict[str, Any]) -> int:

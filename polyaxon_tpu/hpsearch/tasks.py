@@ -135,6 +135,13 @@ def register_hp_tasks(ctx: HPContext) -> None:
         running = [t for t in trials if not t.is_done and t.status != S.CREATED]
         pending = [t for t in trials if t.status == S.CREATED]
         window = max(0, hptuning.concurrency - len(running))
+        # Waves are bounded by free accelerator slices, not just the sweep's
+        # concurrency (SURVEY §7: trials×slices packing): dispatching more
+        # trials than the inventory fits would just park them at admission.
+        topo = group.spec.environment.topology
+        free = reg.free_slice_count(topo.accelerator, int(topo.num_devices))
+        if free is not None:
+            window = min(window, free)
         for t in pending[:window]:
             # Mark the trial dispatched BEFORE sending: a trial sitting in
             # the bus queue must not look pending to the next HP_START

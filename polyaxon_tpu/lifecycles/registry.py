@@ -30,6 +30,10 @@ ExperimentLifeCycle = LifeCycle(
     done=(S.SUCCEEDED, S.FAILED, S.UPSTREAM_FAILED, S.STOPPED, S.SKIPPED),
     transient=(S.WARNING, S.UNKNOWN, S.UNSCHEDULABLE),
     resumable_from=(S.SUCCEEDED, S.STOPPED, S.SKIPPED, S.WARNING, S.FAILED),
+    # A BUILT run can still queue at device admission (QUEUED otherwise
+    # precedes BUILDING in the preparing order and would be unreachable,
+    # stranding built runs when every slice is held).
+    extra_edges={S.QUEUED: (S.BUILDING,)},
 )
 
 #: Host-process jobs (the replica unit inside a gang).

@@ -184,6 +184,18 @@ class Orchestrator:
         self.auditor.record(event_type, **{key: run.id})
         return run
 
+    def register_device(
+        self, name: str, accelerator: str, chips: int, num_hosts: int = 1
+    ) -> Dict[str, Any]:
+        """Add slice capacity and immediately re-kick admission — queued
+        runs and window-clamped sweeps must not wait for an unrelated run
+        to finish before seeing the new inventory."""
+        device = self.registry.register_device(
+            name, accelerator, chips, num_hosts=num_hosts
+        )
+        self.bus.send(SchedulerTasks.ADMISSION_CHECK, {})
+        return device
+
     def stop_run(self, run_id: int) -> None:
         run = self.registry.get_run(run_id)
         if run.kind == Kinds.GROUP:

@@ -54,6 +54,10 @@ class SchedulerContext:
 
 
 def _record_done(ctx: SchedulerContext, run_id: int, status: str) -> None:
+    # Terminal = the gang's slice goes back into the inventory; freed
+    # capacity immediately re-dispatches runs queued at admission.
+    if ctx.registry.release_devices(run_id):
+        ctx.bus.send(SchedulerTasks.ADMISSION_CHECK, {})
     run = ctx.registry.get_run(run_id)
     by_status = {
         S.SUCCEEDED: EventTypes.EXPERIMENT_SUCCEEDED,
@@ -106,8 +110,27 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             reg.set_status(run_id, S.FAILED, message=f"compile failed: {e}")
             _record_done(ctx, run_id, S.FAILED)
             return
+        # Gang admission (reference: scheduler/experiment_scheduler.py's
+        # k8s-delegated placement; here an explicit slice inventory). No
+        # inventory for the family → admission is off; otherwise the run
+        # holds a whole slice from SCHEDULED until terminal.
+        device = reg.acquire_device(run_id, plan.accelerator, plan.num_devices)
+        if device is None:
+            # Queue at admission: the QUEUED re-dispatch cron and the
+            # release hook both retry this run later.
+            reg.set_status(
+                run_id,
+                S.QUEUED,
+                message=f"waiting for a free {plan.accelerator} slice "
+                f"({plan.num_devices} chips)",
+            )
+            return
         if not reg.set_status(run_id, S.SCHEDULED):
             logger.warning("Run %s not schedulable from %s", run_id, run.status)
+            if not device.get("unmanaged") and not device.get("already_held"):
+                # This dispatch lost the race but newly claimed a slice:
+                # give it back (the winning dispatch holds its own).
+                reg.release_devices(run_id)
             return
         try:
             handle = ctx.spawner.start(run, plan)
@@ -255,6 +278,23 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
                 reg.upsert_process(run_id, p["process_id"], status=S.STOPPED)
         reg.set_status(run_id, S.STOPPED)
         _record_done(ctx, run_id, S.STOPPED)
+
+    @bus.register(SchedulerTasks.ADMISSION_CHECK)
+    def admission_check() -> None:
+        """Re-dispatch runs queued at admission (oldest first) after capacity
+        was freed. Each re-entry retries ``acquire_device``; runs that still
+        don't fit simply stay QUEUED (their status write is a no-op)."""
+        for run in reg.list_runs(statuses=[S.QUEUED]):
+            bus.send(SchedulerTasks.EXPERIMENTS_BUILD, {"run_id": run.id})
+        # Sweeps throttle their own waves by free slices, so freed capacity
+        # must also re-kick running groups whose trials are still CREATED
+        # (no EXPERIMENT_DONE is coming to do it when the slices were held
+        # by unrelated runs).
+        from polyaxon_tpu.workers import HPTasks
+
+        if bus.has_task(HPTasks.START):
+            for group in reg.list_runs(kind="group", statuses=[S.RUNNING]):
+                bus.send(HPTasks.START, {"group_id": group.id})
 
     @bus.register(CronTasks.CLEAN_ACTIVITY)
     def clean_activity(retention_seconds: float = 30 * 86400.0) -> None:

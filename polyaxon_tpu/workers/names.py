@@ -16,6 +16,7 @@ class SchedulerTasks:
     EXPERIMENTS_MONITOR = "experiments.monitor"
     EXPERIMENTS_STOP = "experiments.stop"
     EXPERIMENTS_CHECK_HEARTBEAT = "experiments.check_heartbeat"
+    ADMISSION_CHECK = "experiments.admission_check"
     GROUPS_CREATE = "groups.create"
     GROUPS_STOP = "groups.stop"
     GROUPS_CHECK_DONE = "groups.check_done"

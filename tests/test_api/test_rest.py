@@ -177,6 +177,27 @@ class TestRunsAPI:
         assert drive(orch, body)
 
 
+class TestDevicesAPI:
+    def test_register_list_remove(self, orch):
+        async def body(client):
+            resp = await client.post(
+                "/api/v1/devices",
+                json={"name": "slice0", "accelerator": "v5e-8", "chips": 8},
+            )
+            assert resp.status == 201
+            listed = await (await client.get("/api/v1/devices")).json()
+            assert [d["name"] for d in listed["results"]] == ["slice0"]
+            bad = await client.post("/api/v1/devices", json={"name": "x"})
+            assert bad.status == 400
+            gone = await client.delete("/api/v1/devices/slice0")
+            assert gone.status == 200
+            missing = await client.delete("/api/v1/devices/slice0")
+            assert missing.status == 404
+            return True
+
+        assert drive(orch, body)
+
+
 class TestAuthAndDashboard:
     def test_auth_required_when_token_set(self, orch):
         import asyncio

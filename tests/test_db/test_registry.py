@@ -155,6 +155,57 @@ class TestStaleQueued:
         assert reg.stale_queued_runs(3600.0, now=future) == []
 
 
+class TestDevices:
+    def test_register_list_remove(self, reg):
+        reg.register_device("a", "v5e-8", 8)
+        reg.register_device("b", "v5e-16", 16, num_hosts=2)
+        names = {d["name"] for d in reg.list_devices()}
+        assert names == {"a", "b"}
+        # Upsert by name.
+        reg.register_device("a", "v5e-4", 4)
+        assert reg.get_device("a")["chips"] == 4
+        assert reg.remove_device("b")
+        assert not reg.remove_device("b")
+
+    def test_acquire_prefers_smallest_fit_and_is_idempotent(self, reg):
+        reg.register_device("big", "v5e-16", 16, num_hosts=2)
+        reg.register_device("small", "v5e-8", 8)
+        got = reg.acquire_device(run_id=1, accelerator="v5e-8", chips=8)
+        assert got["name"] == "small"
+        again = reg.acquire_device(run_id=1, accelerator="v5e-8", chips=8)
+        assert again["name"] == "small" and again["already_held"]
+        # Second run falls through to the bigger slice.
+        got2 = reg.acquire_device(run_id=2, accelerator="v5e-8", chips=8)
+        assert got2["name"] == "big"
+        # Third run: family managed, nothing free.
+        assert reg.acquire_device(run_id=3, accelerator="v5e-8", chips=8) is None
+        assert reg.free_slice_count("v5e-8", 8) == 0
+        assert reg.release_devices(1) == 1
+        assert reg.free_slice_count("v5e-8", 8) == 1
+
+    def test_unmanaged_family(self, reg):
+        reg.register_device("tpu", "v5e-8", 8)
+        # cpu family has no inventory: admission off.
+        got = reg.acquire_device(run_id=1, accelerator="cpu-1", chips=1)
+        assert got == {"unmanaged": True}
+        assert reg.free_slice_count("cpu", 1) is None
+
+    def test_family_isolation(self, reg):
+        reg.register_device("e", "v5e-8", 8)
+        # A v5p gang can't land on a v5e slice even though chips fit.
+        assert reg.acquire_device(run_id=1, accelerator="v5p-8", chips=4) == {
+            "unmanaged": True
+        }
+        # Nor can a shorter-prefix family claim a longer one: 'v5' is not
+        # 'v5e' (a prefix LIKE would have matched).
+        assert reg.acquire_device(run_id=4, accelerator="v5-8", chips=4) == {
+            "unmanaged": True
+        }
+        assert reg.free_slice_count("v5-8", 4) is None
+        got = reg.acquire_device(run_id=2, accelerator="v5e-8", chips=8)
+        assert got["name"] == "e"
+
+
 class TestIterations:
     def test_lifecycle(self, reg):
         n1 = reg.create_iteration(5, {"bracket": 0})

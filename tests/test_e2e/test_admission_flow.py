@@ -1,0 +1,193 @@
+"""Device inventory + gang admission e2e.
+
+Parity: reference node/GPU accounting (``db/models/nodes.py``) + scheduler
+placement (``scheduler/experiment_scheduler.py:101-140``), TPU-native: the
+inventory is whole accelerator slices, a gang holds one slice from
+SCHEDULED to terminal, runs that don't fit queue (QUEUED) and re-enter
+when capacity frees, and hpsearch waves are bounded by free slices.
+"""
+
+import pytest
+
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.orchestrator import Orchestrator
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(
+        tmp_path / "plat",
+        monitor_interval=0.05,
+        heartbeat_interval=0.2,
+        heartbeat_ttl=30.0,
+    )
+    yield o
+    o.stop()
+
+
+def sleepy_spec(seconds=1.0):
+    return {
+        "kind": "experiment",
+        "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:sleepy"},
+        "declarations": {"seconds": seconds},
+        "environment": {
+            "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+        },
+    }
+
+
+def max_overlap(intervals):
+    """Max number of [start, end) intervals alive at once."""
+    events = []
+    for start, end in intervals:
+        events += [(start, 1), (end, -1)]
+    live = peak = 0
+    for _, delta in sorted(events):
+        live += delta
+        peak = max(peak, live)
+    return peak
+
+
+@pytest.mark.e2e
+class TestAdmission:
+    def test_two_runs_on_one_slice_serialize(self, orch):
+        orch.registry.register_device("slice0", "cpu-1", 1)
+        a = orch.submit(sleepy_spec(1.0), name="first")
+        b = orch.submit(sleepy_spec(0.2), name="second")
+        # Drive until the first gang is up.
+        for _ in range(400):
+            orch.pump(max_wait=0.05)
+            if orch.get_run(a.id).status == S.RUNNING:
+                break
+        assert orch.get_run(a.id).status == S.RUNNING
+        # The second run hit admission and queued.
+        b_now = orch.get_run(b.id)
+        assert b_now.status == S.QUEUED
+        statuses = orch.registry.get_statuses(b.id)
+        assert any(
+            "waiting for a free" in (s["message"] or "") for s in statuses
+        )
+        # Only one slice holder at any time.
+        holders = [d["run_id"] for d in orch.registry.list_devices()]
+        assert holders == [a.id]
+        # Release → admission → the queued run completes.
+        done_b = orch.wait(b.id, timeout=90)
+        assert done_b.status == S.SUCCEEDED
+        done_a = orch.get_run(a.id)
+        assert done_a.status == S.SUCCEEDED
+        # Strict serialization: b's gang started after a's finished.
+        assert done_b.started_at >= done_a.finished_at - 0.05
+        # Slice is free again.
+        assert [d["run_id"] for d in orch.registry.list_devices()] == [None]
+
+    def test_unmanaged_family_is_not_gated(self, orch):
+        # No inventory registered → admission off, runs proceed directly.
+        run = orch.submit(sleepy_spec(0.1))
+        done = orch.wait(run.id, timeout=60)
+        assert done.status == S.SUCCEEDED
+        history = [s["status"] for s in orch.registry.get_statuses(run.id)]
+        assert S.QUEUED not in history
+
+    def test_sweep_waves_pack_onto_free_slices(self, orch):
+        orch.registry.register_device("s0", "cpu-1", 1)
+        orch.registry.register_device("s1", "cpu-1", 1)
+        group = orch.submit(
+            {
+                "kind": "group",
+                "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:sleepy"},
+                "environment": {
+                    "topology": {
+                        "accelerator": "cpu-1",
+                        "num_devices": 1,
+                        "num_hosts": 1,
+                    }
+                },
+                "declarations": {"seconds": 0.6},
+                "hptuning": {
+                    "matrix": {"x": {"values": [1, 2, 3, 4]}},
+                    "concurrency": 4,  # wants 4, inventory fits 2
+                    "grid_search": {},
+                },
+            }
+        )
+        done = orch.wait(group.id, timeout=180)
+        assert done.status == S.SUCCEEDED
+        trials = orch.registry.list_runs(group_id=group.id)
+        assert len(trials) == 4
+        assert all(t.status == S.SUCCEEDED for t in trials)
+        # At most 2 gangs ever ran concurrently (the admission guarantee).
+        intervals = [
+            (t.started_at, t.finished_at)
+            for t in trials
+            if t.started_at and t.finished_at
+        ]
+        assert max_overlap(intervals) <= 2
+
+    def test_registering_capacity_unblocks_clamped_sweep(self, orch):
+        # A sweep clamped to window=0 must start when NEW inventory is
+        # registered (not only when an unrelated run releases a slice).
+        orch.register_device("s0", "cpu-1", 1)
+        blocker = orch.submit(sleepy_spec(20.0))
+        for _ in range(400):
+            orch.pump(max_wait=0.05)
+            if orch.get_run(blocker.id).status == S.RUNNING:
+                break
+        assert orch.get_run(blocker.id).status == S.RUNNING
+        group = orch.submit(
+            {
+                "kind": "group",
+                "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+                "environment": {
+                    "topology": {
+                        "accelerator": "cpu-1",
+                        "num_devices": 1,
+                        "num_hosts": 1,
+                    }
+                },
+                "hptuning": {
+                    "matrix": {"x": {"values": [1, 2]}},
+                    "concurrency": 2,
+                    "grid_search": {},
+                },
+            }
+        )
+        orch.pump(max_wait=0.5)
+        trials = orch.registry.list_runs(group_id=group.id)
+        assert trials and all(t.status == S.CREATED for t in trials)
+        orch.register_device("s1", "cpu-1", 1)  # operator adds capacity
+        done = orch.wait(group.id, timeout=120)
+        assert done.status == S.SUCCEEDED
+        assert orch.get_run(blocker.id).status == S.RUNNING  # untouched
+        orch.stop_run(blocker.id)
+        orch.wait(blocker.id, timeout=30)
+
+    def test_released_capacity_unblocks_queued_group(self, orch):
+        # All slices held by a non-sweep run; the sweep's first wave must
+        # start once that run finishes (the ADMISSION_CHECK group re-kick).
+        orch.registry.register_device("s0", "cpu-1", 1)
+        blocker = orch.submit(sleepy_spec(1.0))
+        for _ in range(400):
+            orch.pump(max_wait=0.05)
+            if orch.get_run(blocker.id).status == S.RUNNING:
+                break
+        group = orch.submit(
+            {
+                "kind": "group",
+                "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+                "environment": {
+                    "topology": {
+                        "accelerator": "cpu-1",
+                        "num_devices": 1,
+                        "num_hosts": 1,
+                    }
+                },
+                "hptuning": {
+                    "matrix": {"x": {"values": [1, 2]}},
+                    "concurrency": 2,
+                    "grid_search": {},
+                },
+            }
+        )
+        done = orch.wait(group.id, timeout=120)
+        assert done.status == S.SUCCEEDED
+        assert orch.get_run(blocker.id).status == S.SUCCEEDED

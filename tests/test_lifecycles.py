@@ -63,6 +63,9 @@ class TestExperimentLifeCycle:
         assert ExperimentLifeCycle.can_transition(S.QUEUED, S.STOPPING)
         assert not ExperimentLifeCycle.can_transition(S.RUNNING, S.QUEUED)
         assert not ExperimentLifeCycle.can_transition(S.SCHEDULED, S.QUEUED)
+        # A BUILT run queues at device admission (explicit extra edge —
+        # otherwise built runs strand when every slice is held).
+        assert ExperimentLifeCycle.can_transition(S.BUILDING, S.QUEUED)
 
     def test_no_backward_motion_in_running_phase(self):
         # VERDICT r1: SCHEDULED is not reachable from RUNNING.
