@@ -207,6 +207,46 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         run = _run_or_404(request)
         return web.json_response({"results": reg.get_processes(run.id)})
 
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/artifacts")
+    async def list_artifacts(request):
+        # Outputs browsing (reference stores-managed outputs endpoints):
+        # local run dir first, artifact store as the durable fallback.
+        run = _run_or_404(request)
+        # Store listing may shell out to gsutil — keep it off the event loop.
+        results = await asyncio.to_thread(orch.list_artifacts, run.id)
+        return web.json_response({"results": results})
+
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/artifacts/{{key:.+}}")
+    async def get_artifact(request):
+        run = _run_or_404(request)
+        key = request.match_info["key"]
+        local = orch.artifact_local_path(run.id, key)
+        if local is not None:
+            return web.FileResponse(local)  # sendfile, zero-copy
+        # Store fallback: the open (gsutil cp to a temp file) blocks for the
+        # transfer — keep it off the event loop — then stream chunks so a
+        # multi-GB checkpoint never sits in control-plane memory.
+        f = await asyncio.to_thread(orch.open_artifact, run.id, key)
+        if f is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": f"artifact {key!r} not found"}),
+                content_type="application/json",
+            )
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/octet-stream"}
+        )
+        await resp.prepare(request)
+        try:
+            while True:
+                chunk = await asyncio.to_thread(f.read, 1 << 20)
+                if not chunk:
+                    break
+                await resp.write(chunk)
+        finally:
+            f.close()
+        await resp.write_eof()
+        return resp
+
     # -- devices (accelerator inventory) --------------------------------------
     @routes.get(f"{API_PREFIX}/devices")
     async def list_devices(request):

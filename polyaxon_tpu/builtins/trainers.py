@@ -43,6 +43,22 @@ def flaky_once(ctx: Context) -> None:
     ctx.log_metrics(recovered=1.0)
 
 
+def resume_counter(ctx: Context) -> None:
+    """Counts resume attempts via a checkpoint file (artifact-store probe).
+
+    Each attempt reads the counter from checkpoints/ (which clone/resume
+    restores — from the local run dir or the artifact store), increments
+    it, and reports it; outputs/ gets a marker file so output shipping is
+    observable too.
+    """
+    state = ctx.checkpoints_path / "counter.txt"
+    n = int(state.read_text()) if state.exists() else 0
+    state.write_text(str(n + 1))
+    (ctx.outputs_path / f"attempt_{n + 1}.marker").write_text("ok")
+    ctx.log_metrics(step=n + 1, counter=float(n + 1))
+    ctx.log_text(f"resume_counter attempt {n + 1}")
+
+
 def cnn_train(ctx: Context) -> None:
     """Train the CNN image classifier (the CIFAR-10 quick-start shape).
 

@@ -92,6 +92,22 @@ class RemoteClient:
     def remove_device(self, name):
         return self._request("DELETE", f"/api/v1/devices/{name}")
 
+    def list_artifacts(self, run_id):
+        return self._request("GET", f"/api/v1/runs/{run_id}/artifacts")["results"]
+
+    def open_artifact(self, run_id, key):
+        """A readable stream over the artifact (caller closes)."""
+        from urllib.parse import quote
+
+        headers = {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        req = urllib.request.Request(
+            f"{self.base}/api/v1/runs/{run_id}/artifacts/{quote(key)}",
+            headers=headers,
+        )
+        return urllib.request.urlopen(req)
+
 
 class LocalClient:
     """Embedded-orchestrator backend (creates it lazily, pumps eagerly)."""
@@ -102,6 +118,10 @@ class LocalClient:
 
         self._to_dict = run_to_dict
         self.orch = Orchestrator(Path(base_dir).expanduser())
+        # Each CLI invocation is a fresh control plane over the same durable
+        # registry: re-enqueue dispatch tasks the previous process took with
+        # it (e.g. a clone created by `resume` then driven by `logs -f`).
+        self.orch.recover()
 
     def submit(self, spec, project, name, tags):
         run = self.orch.submit(spec, project=project, name=name, tags=tags)
@@ -148,6 +168,15 @@ class LocalClient:
         if not self.orch.registry.remove_device(name):
             raise SystemExit(f"no device named {name!r}")
         return {"ok": True}
+
+    def list_artifacts(self, run_id):
+        return self.orch.list_artifacts(int(run_id))
+
+    def open_artifact(self, run_id, key):
+        f = self.orch.open_artifact(int(run_id), key)
+        if f is None:
+            raise SystemExit(f"artifact {key!r} not found for run {run_id}")
+        return f
 
     def pump(self, max_wait: float) -> None:
         self.orch.pump(max_wait=max_wait)
@@ -251,6 +280,15 @@ def main(argv=None) -> int:
     p_dev_rm = dev_sub.add_parser("remove", help="drop a slice")
     p_dev_rm.add_argument("name")
 
+    p_art = sub.add_parser("artifacts", help="browse/fetch run artifacts")
+    art_sub = p_art.add_subparsers(dest="artifacts_command", required=True)
+    p_art_ls = art_sub.add_parser("ls", help="list a run's artifact keys")
+    p_art_ls.add_argument("run_id")
+    p_art_pull = art_sub.add_parser("pull", help="download one artifact")
+    p_art_pull.add_argument("run_id")
+    p_art_pull.add_argument("key")
+    p_art_pull.add_argument("-o", "--output", help="write here (default: stdout)")
+
     p_serve = sub.add_parser("serve", help="run the API service")
     p_serve.add_argument("--port", type=int, default=8000)
     p_serve.add_argument("--bind", default="127.0.0.1")
@@ -311,6 +349,21 @@ def main(argv=None) -> int:
             for s in client.statuses(args.run_id):
                 msg = f"  {s['message']}" if s.get("message") else ""
                 print(f"{s['created_at']:.1f}  {s['status']}{msg}")
+            return 0
+        if args.command == "artifacts":
+            if args.artifacts_command == "ls":
+                for key in client.list_artifacts(args.run_id):
+                    print(key)
+            elif args.artifacts_command == "pull":
+                import shutil
+
+                with client.open_artifact(args.run_id, args.key) as src:
+                    if args.output:
+                        with open(args.output, "wb") as dst:
+                            shutil.copyfileobj(src, dst)
+                        print(f"wrote {args.output}", file=sys.stderr)
+                    else:
+                        shutil.copyfileobj(src, sys.stdout.buffer)
             return 0
         if args.command == "devices":
             if args.devices_command == "list":

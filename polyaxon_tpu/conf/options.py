@@ -70,6 +70,9 @@ _ALL = [
            "python interpreter on worker hosts"),
     Option("spawner.coordinator_port_base", int, 8476,
            "base of the 512-wide jax.distributed coordinator port range"),
+    Option("stores.artifacts_url", str, "",
+           "durable artifact store (file:///path or gs://bucket/prefix); "
+           "'' disables off-box sync"),
     Option("groups.max_concurrency", int, 64,
            "upper bound on a sweep's concurrency setting"),
     Option("restarts.max_allowed", int, 10,

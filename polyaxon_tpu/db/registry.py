@@ -127,6 +127,7 @@ CREATE TABLE IF NOT EXISTS processes (
     pid INTEGER,
     status TEXT NOT NULL,
     exit_code INTEGER,
+    report_offset INTEGER NOT NULL DEFAULT 0,
     updated_at REAL NOT NULL,
     PRIMARY KEY (run_id, process_id)
 );
@@ -242,6 +243,14 @@ class RunRegistry:
         self._lock = threading.Lock()
         with self._conn() as conn:
             conn.executescript(_SCHEMA)
+            # In-place migration for registries created before the durable
+            # report-offset column (CREATE IF NOT EXISTS won't add it).
+            cols = {r[1] for r in conn.execute("PRAGMA table_info(processes)")}
+            if "report_offset" not in cols:
+                conn.execute(
+                    "ALTER TABLE processes ADD COLUMN"
+                    " report_offset INTEGER NOT NULL DEFAULT 0"
+                )
 
     # -- connection management ------------------------------------------------
     def _conn(self) -> sqlite3.Connection:
@@ -789,11 +798,22 @@ class RunRegistry:
 
     def get_processes(self, run_id: int) -> List[Dict[str, Any]]:
         rows = self._conn().execute(
-            "SELECT process_id, pid, status, exit_code, updated_at FROM processes"
-            " WHERE run_id = ? ORDER BY process_id",
+            "SELECT process_id, pid, status, exit_code, report_offset, updated_at"
+            " FROM processes WHERE run_id = ? ORDER BY process_id",
             (run_id,),
         ).fetchall()
         return [dict(r) for r in rows]
+
+    def set_report_offset(self, run_id: int, process_id: int, offset: int) -> None:
+        """Persist the watcher's report-tail cursor — a restarted control
+        plane resumes ingestion exactly where the dead one stopped (no
+        replayed metrics, no lost final status lines)."""
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                "UPDATE processes SET report_offset = ? "
+                "WHERE run_id = ? AND process_id = ?",
+                (offset, run_id, process_id),
+            )
 
     def clear_processes(self, run_id: int) -> None:
         with self._lock, self._conn() as conn:

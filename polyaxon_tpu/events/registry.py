@@ -36,6 +36,7 @@ class EventTypes:
     EXPERIMENT_STOPPED = "experiment.stopped"
     EXPERIMENT_DONE = "experiment.done"
     EXPERIMENT_ZOMBIE = "experiment.zombie"
+    EXPERIMENT_ARTIFACTS_SYNCED = "experiment.artifacts_synced"
 
     # groups (events/registry/experiment_group.py)
     GROUP_CREATED = "group.created"

@@ -56,6 +56,14 @@ class GangWatcher:
                     logger.warning("Bad report line from proc %d: %r", process_id, raw[:200])
                     continue
                 self._apply(handle, process_id, event)
+            # Durable cursor: a restarted control plane reattaches and
+            # resumes the tail here. Persisted AFTER the apply loop — a
+            # crash in between replays these lines (status upserts are
+            # idempotent, metrics at-least-once) instead of silently
+            # skipping a worker's terminal status.
+            self.registry.set_report_offset(
+                handle.run_id, process_id, offset + end + 1
+            )
 
     def _apply(self, handle: GangHandle, process_id: int, event: dict) -> None:
         etype = event.get("type")

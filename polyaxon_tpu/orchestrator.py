@@ -35,6 +35,12 @@ from polyaxon_tpu.workers import CronTasks, SchedulerTasks, TaskBus
 
 
 class Orchestrator:
+    #: Control-plane lease cadence/TTL: a service refreshes every interval;
+    #: another control plane treats the lease as live within the TTL.
+    LEASE_KEY = "platform.lease"
+    LEASE_INTERVAL = 5.0
+    LEASE_TTL = 15.0
+
     def __init__(
         self,
         base_dir: Union[str, Path],
@@ -109,6 +115,12 @@ class Orchestrator:
             self.layout, conf, heartbeat_interval=heartbeat_interval
         )
         self.watcher = GangWatcher(self.registry)
+        artifacts_url = conf.get("stores.artifacts_url")
+        self.artifact_store = None
+        if artifacts_url:
+            from polyaxon_tpu.stores import artifact_store_from_url
+
+            self.artifact_store = artifact_store_from_url(artifacts_url)
         self.ctx = SchedulerContext(
             registry=self.registry,
             bus=self.bus,
@@ -121,6 +133,7 @@ class Orchestrator:
             terminal_grace=conf.get("scheduler.terminal_grace"),
             monitor_failure_streak=conf.get("scheduler.monitor_failure_streak"),
             queued_redispatch_ttl=conf.get("scheduler.queued_redispatch_ttl"),
+            artifact_store=self.artifact_store,
         )
         register_scheduler_tasks(self.ctx)
         from polyaxon_tpu.hpsearch import HPContext, register_hp_tasks
@@ -136,9 +149,166 @@ class Orchestrator:
             )
         )
         self._heartbeat_check_interval = heartbeat_check_interval
+        import uuid as _uuid
+
+        self._lease_id = _uuid.uuid4().hex
 
     # -- lifecycle ------------------------------------------------------------
+    def refresh_lease(self) -> None:
+        self.registry.set_option(
+            self.LEASE_KEY, {"owner": self._lease_id, "at": time.time()}
+        )
+
+    def another_control_plane_active(self) -> bool:
+        """Is a different control plane currently holding the lease?
+
+        Guards :meth:`recover`: a CLI invocation over the base dir of a
+        live ``serve`` must not reattach/re-dispatch the gangs that service
+        is actively monitoring.
+        """
+        lease = self.registry.get_option(self.LEASE_KEY)
+        return bool(
+            lease
+            and lease.get("owner") != self._lease_id
+            and time.time() - float(lease.get("at", 0)) < self.LEASE_TTL
+        )
+
+    def recover(self) -> int:
+        """Re-dispatch work stranded by a control-plane restart.
+
+        The registry is durable; the task bus is not. Runs whose dispatch
+        task died with the previous process re-enter the build→start chain,
+        and sweeps/pipelines get their driving task re-kicked (the
+        reference reconciles equivalent state from the k8s API on startup,
+        SURVEY §3.2). Gang-phase runs (scheduled/starting/running) have no
+        live handle in this process — the heartbeat cron zombies them and
+        the restart policy revives what it can.
+        """
+        from polyaxon_tpu.workers import HPTasks, PipelineTasks
+
+        if self.another_control_plane_active():
+            import logging
+
+            logging.getLogger(__name__).info(
+                "Skipping recovery: another control plane holds the lease"
+            )
+            return 0
+        n = 0
+        for run in self.registry.list_runs(statuses=[S.CREATED, S.QUEUED]):
+            if run.kind == Kinds.GROUP:
+                # A group with trials already created must not re-create
+                # them; re-kick the wave instead.
+                has_trials = bool(self.registry.list_runs(group_id=run.id))
+                self.bus.send(
+                    HPTasks.START if has_trials else HPTasks.CREATE,
+                    {"group_id": run.id},
+                )
+            elif run.kind == Kinds.PIPELINE:
+                has_ops = bool(self.registry.list_runs(pipeline_id=run.id))
+                self.bus.send(
+                    PipelineTasks.CHECK if has_ops else PipelineTasks.START,
+                    {"pipeline_id": run.id},
+                )
+            elif run.status == S.CREATED and (run.group_id or run.pipeline_id):
+                # Wave/DAG scheduling owns dispatch of member runs — direct
+                # re-entry would bypass concurrency windows and DAG order.
+                continue
+            else:
+                self.bus.send(SchedulerTasks.EXPERIMENTS_BUILD, {"run_id": run.id})
+            n += 1
+        # Gang-phase runs: reattach to the live gang via the shared run dir
+        # (remote rc/pid files, local pgid liveness) and resume monitoring;
+        # a gang that can't be reattached is re-dispatched without touching
+        # the run's restart budget — a control-plane restart is not the
+        # run's failure.
+        from polyaxon_tpu.compiler import compile_gang_plan
+        from polyaxon_tpu.workers import SchedulerTasks as ST
+
+        gang_phase = self.registry.list_runs(
+            statuses=[S.SCHEDULED, S.STARTING, S.RUNNING, S.STOPPING]
+        )
+        redispatched = set()
+        for run in gang_phase:
+            if run.kind in (Kinds.GROUP, Kinds.PIPELINE) or run.id in self.ctx.gangs:
+                continue
+            if run.status == S.STOPPING:
+                # The stop task died mid-flight. Reattach first so the stop
+                # actually signals the (possibly still live) gang — without
+                # a handle experiments_stop would mark the run STOPPED and
+                # free its slice while the workers keep holding the chips.
+                try:
+                    plan = compile_gang_plan(run.spec)
+                    handle = self.spawner.reattach(
+                        run, plan, self.registry.get_processes(run.id)
+                    )
+                except PolyaxonTPUError:
+                    handle = None
+                if handle is not None:
+                    self.ctx.gangs[run.id] = handle
+                self.bus.send(SchedulerTasks.EXPERIMENTS_STOP, {"run_id": run.id})
+                n += 1
+                continue
+            try:
+                plan = compile_gang_plan(run.spec)
+            except PolyaxonTPUError:
+                continue  # was admitted once; a compile break now is terminal
+            handle = self.spawner.reattach(
+                run, plan, self.registry.get_processes(run.id)
+            )
+            attach = False
+            if handle is not None:
+                if any(ref.poll() is None for ref in handle.processes.values()):
+                    attach = True  # gang still live: resume monitoring
+                else:
+                    # Every member is gone. Drain the report tail first —
+                    # a gang that FINISHED while the control plane was down
+                    # left terminal status lines — then decide: reported
+                    # terminal = let the monitor finalize it; no terminal
+                    # report = the gang died with the old control plane
+                    # (e.g. took its TERM), which must not burn the run's
+                    # restart budget.
+                    self.watcher.ingest(handle)
+                    procs = self.registry.get_processes(run.id)
+                    terminal = (S.SUCCEEDED, S.FAILED, S.STOPPED)
+                    attach = bool(procs) and all(
+                        p["status"] in terminal for p in procs
+                    )
+            if attach:
+                self.ctx.gangs[run.id] = handle
+                self.bus.send(SchedulerTasks.EXPERIMENTS_MONITOR, {"run_id": run.id})
+            else:
+                self.registry.clear_processes(run.id)
+                for process_id in range(plan.num_hosts):
+                    report = self.layout.run_paths(run.uuid).report_file(process_id)
+                    if report.exists():
+                        report.rename(report.with_suffix(".jsonl.lost"))
+                self.registry.set_status(
+                    run.id,
+                    S.WARNING,
+                    message="gang lost across control-plane restart; re-dispatching",
+                )
+                self.bus.send(ST.EXPERIMENTS_START, {"run_id": run.id})
+                redispatched.add(run.id)
+            n += 1
+        for run in self.registry.list_runs(statuses=[S.WARNING]):
+            # A WARNING run is a restart whose EXPERIMENTS_START task died
+            # with the previous bus; the send is idempotent under the gate.
+            if run.id not in self.ctx.gangs and run.id not in redispatched:
+                self.bus.send(SchedulerTasks.EXPERIMENTS_START, {"run_id": run.id})
+                n += 1
+        for group in self.registry.list_runs(kind=Kinds.GROUP, statuses=[S.RUNNING]):
+            self.bus.send(HPTasks.START, {"group_id": group.id})
+            n += 1
+        for pipe in self.registry.list_runs(kind=Kinds.PIPELINE, statuses=[S.RUNNING]):
+            self.bus.send(PipelineTasks.CHECK, {"pipeline_id": pipe.id})
+            n += 1
+        return n
+
     def start(self) -> None:
+        self.refresh_lease()
+        self.recover()
+        self.bus.register(CronTasks.LEASE_REFRESH, self.refresh_lease)
+        self.bus.add_cron(CronTasks.LEASE_REFRESH, self.LEASE_INTERVAL)
         self.bus.add_cron(CronTasks.HEARTBEAT_CHECK, self._heartbeat_check_interval)
         self.bus.add_cron(
             CronTasks.CLEAN_ACTIVITY,
@@ -148,6 +318,11 @@ class Orchestrator:
         self.bus.start()
 
     def stop(self) -> None:
+        lease = self.registry.get_option(self.LEASE_KEY)
+        if lease and lease.get("owner") == self._lease_id:
+            # Clean shutdown releases the lease so the next control plane
+            # recovers immediately instead of waiting out the TTL.
+            self.registry.delete_option(self.LEASE_KEY)
         self.bus.stop()
         for run_id in list(self.ctx.gangs):
             handle = self.ctx.gangs.pop(run_id)
@@ -244,6 +419,19 @@ class Orchestrator:
             self.registry.update_run(run.id, code_ref=orig.code_ref)
         if strategy in ("resume", "copy"):
             self.layout.copy_outputs(orig.uuid, run.uuid)
+            if self.artifact_store is not None:
+                # The original's local run dir may be gone (TPU-VM local
+                # disk is ephemeral; the slice may have been recycled) —
+                # the artifact store is the durable source of truth.
+                from polyaxon_tpu.stores import run_prefix
+
+                dst = self.layout.run_paths(run.uuid).ensure()
+                for sub in ("outputs", "checkpoints"):
+                    d = dst.root / sub
+                    if not any(d.iterdir()):
+                        self.artifact_store.download_tree(
+                            f"{run_prefix(orig.uuid)}/{sub}", d
+                        )
         event = (
             EventTypes.EXPERIMENT_RESUMED
             if strategy == "resume"
@@ -251,6 +439,83 @@ class Orchestrator:
         )
         self.auditor.record(event, run_id=run.id)
         return self.registry.get_run(run.id)
+
+    def list_artifacts(self, run_id: Union[int, str]) -> list:
+        """A run's artifact keys: local run dir ∪ the durable store.
+
+        Parity: reference outputs browsing over its store managers
+        (``stores/managers/base.py:11-40``).
+        """
+        run = self.registry.get_run(run_id)
+        paths = self.layout.run_paths(run.uuid)
+        local = (
+            {
+                p.relative_to(paths.root).as_posix()
+                for p in paths.root.rglob("*")
+                if p.is_file()
+            }
+            if paths.root.is_dir()
+            else set()
+        )
+        stored = set()
+        if self.artifact_store is not None:
+            from polyaxon_tpu.stores import run_prefix
+
+            prefix = run_prefix(run.uuid) + "/"
+            stored = {
+                k[len(prefix):]
+                for k in self.artifact_store.list(run_prefix(run.uuid))
+            }
+        return sorted(local | stored)
+
+    @staticmethod
+    def _artifact_key_ok(key: str) -> bool:
+        # A '..' segment must not reach the store path join — the local
+        # branch's resolve() guard doesn't cover the store fallback, where
+        # 'runs/<uuid>/../<other-uuid>/x' would read another run's artifacts.
+        from pathlib import PurePosixPath
+
+        p = PurePosixPath(key)
+        return not p.is_absolute() and ".." not in p.parts
+
+    def artifact_local_path(self, run_id: Union[int, str], key: str):
+        """The on-disk path of a local artifact, or None (absent/unsafe key)."""
+        if not self._artifact_key_ok(key):
+            return None
+        run = self.registry.get_run(run_id)
+        paths = self.layout.run_paths(run.uuid)
+        local = (paths.root / key).resolve()
+        if local.is_relative_to(paths.root.resolve()) and local.is_file():
+            return local
+        return None
+
+    def open_artifact(self, run_id: Union[int, str], key: str):
+        """A readable binary stream (local first, store fallback); None if
+        absent.  Streams — multi-GB checkpoints never land in control-plane
+        memory.  Caller closes."""
+        local = self.artifact_local_path(run_id, key)
+        if local is not None:
+            return local.open("rb")
+        if self.artifact_store is not None and self._artifact_key_ok(key):
+            from polyaxon_tpu.stores import run_prefix
+
+            run = self.registry.get_run(run_id)
+            # One round-trip: attempt the read and treat not-found as None
+            # (an exists() probe would double the gsutil subprocess cost).
+            try:
+                return self.artifact_store.open(f"{run_prefix(run.uuid)}/{key}")
+            except PolyaxonTPUError:
+                return None
+        return None
+
+    def get_artifact(self, run_id: Union[int, str], key: str) -> Optional[bytes]:
+        """An artifact's bytes; None if absent. Small-payload convenience —
+        prefer :meth:`open_artifact` for anything checkpoint-sized."""
+        f = self.open_artifact(run_id, key)
+        if f is None:
+            return None
+        with f:
+            return f.read()
 
     # -- eager driving (tests; service mode doesn't need these) ----------------
     def pump(self, max_wait: float = 0.0) -> int:

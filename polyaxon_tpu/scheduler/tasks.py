@@ -51,6 +51,8 @@ class SchedulerContext:
     monitor_failure_streak: int = 25
     #: How long a run may sit in QUEUED before the cron re-dispatches it.
     queued_redispatch_ttl: float = 60.0
+    #: Durable artifact store (None = off-box sync disabled).
+    artifact_store: Optional[object] = None
 
 
 def _record_done(ctx: SchedulerContext, run_id: int, status: str) -> None:
@@ -73,6 +75,10 @@ def _record_done(ctx: SchedulerContext, run_id: int, status: str) -> None:
         group_id=run.group_id,
         pipeline_id=run.pipeline_id,
     )
+    if ctx.artifact_store is not None:
+        # Ship durable artifacts (outputs/checkpoints/logs) off-box once the
+        # gang is fully down and the watcher flushed its final ingest.
+        ctx.bus.send(SchedulerTasks.ARTIFACTS_SYNC, {"run_id": run_id})
 
 
 def register_scheduler_tasks(ctx: SchedulerContext) -> None:
@@ -278,6 +284,32 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
                 reg.upsert_process(run_id, p["process_id"], status=S.STOPPED)
         reg.set_status(run_id, S.STOPPED)
         _record_done(ctx, run_id, S.STOPPED)
+
+    @bus.register(SchedulerTasks.ARTIFACTS_SYNC)
+    def artifacts_sync(run_id: int) -> None:
+        """Upload a finished run's durable subdirs to the artifact store.
+
+        Parity: reference outputs/log collection into its stores
+        (``stores/managers/base.py:11-40``); here checkpoint shipping is
+        first-class too.  Transient store failures ride the bus Retry
+        budget — a flaky gsutil call must not silently drop a checkpoint.
+        """
+        from polyaxon_tpu.stores import sync_run_up
+        from polyaxon_tpu.workers import Retry
+
+        store = ctx.artifact_store
+        if store is None:
+            return
+        run = reg.get_run(run_id)
+        paths = ctx.layout.run_paths(run.uuid)
+        try:
+            n = sync_run_up(store, paths, run.uuid)
+        except Exception:
+            logger.exception("Artifact sync failed for run %s", run_id)
+            raise Retry(countdown=5.0)
+        ctx.auditor.record(
+            EventTypes.EXPERIMENT_ARTIFACTS_SYNCED, run_id=run_id, files=n
+        )
 
     @bus.register(SchedulerTasks.ADMISSION_CHECK)
     def admission_check() -> None:

@@ -203,6 +203,38 @@ class GangSpawner:
             raise SpawnerError(f"Failed to launch gang for run {run.id}: {e}") from e
         return handle
 
+    def reattach(
+        self, run: Run, plan: GangPlan, processes: List[Dict]
+    ) -> Optional[GangHandle]:
+        """Rebuild the handle for a gang a previous control plane launched.
+
+        ``processes`` are the registry's process rows (pid + durable report
+        offset). Returns None when the gang is not reattachable — run dir
+        gone or pids unrecorded — in which case the caller re-dispatches.
+        The reference gets this for free from k8s (pods outlive the API
+        server); here the shared run dir + pid bookkeeping play that role.
+        """
+        paths = self.layout.run_paths(run.uuid)
+        if not paths.root.exists():
+            return None
+        by_id = {p["process_id"]: p for p in processes}
+        if any(
+            process_id not in by_id or not by_id[process_id].get("pid")
+            for process_id in range(plan.num_hosts)
+        ):
+            return None
+        handle = GangHandle(
+            run_id=run.id, run_uuid=run.uuid, plan=plan, paths=paths
+        )
+        for process_id in range(plan.num_hosts):
+            row = by_id[process_id]
+            rc_path = paths.log_file(process_id).with_suffix(".rc")
+            handle.processes[process_id] = self.transport.reattach(
+                self.host_for(process_id), int(row["pid"]), rc_path
+            )
+            handle.report_offsets[process_id] = int(row.get("report_offset") or 0)
+        return handle
+
     def signal_gang(self, handle: GangHandle, sig: int) -> None:
         """Signal every live process group without waiting — the monitor's
         kill-escalation path, which must never block the task-bus thread."""

@@ -73,6 +73,14 @@ class Transport:
         enumerate a remote host's env by name."""
         raise NotImplementedError
 
+    def reattach(
+        self, host: str, pid: int, rc_path: Path
+    ) -> ProcessRef:  # pragma: no cover - interface
+        """Rebuild a ref for a process launched by a PREVIOUS control plane
+        (restart recovery).  The ref must poll correctly whether the process
+        is still running or already exited."""
+        raise NotImplementedError
+
 
 # -- local exec ---------------------------------------------------------------
 
@@ -143,6 +151,72 @@ class LocalExecTransport(Transport):
         )
         log_fh.close()  # child holds the fd
         return _LocalProcessRef(proc)
+
+    def reattach(self, host: str, pid: int, rc_path: Path) -> ProcessRef:
+        return _ReattachedLocalRef(pid, rc_path)
+
+
+class _ReattachedLocalRef(ProcessRef):
+    """A local gang process inherited from a dead control plane.
+
+    We are not its parent, so ``waitpid`` is unavailable: liveness comes
+    from signal-0 to the process group (pgid == pid — launches are session
+    leaders), and the exit code from the rc file when one exists.  A local
+    launch records no rc file, so a process found dead reads as exit 1
+    (status-wise the worker's own final report line, ingested from the run
+    dir, still wins when it got written)."""
+
+    def __init__(self, pid: int, rc_path: Path) -> None:
+        self.pid = pid
+        self._rc_path = rc_path
+        self._exit_code: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self._exit_code is not None:
+            return self._exit_code
+        try:
+            raw = self._rc_path.read_text().strip()
+        except OSError:
+            raw = ""
+        if raw:
+            self._exit_code = int(raw)
+            return self._exit_code
+        try:
+            os.killpg(self.pid, 0)
+        except ProcessLookupError:
+            self._exit_code = 1  # died before this control plane attached
+            return self._exit_code
+        except (PermissionError, OSError):
+            # Exists but not signalable by us — treat as alive; the
+            # heartbeat cron is the backstop if it's a reused pid.
+            return None
+        # Signal-0 counts zombies as alive: a worker whose (dead or
+        # unrelated) parent never reaped it would read as running forever.
+        try:
+            with open(f"/proc/{self.pid}/stat") as fh:
+                # Field 3, after the parenthesized comm (which may itself
+                # contain spaces/parens — split after the LAST ')').
+                state = fh.read().rsplit(")", 1)[1].split()[0]
+            if state == "Z":
+                self._exit_code = 1
+                return self._exit_code
+        except (OSError, IndexError):
+            pass  # no procfs — fall back to signal-0 semantics
+        return None
+
+    def signal(self, sig: int) -> None:
+        try:
+            os.killpg(self.pid, sig)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+
+    def wait(self, timeout: float) -> Optional[int]:
+        deadline = time.time() + timeout
+        while True:
+            code = self.poll()
+            if code is not None or time.time() >= deadline:
+                return code
+            time.sleep(min(0.2, max(0.0, deadline - time.time())))
 
 
 # -- ssh ----------------------------------------------------------------------
@@ -377,6 +451,11 @@ class SSHTransport(Transport):
         )
         out = self.run_on(host, script)
         pid = int(out.strip().splitlines()[-1])
+        return _RemoteProcessRef(self, host, pid, rc_path)
+
+    def reattach(self, host: str, pid: int, rc_path: Path) -> ProcessRef:
+        # The remote ref is already reconstructable from disk alone: the rc
+        # file (shared run dir) is the poll channel and pid the signal target.
         return _RemoteProcessRef(self, host, pid, rc_path)
 
 

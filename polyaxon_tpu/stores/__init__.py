@@ -1,4 +1,25 @@
+from polyaxon_tpu.stores.artifacts import (
+    ArtifactStore,
+    GsutilArtifactStore,
+    LocalArtifactStore,
+    artifact_store_from_url,
+    run_prefix,
+    sync_run_down,
+    sync_run_up,
+)
 from polyaxon_tpu.stores.layout import RunPaths, StoreLayout
 from polyaxon_tpu.stores.snapshots import create_snapshot, materialize_snapshot
 
-__all__ = ["StoreLayout", "RunPaths", "create_snapshot", "materialize_snapshot"]
+__all__ = [
+    "StoreLayout",
+    "RunPaths",
+    "create_snapshot",
+    "materialize_snapshot",
+    "ArtifactStore",
+    "LocalArtifactStore",
+    "GsutilArtifactStore",
+    "artifact_store_from_url",
+    "run_prefix",
+    "sync_run_up",
+    "sync_run_down",
+]

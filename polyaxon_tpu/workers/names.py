@@ -17,6 +17,7 @@ class SchedulerTasks:
     EXPERIMENTS_STOP = "experiments.stop"
     EXPERIMENTS_CHECK_HEARTBEAT = "experiments.check_heartbeat"
     ADMISSION_CHECK = "experiments.admission_check"
+    ARTIFACTS_SYNC = "experiments.artifacts_sync"
     GROUPS_CREATE = "groups.create"
     GROUPS_STOP = "groups.stop"
     GROUPS_CHECK_DONE = "groups.check_done"
@@ -37,5 +38,6 @@ class PipelineTasks:
 
 class CronTasks:
     HEARTBEAT_CHECK = "crons.heartbeat_check"
+    LEASE_REFRESH = "crons.lease_refresh"
     STATUS_RECONCILE = "crons.status_reconcile"
     CLEAN_ACTIVITY = "crons.clean_activity"

@@ -244,3 +244,21 @@ class TestAuthAndDashboard:
             return True
 
         assert drive(orch, body)
+
+    def test_artifacts_listing_and_fetch(self, orch):
+        async def body(client):
+            run = await (await client.post("/api/v1/runs", json={"spec": SPEC})).json()
+            await _wait_done(orch, client, run["id"])
+            resp = await client.get(f"/api/v1/runs/{run['id']}/artifacts")
+            keys = (await resp.json())["results"]
+            assert any(k.startswith("logs/") for k in keys), keys
+            # reports/ carries the worker's jsonl channel — guaranteed bytes.
+            report_key = next(k for k in keys if k.startswith("reports/"))
+            resp = await client.get(f"/api/v1/runs/{run['id']}/artifacts/{report_key}")
+            assert resp.status == 200
+            assert await resp.read()
+            resp = await client.get(f"/api/v1/runs/{run['id']}/artifacts/no/such.bin")
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)

@@ -1,0 +1,87 @@
+"""Artifact-store e2e: durable sync on done + resume after the run dir dies.
+
+Parity: reference outputs/log collection through its store managers
+(``stores/managers/base.py:11-40``) — here proven the TPU-native way: the
+run directory (ephemeral TPU-VM disk) is wiped between attempts and the
+clone resumes purely from the artifact store.
+"""
+
+import shutil
+
+import pytest
+
+from polyaxon_tpu.events import EventTypes
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.orchestrator import Orchestrator
+from polyaxon_tpu.stores import run_prefix
+
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:resume_counter"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+
+@pytest.fixture()
+def orch(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "POLYAXON_TPU_STORES_ARTIFACTS_URL", f"file://{tmp_path}/artifacts"
+    )
+    o = Orchestrator(
+        tmp_path / "plat",
+        monitor_interval=0.1,
+        heartbeat_interval=0.2,
+        heartbeat_ttl=30.0,
+    )
+    yield o
+    o.stop()
+
+
+@pytest.mark.e2e
+class TestArtifactsFlow:
+    def test_done_run_syncs_to_store(self, orch):
+        run = orch.submit(SPEC, name="sync")
+        done = orch.wait(run.id, timeout=60)
+        assert done.status == S.SUCCEEDED, orch.registry.get_logs(run.id)
+        orch.pump(max_wait=0.5)  # drain the ARTIFACTS_SYNC task
+        store = orch.artifact_store
+        keys = store.list(run_prefix(done.uuid))
+        assert f"{run_prefix(done.uuid)}/checkpoints/counter.txt" in keys
+        assert f"{run_prefix(done.uuid)}/outputs/attempt_1.marker" in keys
+        assert any(k.startswith(f"{run_prefix(done.uuid)}/logs/") for k in keys)
+        assert orch.registry.get_activities(EventTypes.EXPERIMENT_ARTIFACTS_SYNCED)
+
+    def test_resume_from_store_after_run_dir_wiped(self, orch):
+        run = orch.submit(SPEC, name="resumable")
+        done = orch.wait(run.id, timeout=60)
+        assert done.status == S.SUCCEEDED, orch.registry.get_logs(run.id)
+        assert done.last_metric["counter"] == 1.0
+        orch.pump(max_wait=0.5)
+
+        # The TPU-VM slice was recycled: every local run dir is gone.
+        shutil.rmtree(orch.layout.runs_dir)
+
+        clone = orch.clone_run(run.id, strategy="resume")
+        # The clone's checkpoints were restored from the store, not disk.
+        clone_paths = orch.layout.run_paths(clone.uuid)
+        assert (clone_paths.checkpoints / "counter.txt").read_text() == "1"
+        done2 = orch.wait(clone.id, timeout=60)
+        assert done2.status == S.SUCCEEDED, orch.registry.get_logs(clone.id)
+        assert done2.last_metric["counter"] == 2.0
+
+    def test_copy_clone_still_copies_locally_without_store(self, tmp_path):
+        # No artifacts url → the pre-existing local copy path is unchanged.
+        o = Orchestrator(tmp_path / "plat2", monitor_interval=0.1)
+        try:
+            assert o.artifact_store is None
+            run = o.submit(SPEC)
+            done = o.wait(run.id, timeout=60)
+            assert done.status == S.SUCCEEDED
+            clone = o.clone_run(run.id, strategy="copy")
+            done2 = o.wait(clone.id, timeout=60)
+            assert done2.last_metric["counter"] == 2.0
+        finally:
+            o.stop()

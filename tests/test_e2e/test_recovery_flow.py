@@ -1,0 +1,185 @@
+"""Control-plane restart recovery: stranded dispatch tasks are rebuilt.
+
+Parity: the reference's startup reconcile against the k8s API (SURVEY
+§3.2) — here the durable registry is the source and :meth:`recover`
+re-enqueues the in-memory bus tasks the previous process died with. This
+is the path every fresh CLI invocation takes over a shared base dir.
+"""
+
+import pytest
+
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.orchestrator import Orchestrator
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+GROUP_SPEC = {
+    "kind": "group",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:metric_probe"},
+    "hptuning": {
+        "concurrency": 2,
+        "matrix": {"lr": {"values": [0.1, 0.5]}},
+    },
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+
+@pytest.mark.e2e
+class TestRecoveryFlow:
+    def test_stranded_created_run_recovers(self, tmp_path):
+        # Process 1 submits but dies before its bus drains.
+        o1 = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        run = o1.submit(SPEC, name="stranded")
+        o1.stop()
+
+        o2 = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        try:
+            assert o2.registry.get_run(run.id).status == S.CREATED
+            assert o2.recover() == 1
+            done = o2.wait(run.id, timeout=60)
+            assert done.status == S.SUCCEEDED, o2.registry.get_logs(run.id)
+        finally:
+            o2.stop()
+
+    def test_stranded_group_recovers(self, tmp_path):
+        o1 = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        group = o1.submit(GROUP_SPEC, name="stranded-sweep")
+        o1.stop()
+
+        o2 = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        try:
+            assert o2.recover() >= 1
+            done = o2.wait(group.id, timeout=120)
+            assert done.status == S.SUCCEEDED
+            trials = o2.registry.list_runs(group_id=group.id)
+            assert len(trials) == 2
+            assert all(t.status == S.SUCCEEDED for t in trials)
+        finally:
+            o2.stop()
+
+    def test_recover_does_not_duplicate_trials(self, tmp_path):
+        # Process 1 creates the trials, then dies mid-sweep.
+        o1 = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        group = o1.submit(GROUP_SPEC)
+        # Drain just the create step (trials exist, wave not finished).
+        for _ in range(4):
+            o1.pump(max_wait=0.1)
+            if o1.registry.list_runs(group_id=group.id):
+                break
+        created = len(o1.registry.list_runs(group_id=group.id))
+        assert created == 2
+        o1.stop()
+
+        o2 = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        try:
+            o2.recover()
+            done = o2.wait(group.id, timeout=120)
+            assert done.status == S.SUCCEEDED
+            assert len(o2.registry.list_runs(group_id=group.id)) == created
+        finally:
+            o2.stop()
+
+    def test_reattach_live_gang(self, tmp_path):
+        """The gang outlives the control plane; recovery resumes monitoring
+        the SAME processes instead of re-running the workload."""
+        o1 = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        run = o1.submit(
+            {
+                **SPEC,
+                "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:sleepy"},
+                "declarations": {"seconds": 6.0},
+            }
+        )
+        # Drive until the gang is up, then abandon o1 WITHOUT stop() —
+        # the control-plane process died, the workers did not.
+        import time
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            o1.pump(max_wait=0.1)
+            if o1.registry.get_run(run.id).status in (S.STARTING, S.RUNNING):
+                break
+        pids_before = [p["pid"] for p in o1.registry.get_processes(run.id)]
+        assert pids_before and all(pids_before)
+        o1.registry.close()
+
+        o2 = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        try:
+            assert o2.recover() >= 1
+            assert run.id in o2.ctx.gangs  # reattached, not re-dispatched
+            done = o2.wait(run.id, timeout=60)
+            assert done.status == S.SUCCEEDED, o2.registry.get_logs(run.id)
+            # Same gang: the pids were never replaced.
+            assert [p["pid"] for p in o2.registry.get_processes(run.id)] == pids_before
+        finally:
+            o2.stop()
+
+    def test_finalize_gang_that_finished_while_down(self, tmp_path):
+        """Workers finished and exited during the outage; recovery ingests
+        their final reports and finalizes without a re-run."""
+        import time
+
+        o1 = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        run = o1.submit(
+            {
+                **SPEC,
+                "run": {
+                    "entrypoint": "polyaxon_tpu.builtins.trainers:resume_counter"
+                },
+            }
+        )
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            o1.pump(max_wait=0.1)
+            if o1.registry.get_run(run.id).status in (S.STARTING, S.RUNNING):
+                break
+        o1.registry.close()
+        # Let the worker run to completion with no control plane attached.
+        time.sleep(4.0)
+
+        o2 = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        try:
+            o2.recover()
+            done = o2.wait(run.id, timeout=60)
+            assert done.status == S.SUCCEEDED, o2.registry.get_logs(run.id)
+            # Finalized from reports, not re-run: one attempt only.
+            assert done.last_metric["counter"] == 1.0
+        finally:
+            o2.stop()
+
+    def test_recover_skips_when_another_control_plane_holds_lease(self, tmp_path):
+        """A CLI invocation over a live `serve` base dir must not steal
+        its gangs; recovery is gated on the control-plane lease."""
+        o1 = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        run = o1.submit(SPEC)
+        o1.refresh_lease()
+
+        o2 = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        try:
+            assert o2.another_control_plane_active()
+            assert o2.recover() == 0  # deferred to the lease holder
+            o1.stop()  # clean shutdown releases the lease
+            assert not o2.another_control_plane_active()
+            assert o2.recover() == 1
+            done = o2.wait(run.id, timeout=60)
+            assert done.status == S.SUCCEEDED
+        finally:
+            o2.stop()
+
+    def test_recover_noop_on_clean_state(self, tmp_path):
+        o = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        try:
+            run = o.submit(SPEC)
+            done = o.wait(run.id, timeout=60)
+            assert done.status == S.SUCCEEDED
+            assert o.recover() == 0
+        finally:
+            o.stop()

@@ -1,0 +1,166 @@
+"""Artifact store backends: key round-trips, tree sync, url dispatch.
+
+Parity: reference store-manager tests (``tests/test_stores``) — upload/
+download file + dir against each backend.
+"""
+
+import subprocess
+
+import pytest
+
+from polyaxon_tpu.exceptions import PolyaxonTPUError
+from polyaxon_tpu.stores import (
+    GsutilArtifactStore,
+    LocalArtifactStore,
+    artifact_store_from_url,
+    run_prefix,
+    sync_run_down,
+    sync_run_up,
+)
+from polyaxon_tpu.stores.layout import StoreLayout
+
+
+class TestLocalStore:
+    def test_put_get_roundtrip(self, tmp_path):
+        store = LocalArtifactStore(tmp_path / "store")
+        src = tmp_path / "a.txt"
+        src.write_text("hello")
+        store.put_file(src, "runs/u1/outputs/a.txt")
+        assert store.exists("runs/u1/outputs/a.txt")
+        dst = tmp_path / "back.txt"
+        store.get_file("runs/u1/outputs/a.txt", dst)
+        assert dst.read_text() == "hello"
+        with store.open("runs/u1/outputs/a.txt") as f:
+            assert f.read() == b"hello"
+
+    def test_list_and_delete(self, tmp_path):
+        store = LocalArtifactStore(tmp_path / "store")
+        for name in ("x/1.txt", "x/sub/2.txt", "y/3.txt"):
+            src = tmp_path / "f"
+            src.write_text(name)
+            store.put_file(src, name)
+        assert store.list("x") == ["x/1.txt", "x/sub/2.txt"]
+        assert store.list() == ["x/1.txt", "x/sub/2.txt", "y/3.txt"]
+        assert store.delete("x") == 2
+        assert store.list("x") == []
+        assert not store.exists("x/1.txt")
+
+    def test_missing_key_raises(self, tmp_path):
+        store = LocalArtifactStore(tmp_path / "store")
+        with pytest.raises(PolyaxonTPUError):
+            store.get_file("nope", tmp_path / "out")
+
+    def test_key_escape_rejected(self, tmp_path):
+        store = LocalArtifactStore(tmp_path / "store")
+        with pytest.raises(PolyaxonTPUError):
+            store.exists("../outside")
+
+    def test_tree_sync(self, tmp_path):
+        store = LocalArtifactStore(tmp_path / "store")
+        src = tmp_path / "tree"
+        (src / "sub").mkdir(parents=True)
+        (src / "a.txt").write_text("a")
+        (src / "sub" / "b.txt").write_text("b")
+        assert store.upload_tree(src, "pre") == 2
+        dst = tmp_path / "down"
+        assert store.download_tree("pre", dst) == 2
+        assert (dst / "a.txt").read_text() == "a"
+        assert (dst / "sub" / "b.txt").read_text() == "b"
+
+    def test_upload_missing_dir_is_zero(self, tmp_path):
+        store = LocalArtifactStore(tmp_path / "store")
+        assert store.upload_tree(tmp_path / "nope", "pre") == 0
+
+
+class TestRunSync:
+    def test_run_roundtrip_through_store(self, tmp_path):
+        layout = StoreLayout(tmp_path / "plat")
+        store = LocalArtifactStore(tmp_path / "store")
+        paths = layout.run_paths("u-1").ensure()
+        (paths.outputs / "model.bin").write_bytes(b"\x00\x01")
+        (paths.checkpoints / "ckpt-1").write_text("state")
+        paths.log_file(0).write_text("line\n")
+        n = sync_run_up(store, paths, "u-1")
+        assert n == 3
+        assert store.exists(f"{run_prefix('u-1')}/checkpoints/ckpt-1")
+        # Wipe and restore — the ephemeral-disk recovery path.
+        import shutil
+
+        shutil.rmtree(paths.root)
+        paths = layout.run_paths("u-1").ensure()
+        assert sync_run_down(store, paths, "u-1") == 3
+        assert (paths.checkpoints / "ckpt-1").read_text() == "state"
+        assert (paths.outputs / "model.bin").read_bytes() == b"\x00\x01"
+
+
+class TestUrlDispatch:
+    def test_file_url(self, tmp_path):
+        store = artifact_store_from_url(f"file://{tmp_path}/s")
+        assert isinstance(store, LocalArtifactStore)
+
+    def test_bare_path(self, tmp_path):
+        assert isinstance(
+            artifact_store_from_url(str(tmp_path / "s")), LocalArtifactStore
+        )
+
+    def test_gs_url(self):
+        store = artifact_store_from_url("gs://bucket/prefix/")
+        assert isinstance(store, GsutilArtifactStore)
+        assert store.url == "gs://bucket/prefix"
+
+    def test_bad_url(self):
+        with pytest.raises(PolyaxonTPUError):
+            artifact_store_from_url("ftp://nope")
+        with pytest.raises(PolyaxonTPUError):
+            artifact_store_from_url("")
+
+
+class TestGsutilCommands:
+    """The command builder, against a recording fake runner."""
+
+    def _store(self, calls, stdout=""):
+        def runner(cmd):
+            calls.append(list(cmd))
+            return subprocess.CompletedProcess(cmd, 0, stdout=stdout, stderr="")
+
+        return GsutilArtifactStore("gs://b/pre", runner=runner)
+
+    def test_put_get(self, tmp_path):
+        calls = []
+        store = self._store(calls)
+        store.put_file(tmp_path / "f", "runs/u/outputs/f")
+        store.get_file("runs/u/outputs/f", tmp_path / "back")
+        assert calls[0] == [
+            "gsutil", "-q", "cp", str(tmp_path / "f"), "gs://b/pre/runs/u/outputs/f",
+        ]
+        assert calls[1][-2:] == ["gs://b/pre/runs/u/outputs/f", str(tmp_path / "back")]
+
+    def test_list_parses_keys(self):
+        calls = []
+        store = self._store(
+            calls,
+            stdout="gs://b/pre/runs/u/outputs/a.txt\ngs://b/pre/runs/u/logs/l.log\n",
+        )
+        keys = store.list("runs/u")
+        assert calls[0] == ["gsutil", "ls", "-r", "gs://b/pre/runs/u/**"]
+        assert keys == ["runs/u/logs/l.log", "runs/u/outputs/a.txt"]
+
+    def test_list_empty_prefix_is_empty(self):
+        def runner(cmd):
+            raise subprocess.CalledProcessError(
+                1, cmd, stderr="CommandException: One or more URLs matched no objects."
+            )
+
+        store = GsutilArtifactStore("gs://b/pre", runner=runner)
+        assert store.list("none") == []
+
+    def test_upload_tree_uses_recursive_cp(self, tmp_path):
+        calls = []
+        store = self._store(calls)
+        d = tmp_path / "tree"
+        d.mkdir()
+        (d / "a").write_text("a")
+        assert store.upload_tree(d, "runs/u/outputs") == 1
+        assert calls[0] == [
+            "gsutil", "-q", "-m", "cp", "-r", f"{d}/.", "gs://b/pre/runs/u/outputs",
+        ]
