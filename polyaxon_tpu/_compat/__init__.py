@@ -1,0 +1,6 @@
+"""Scoped compatibility shims (never imported by the package itself).
+
+Modules here are injected into specific subprocesses' PYTHONPATH — e.g.
+``pkg_resources.py`` for tensorboard under setuptools >= 82 — and must not
+leak onto the control plane's or ordinary workers' import paths.
+"""

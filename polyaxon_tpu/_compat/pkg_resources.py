@@ -1,0 +1,57 @@
+"""Minimal ``pkg_resources`` shim for setuptools >= 82 environments.
+
+setuptools 82 removed ``pkg_resources``; tensorboard 2.20 still imports it
+(``tensorboard/default.py``, ``tensorboard/data/server_ingester.py``) for
+exactly two names: ``parse_version`` and ``iter_entry_points``.  This shim
+provides those on top of ``packaging`` / ``importlib.metadata``.
+
+Scoped on purpose: it lives in ``polyaxon_tpu/_compat/`` (NOT on the
+package's import path) and is prepended to ``PYTHONPATH`` only for the
+tensorboard subprocess by ``builtins/services.py`` — ordinary workers
+never see a shadowed ``pkg_resources``.
+"""
+
+from __future__ import annotations
+
+
+def parse_version(version):
+    try:
+        from packaging.version import parse
+
+        return parse(str(version))
+    except ImportError:  # packaging always ships with setuptools; belt+braces
+        return tuple(
+            int(part) if part.isdigit() else -1
+            for part in str(version).split(".")
+        )
+
+
+class _EntryPointAdapter:
+    """pkg_resources-style EntryPoint over importlib.metadata's.
+
+    tensorboard's dynamic-plugin loader calls ``.resolve()`` (the old
+    spelling of ``.load()``)."""
+
+    def __init__(self, ep) -> None:
+        self._ep = ep
+        self.name = ep.name
+
+    def resolve(self):
+        return self._ep.load()
+
+    def load(self):
+        return self._ep.load()
+
+
+def iter_entry_points(group, name=None):
+    """``importlib.metadata`` entry points, pkg_resources-style."""
+    from importlib.metadata import entry_points
+
+    eps = entry_points()
+    try:
+        selected = eps.select(group=group)  # py3.10+ API
+    except AttributeError:  # pragma: no cover - legacy mapping API
+        selected = eps.get(group, [])
+    for ep in selected:
+        if name is None or ep.name == name:
+            yield _EntryPointAdapter(ep)

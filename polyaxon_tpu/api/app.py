@@ -40,6 +40,7 @@ def run_to_dict(run: Run) -> Dict[str, Any]:
         "restarts": run.restarts,
         "tags": run.tags,
         "last_metric": run.last_metric,
+        "service_url": run.service_url,
         "is_done": run.is_done,
         "created_at": run.created_at,
         "started_at": run.started_at,

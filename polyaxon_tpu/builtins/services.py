@@ -1,0 +1,113 @@
+"""Built-in service entrypoints: tensorboard + outputs file server.
+
+Parity: reference plugin deployments — ``polypod/tensorboard.py:32`` (a
+tensorboard pod over an experiment's outputs) and ``polypod/notebook.py:35``.
+TPU-native framing: services are ordinary gangs whose entrypoint serves
+until the platform stops them; the serving port is allocated at dispatch
+and arrives as ``ctx.get_param("service_port")`` (also exported as
+``POLYAXON_TPU_SERVICE_PORT``), and the run's ``service_url`` is recorded
+in the registry.
+
+Target resolution: services usually visualize ANOTHER run's outputs — the
+``target`` param is that run's uuid; the shared store layout makes its
+``outputs/`` reachable from this gang's host.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+from polyaxon_tpu.tracking import Context
+
+
+def _target_outputs(ctx: Context) -> Path:
+    """The outputs dir to serve: the `target` run's, or our own."""
+    target = ctx.get_param("target")
+    if ctx.get_param("logdir"):
+        return Path(str(ctx.get_param("logdir")))
+    own_outputs = ctx.outputs_path
+    if target is None:
+        return own_outputs
+    # runs/<uuid>/outputs → runs/<target-uuid>/outputs on the shared layout.
+    runs_root = own_outputs.parent.parent
+    return runs_root / str(target) / "outputs"
+
+
+def _service_port(ctx: Context) -> int:
+    port = ctx.get_param("service_port") or ctx.get_param("port")
+    if not port:
+        raise RuntimeError(
+            "No service port allocated — submit this entrypoint under a "
+            "service kind (notebook/tensorboard) so dispatch assigns one"
+        )
+    return int(port)
+
+
+def tensorboard(ctx: Context) -> None:
+    """Serve tensorboard over a run's outputs until stopped.
+
+    Params: ``target`` (run uuid whose outputs to visualize; default: this
+    run's own outputs), ``logdir`` (explicit path override), ``host``
+    (bind address, default 0.0.0.0 so the URL is reachable off-host).
+    """
+    import os
+
+    logdir = _target_outputs(ctx)
+    port = _service_port(ctx)
+    host = str(ctx.get_param("host", "0.0.0.0"))
+    ctx.log_text(f"tensorboard serving {logdir} on {host}:{port}")
+    # A subprocess (not the program API) so the gang's TERM→KILL escalation
+    # tears it down exactly like any workload; --load_fast=false keeps the
+    # data-loading path version-robust.  When the environment has no real
+    # pkg_resources (setuptools >= 82 removed it; tensorboard 2.20 still
+    # imports it), the _compat dir supplies a scoped shim — prepended only
+    # for THIS subprocess, and never when the real module exists.
+    env = dict(os.environ)
+    import importlib.util
+
+    if importlib.util.find_spec("pkg_resources") is None:
+        compat_dir = str(Path(__file__).resolve().parents[1] / "_compat")
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (compat_dir, env.get("PYTHONPATH")) if p
+        )
+    rc = subprocess.call(
+        [
+            sys.executable,
+            "-m",
+            "tensorboard.main",
+            "--logdir",
+            str(logdir),
+            "--host",
+            host,
+            "--port",
+            str(port),
+            "--load_fast",
+            "false",
+        ],
+        env=env,
+    )
+    if rc != 0:
+        raise RuntimeError(f"tensorboard exited {rc}")
+
+
+def output_server(ctx: Context) -> None:
+    """Serve a run's outputs dir over plain HTTP until stopped.
+
+    The dependency-free notebook-kind analogue (and the test double for
+    service plumbing): directory listing + file download for ``target``'s
+    outputs.  Params: ``target``, ``logdir``, ``host`` (default 127.0.0.1).
+    """
+    import functools
+    from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+
+    root = _target_outputs(ctx)
+    port = _service_port(ctx)
+    # 0.0.0.0 so the advertised service_url (which names the gang host, not
+    # loopback) is reachable on remote pools too.
+    host = str(ctx.get_param("host", "0.0.0.0"))
+    handler = functools.partial(SimpleHTTPRequestHandler, directory=str(root))
+    server = ThreadingHTTPServer((host, port), handler)
+    ctx.log_text(f"output_server serving {root} on {host}:{port}")
+    server.serve_forever()

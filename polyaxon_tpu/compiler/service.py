@@ -32,6 +32,9 @@ class GangPlan:
     env_vars: Dict[str, str] = field(default_factory=dict)
     max_restarts: int = 0
     backoff_seconds: float = 1.0
+    #: Service kinds only (notebook/tensorboard): the port the service must
+    #: bind. None = not a service; 0 = allocate at dispatch time.
+    service_port: Optional[int] = None
 
     @property
     def world_size(self) -> int:
@@ -67,6 +70,10 @@ def compile_gang_plan(spec: BaseSpecification) -> GangPlan:
         mesh_axes = topo.resolved_mesh()
     except ValueError as e:
         raise CompilerError(str(e)) from e
+    # Service kinds carry a port in the plan (reference: the notebook/
+    # tensorboard deployments' containerPort + service objects,
+    # ``polypod/tensorboard.py:32``); 0 defers allocation to dispatch.
+    service_port = getattr(spec, "port", None)
     return GangPlan(
         num_hosts=int(topo.num_hosts),
         devices_per_host=topo.devices_per_host,
@@ -77,4 +84,5 @@ def compile_gang_plan(spec: BaseSpecification) -> GangPlan:
         env_vars=dict(spec.environment.env_vars),
         max_restarts=spec.environment.restart_policy.max_restarts,
         backoff_seconds=spec.environment.restart_policy.backoff_seconds,
+        service_port=service_port,
     )

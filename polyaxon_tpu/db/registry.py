@@ -70,6 +70,7 @@ CREATE TABLE IF NOT EXISTS runs (
     last_metric TEXT NOT NULL DEFAULT '{}',
     outputs_path TEXT,
     code_ref TEXT,
+    service_url TEXT,
     created_at REAL NOT NULL,
     updated_at REAL NOT NULL,
     started_at REAL,
@@ -185,6 +186,8 @@ class Run:
     last_metric: Dict[str, Any] = field(default_factory=dict)
     outputs_path: Optional[str] = None
     code_ref: Optional[str] = None
+    #: Reachable URL of a serving service gang (notebook/tensorboard kinds).
+    service_url: Optional[str] = None
     created_at: float = 0.0
     updated_at: float = 0.0
     started_at: Optional[float] = None
@@ -222,6 +225,7 @@ def _row_to_run(row: sqlite3.Row) -> Run:
         last_metric=json.loads(row["last_metric"]),
         outputs_path=row["outputs_path"],
         code_ref=row["code_ref"],
+        service_url=row["service_url"],
         created_at=row["created_at"],
         updated_at=row["updated_at"],
         started_at=row["started_at"],
@@ -251,6 +255,9 @@ class RunRegistry:
                     "ALTER TABLE processes ADD COLUMN"
                     " report_offset INTEGER NOT NULL DEFAULT 0"
                 )
+            run_cols = {r[1] for r in conn.execute("PRAGMA table_info(runs)")}
+            if "service_url" not in run_cols:
+                conn.execute("ALTER TABLE runs ADD COLUMN service_url TEXT")
 
     # -- connection management ------------------------------------------------
     def _conn(self) -> sqlite3.Connection:
@@ -382,6 +389,7 @@ class RunRegistry:
             "original_id",
             "cloning_strategy",
             "restarts",
+            "service_url",
         }
         unknown = set(fields) - allowed
         if unknown:

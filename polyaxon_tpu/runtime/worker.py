@@ -103,6 +103,12 @@ def main() -> int:
         from polyaxon_tpu.schemas.specifications import specification_for_kind
 
         spec = specification_for_kind(spec_data["kind"]).model_validate(spec_data)
+        service_port = os.environ.get("POLYAXON_TPU_SERVICE_PORT")
+        if service_port is not None:
+            # The dispatch-time port allocation reaches the workload both as
+            # a template variable ({{service_port}} in cmd/kwargs) and as a
+            # Context param for python entrypoints.
+            spec.declarations.setdefault("service_port", int(service_port))
         run_cfg = spec.resolved_run() if hasattr(spec, "resolved_run") else spec.run
 
         # Code snapshot (if the build step materialized one) takes import

@@ -61,6 +61,9 @@ def _record_done(ctx: SchedulerContext, run_id: int, status: str) -> None:
     if ctx.registry.release_devices(run_id):
         ctx.bus.send(SchedulerTasks.ADMISSION_CHECK, {})
     run = ctx.registry.get_run(run_id)
+    if run.service_url:
+        # A terminal service must stop advertising its (now dead) URL.
+        ctx.registry.update_run(run_id, service_url=None)
     by_status = {
         S.SUCCEEDED: EventTypes.EXPERIMENT_SUCCEEDED,
         S.FAILED: EventTypes.EXPERIMENT_FAILED,
@@ -138,6 +141,21 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
                 # give it back (the winning dispatch holds its own).
                 reg.release_devices(run_id)
             return
+        if plan.service_port is not None:
+            # Service gang: pin the serving port now (the plan's 0 defers
+            # to dispatch) — the reference's service object + proxy URL
+            # equivalent.
+            import dataclasses
+
+            port = plan.service_port or ctx.spawner.allocate_service_port(run)
+            plan = dataclasses.replace(
+                plan,
+                service_port=port,
+                env_vars={
+                    **plan.env_vars,
+                    "POLYAXON_TPU_SERVICE_PORT": str(port),
+                },
+            )
         try:
             handle = ctx.spawner.start(run, plan)
         except Exception as e:  # disk-full/permission OSErrors included —
@@ -148,6 +166,13 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             _record_done(ctx, run_id, S.FAILED)
             return
         ctx.gangs[run_id] = handle
+        if plan.service_port is not None:
+            # Advertise only once the gang actually launched; cleared again
+            # when the run goes terminal (a dead URL must not linger).
+            reg.update_run(
+                run_id,
+                service_url=f"http://{ctx.spawner.host_for(0)}:{plan.service_port}",
+            )
         for process_id in range(plan.num_hosts):
             reg.upsert_process(
                 run_id, process_id, pid=handle.processes[process_id].pid, status=S.STARTING

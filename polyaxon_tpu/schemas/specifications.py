@@ -162,8 +162,17 @@ class ServiceSpecification(BaseSpecification):
 
     def resolved_run(self) -> RunConfig:
         """Run section with declarations interpolated (same contract as
-        experiments — services routinely template their serving port)."""
+        experiments — services routinely template their serving port).
+
+        A tensorboard spec with no run section gets the built-in server
+        over a target run's outputs — the reference's tensorboard plugin
+        needs only config, not a command (``polypod/tensorboard.py:32``).
+        """
         if self.run is None:
+            if self.kind == Kinds.TENSORBOARD:
+                return RunConfig(
+                    entrypoint="polyaxon_tpu.builtins.services:tensorboard"
+                )
             raise ValueError(f"Service spec {self.kind!r} has no run section")
         data = self.run.model_dump()
         return RunConfig.model_validate(interpolate(data, self.declarations))

@@ -106,17 +106,24 @@ class GangSpawner:
     def host_for(self, process_id: int) -> str:
         return self.hosts[process_id % len(self.hosts)]
 
+    def _pick_port(self, run: Run, offset: int) -> int:
+        """A port on the head host: loopback pools probe a genuinely free
+        one; remote heads get a derived port (base + offset block + run id)
+        — the control plane can't probe a remote host's ports cheaply, and
+        the run-id spread keeps concurrent gangs on a shared pool apart."""
+        if self.host_for(0) in LOOPBACK_HOSTS:
+            return _free_port()
+        return self.coordinator_port_base + offset + run.id % 512
+
     def _coordinator(self, run: Run, plan: GangPlan) -> Optional[str]:
         if plan.num_hosts <= 1:
             return None
-        head = self.host_for(0)
-        if head in LOOPBACK_HOSTS:
-            # Local gangs can grab an ephemeral port safely (same machine).
-            return f"{head}:{_free_port()}"
-        # Remote heads need a port the control plane can pick WITHOUT asking
-        # the host; derive it from the run id so concurrent gangs on a
-        # shared pool diverge.
-        return f"{head}:{self.coordinator_port_base + run.id % 512}"
+        return f"{self.host_for(0)}:{self._pick_port(run, 0)}"
+
+    def allocate_service_port(self, run: Run) -> int:
+        """The serving port for a service gang (block above the coordinator
+        range so the two never collide)."""
+        return self._pick_port(run, 512)
 
     # -- env contract ---------------------------------------------------------
     def _process_env(

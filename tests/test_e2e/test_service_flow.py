@@ -73,3 +73,85 @@ class TestServiceFlow:
         # the server is actually gone
         with pytest.raises(OSError):
             urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=0.5)
+
+    def test_auto_port_allocation_and_service_url(self, orch):
+        """No user-declared port: dispatch allocates one, records the URL,
+        and the built-in outputs server binds it."""
+        # A target run whose outputs the service will expose.
+        target = orch.submit(
+            {
+                "kind": "experiment",
+                "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:resume_counter"},
+                "environment": {
+                    "topology": {
+                        "accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1,
+                    }
+                },
+            }
+        )
+        done = orch.wait(target.id, timeout=60)
+        assert done.status == S.SUCCEEDED
+
+        svc = orch.submit(
+            {
+                "kind": "notebook",
+                "run": {
+                    "entrypoint": "polyaxon_tpu.builtins.services:output_server"
+                },
+                "declarations": {"target": done.uuid},
+                "environment": {
+                    "topology": {
+                        "accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1,
+                    }
+                },
+            },
+            name="outputs-svc",
+        )
+        url = None
+        body = None
+        for _ in range(300):
+            orch.pump(max_wait=0.1)
+            url = orch.get_run(svc.id).service_url
+            if url:
+                try:
+                    with urllib.request.urlopen(f"{url}/", timeout=0.3) as resp:
+                        body = resp.read().decode()
+                        break
+                except OSError:
+                    continue
+        assert url and url.startswith("http://127.0.0.1:"), url
+        # The target's outputs are listed (resume_counter wrote a marker).
+        assert body and "attempt_1.marker" in body, body
+        orch.stop_run(svc.id)
+        assert orch.wait(svc.id, timeout=30).status == S.STOPPED
+
+    def test_tensorboard_kind_serves_http(self, orch):
+        """kind=tensorboard with NO run section serves real tensorboard
+        over the target outputs until stopped."""
+        pytest.importorskip("tensorboard")
+        run = orch.submit(
+            {
+                "kind": "tensorboard",
+                "environment": {
+                    "topology": {
+                        "accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1,
+                    }
+                },
+            },
+            name="tb",
+        )
+        body = None
+        for _ in range(600):  # tensorboard cold-start is seconds, not ms
+            orch.pump(max_wait=0.1)
+            url = orch.get_run(run.id).service_url
+            if url:
+                try:
+                    with urllib.request.urlopen(f"{url}/", timeout=0.5) as resp:
+                        body = resp.read().decode(errors="replace")
+                        break
+                except OSError:
+                    continue
+        assert body is not None, orch.registry.get_logs(run.id)
+        assert "tensorboard" in body.lower(), body[:300]
+        orch.stop_run(run.id)
+        assert orch.wait(run.id, timeout=30).status == S.STOPPED
