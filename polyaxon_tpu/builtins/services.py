@@ -30,8 +30,9 @@ def _target_outputs(ctx: Context) -> Path:
     own_outputs = ctx.outputs_path
     if target is None:
         return own_outputs
-    # runs/<uuid>/outputs → runs/<target-uuid>/outputs on the shared layout.
-    runs_root = own_outputs.parent.parent
+    # The worker hands us the layout's runs/ root; a target run's outputs
+    # live beside ours on the shared layout.
+    runs_root = ctx.runs_root or own_outputs.parent.parent
     return runs_root / str(target) / "outputs"
 
 
@@ -97,7 +98,9 @@ def output_server(ctx: Context) -> None:
 
     The dependency-free notebook-kind analogue (and the test double for
     service plumbing): directory listing + file download for ``target``'s
-    outputs.  Params: ``target``, ``logdir``, ``host`` (default 127.0.0.1).
+    outputs.  Params: ``target``, ``logdir``, ``host`` (default 0.0.0.0 —
+    the advertised service_url names the gang host, so the listener is
+    network-visible; pass host: 127.0.0.1 for loopback-only).
     """
     import functools
     from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer

@@ -62,10 +62,19 @@ def resume_counter(ctx: Context) -> None:
 def cnn_train(ctx: Context) -> None:
     """Train the CNN image classifier (the CIFAR-10 quick-start shape).
 
-    Synthetic class-conditional images (deterministic from the seed) so the
-    distributed benchmark isolates compute+collectives from IO; the model
-    learns them, so accuracy rises — the learnability check the quick-start
-    provides.  Params: steps, batch, image_size, classes, lr, and channels.
+    Two data paths, same train loop:
+
+    - ``dataset: <name>`` — a store-registered dataset (see
+      ``runtime/datasets.py``): host-sharded shard reading, per-epoch
+      shuffles, uint8 on the wire with on-device normalization, and a
+      position-exact resume (the data stream fast-forwards to the restored
+      step).  ``cifar10-train`` after ``register_cifar10`` is the
+      reference's CIFAR-10 guide (``docs/guides/training-cifar10.md``).
+    - no dataset — synthetic class-conditional images (deterministic from
+      the seed), isolating compute+collectives from IO for benchmarks.
+
+    Params: steps, batch (global), image_size, classes, lr, channels,
+    dataset, save_every.
     """
     import jax
     import jax.numpy as jnp
@@ -74,6 +83,7 @@ def cnn_train(ctx: Context) -> None:
 
     from polyaxon_tpu.models import cnn
     from polyaxon_tpu.parallel import template_for
+    from polyaxon_tpu.runtime.data import global_batch_from_host_data
     from polyaxon_tpu.runtime.train import build_train_step
 
     steps = int(ctx.get_param("steps", 20))
@@ -82,6 +92,8 @@ def cnn_train(ctx: Context) -> None:
     n_classes = int(ctx.get_param("classes", 10))
     lr = float(ctx.get_param("lr", 1e-3))
     channels = tuple(ctx.get_param("channels", (64, 128, 256)))
+    dataset = ctx.get_param("dataset")
+    save_every = int(ctx.get_param("save_every", 0))
     cfg = cnn.CNNConfig(
         image_size=image_size, n_classes=n_classes, channels=channels
     )
@@ -93,8 +105,14 @@ def cnn_train(ctx: Context) -> None:
         mesh = build_mesh({"data": jax.device_count()})
     template = template_for(ctx.strategy, dict(mesh.shape), ctx.strategy_options)
 
+    def normalized_loss(p, b):
+        # uint8 rides the host→HBM wire (4x smaller than f32); normalize
+        # on device where it fuses into the first conv.
+        images = b["images"].astype(cfg.dtype) / 255.0 - 0.5
+        return cnn.loss_fn(p, {**b, "images": images}, cfg)
+
     ts = build_train_step(
-        loss_fn=lambda p, b: cnn.loss_fn(p, b, cfg),
+        loss_fn=normalized_loss,
         init_fn=lambda k: cnn.init_params(k, cfg),
         axes_tree=cnn.param_axes(cfg),
         optimizer=optax.adamw(lr),
@@ -104,32 +122,90 @@ def cnn_train(ctx: Context) -> None:
     key = jax.random.PRNGKey(ctx.seed or 0)
     params, opt_state = ts.init(key)
 
-    # Class-conditional synthetic images: class k = noisy template k.
-    rng = np.random.default_rng(ctx.seed or 0)
-    templates = rng.normal(size=(n_classes, image_size, image_size, 3)).astype(
-        np.float32
-    )
-    labels = rng.integers(0, n_classes, batch_size)
-    images = templates[labels] + 0.3 * rng.normal(
-        size=(batch_size, image_size, image_size, 3)
-    ).astype(np.float32)
-    batch = ts.place_batch(
-        {"images": jnp.asarray(images), "labels": jnp.asarray(labels)}
-    )
+    # Checkpoint/resume (same contract as lm_train): restore whatever the
+    # checkpoints/ dir holds — a resumed clone inherits the original's.
+    start_step = 0
+    ckpt = None
+    if save_every > 0 and ctx.checkpoints_path is not None:
+        from polyaxon_tpu.runtime.checkpoint import CheckpointManager
 
-    acc_fn = jax.jit(lambda p, b: cnn.accuracy(p, b, cfg))
+        ckpt = CheckpointManager(ctx.checkpoints_path, save_interval_steps=save_every)
+        restored = ckpt.restore(params, opt_state)
+        if restored is not None:
+            params, opt_state = restored["params"], restored["opt_state"]
+            start_step = restored["step"] + 1
+            ctx.log_text(f"restored checkpoint at step {restored['step']}")
+
+    if dataset is not None:
+        from polyaxon_tpu.runtime.datasets import DatasetReader
+
+        reader = DatasetReader(
+            ctx.data_path,
+            str(dataset),
+            global_batch=batch_size,
+            seed=ctx.seed or 0,
+            num_processes=ctx.num_processes,
+            process_id=ctx.process_id,
+        )
+        stream = reader.batches(start_step)
+
+        def next_batch():
+            local = next(stream)
+            return global_batch_from_host_data(
+                {
+                    "images": local["images"],
+                    "labels": local["labels"].astype(np.int32),
+                },
+                ts.batch_sharding,
+            )
+
+    else:
+        # Synthetic class-conditional images: class k = noisy template k
+        # (per-example noise keeps the learnability check honest — without
+        # it the batch holds only n_classes distinct images).
+        rng = np.random.default_rng(ctx.seed or 0)
+        templates = rng.normal(size=(n_classes, image_size, image_size, 3))
+        labels = rng.integers(0, n_classes, batch_size)
+        noisy = templates[labels] + 0.3 * rng.normal(
+            size=(batch_size, image_size, image_size, 3)
+        )
+        images = np.clip(noisy * 32 + 128, 0, 255).astype(np.uint8)
+        fixed = ts.place_batch(
+            {"images": jnp.asarray(images), "labels": jnp.asarray(labels)}
+        )
+
+        def next_batch():
+            return fixed
+
+    def normalized_accuracy(p, b):
+        images = b["images"].astype(cfg.dtype) / 255.0 - 0.5
+        return cnn.accuracy(p, {**b, "images": images}, cfg)
+
+    acc_fn = jax.jit(normalized_accuracy)
     t0 = time.time()
     metrics = None
-    for i in range(steps):
+    batch = None
+    for i in range(start_step, steps):
+        batch = next_batch()
         params, opt_state, metrics = ts.step(params, opt_state, batch, key)
         if ctx.is_leader and (i % 10 == 0 or i == steps - 1):
             ctx.log_metrics(step=i, loss=float(metrics["loss"]))
+        if ckpt is not None:
+            ckpt.save(i, params, opt_state)
+    if ckpt is not None:
+        ckpt.wait_until_finished()
+        ckpt.close()
+    steps_run = steps - start_step
+    if steps_run <= 0 or batch is None:
+        if ctx.is_leader:
+            ctx.log_text("cnn_train: nothing to do (checkpoint already at end)")
+        return
     dt = time.time() - t0
     # Every process must join the (global-array) accuracy computation —
     # leader-only dispatch would deadlock multi-host gangs.
     acc = float(acc_fn(params, batch))
     if ctx.is_leader:
-        ips = steps * batch_size / dt
+        ips = steps_run * batch_size / dt
         ctx.log_metrics(step=steps, accuracy=acc, images_per_s=ips)
         ctx.log_text(
             f"cnn_train done: {steps} steps, strategy={template.name}, "

@@ -280,6 +280,14 @@ def main(argv=None) -> int:
     p_dev_rm = dev_sub.add_parser("remove", help="drop a slice")
     p_dev_rm.add_argument("name")
 
+    p_data = sub.add_parser("data", help="store-resident datasets (local mode)")
+    data_sub = p_data.add_subparsers(dest="data_command", required=True)
+    data_sub.add_parser("ls", help="list registered datasets")
+    p_data_cifar = data_sub.add_parser(
+        "register-cifar10", help="register CIFAR-10 from the standard archive dir"
+    )
+    p_data_cifar.add_argument("batches_dir", help="path to cifar-10-batches-py")
+
     p_art = sub.add_parser("artifacts", help="browse/fetch run artifacts")
     art_sub = p_art.add_subparsers(dest="artifacts_command", required=True)
     p_art_ls = art_sub.add_parser("ls", help="list a run's artifact keys")
@@ -349,6 +357,23 @@ def main(argv=None) -> int:
             for s in client.statuses(args.run_id):
                 msg = f"  {s['message']}" if s.get("message") else ""
                 print(f"{s['created_at']:.1f}  {s['status']}{msg}")
+            return 0
+        if args.command == "data":
+            if not isinstance(client, LocalClient):
+                raise SystemExit("data commands run in local mode (datasets live in the store layout)")
+            from polyaxon_tpu.runtime.datasets import list_datasets, register_cifar10
+
+            data_dir = client.orch.layout.data_dir
+            if args.data_command == "ls":
+                for d in list_datasets(data_dir):
+                    print(
+                        f"{d['name']:24} {d['num_examples']:>8} examples, "
+                        f"{d['shards']} shards"
+                    )
+            elif args.data_command == "register-cifar10":
+                out = register_cifar10(data_dir, args.batches_dir)
+                for split, meta in out.items():
+                    print(f"registered cifar10-{split}: {meta['num_examples']} examples")
             return 0
         if args.command == "artifacts":
             if args.artifacts_command == "ls":

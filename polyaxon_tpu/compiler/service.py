@@ -73,7 +73,12 @@ def compile_gang_plan(spec: BaseSpecification) -> GangPlan:
     # Service kinds carry a port in the plan (reference: the notebook/
     # tensorboard deployments' containerPort + service objects,
     # ``polypod/tensorboard.py:32``); 0 defers allocation to dispatch.
+    # A user-declared `port` (the `cmd: ... {{port}}` shape) pins it too —
+    # otherwise the advertised service_url would name a port the workload
+    # never binds.
     service_port = getattr(spec, "port", None)
+    if service_port == 0 and spec.declarations.get("port"):
+        service_port = int(spec.declarations["port"])
     return GangPlan(
         num_hosts=int(topo.num_hosts),
         devices_per_host=topo.devices_per_host,

@@ -78,6 +78,17 @@ class CheckpointManager:
         restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(target)
         )
+        # Re-place every leaf onto its template's sharding: orbax restores
+        # scalar leaves (e.g. optax's step count) onto the default device,
+        # which poisons the jitted step with mixed device sets on a mesh.
+        import jax
+
+        def _place(template_leaf, restored_leaf):
+            if hasattr(template_leaf, "sharding"):
+                return jax.device_put(restored_leaf, template_leaf.sharding)
+            return restored_leaf
+
+        restored = jax.tree.map(_place, target, restored)
         restored["step"] = step
         return restored
 

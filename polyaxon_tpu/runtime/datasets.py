@@ -1,0 +1,246 @@
+"""Store-resident datasets: registration + host-sharded reading.
+
+Parity: the reference mounts data volumes into pods and points TF at them
+(``stores/managers`` data-path resolution; the CIFAR-10 guide,
+``docs/guides/training-cifar10.md``).  TPU-native: datasets live under the
+store layout's ``data/`` dir as numpy shard files, and the read path is
+host-sharded by contract — each gang process reads ONLY the example range
+it will contribute to the global batch, then
+:func:`~polyaxon_tpu.runtime.data.global_batch_from_host_data` assembles
+the global ``jax.Array`` with zero cross-host traffic at load time.
+
+On-disk format (one dir per dataset):
+
+    data/<name>/meta.json            {"num_examples", "shards", "arrays"}
+    data/<name>/shard-00000.npz      {"images": [n,H,W,C], "labels": [n]}
+    ...
+
+Any array names work; "train" arrays must share a leading dim per shard.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from polyaxon_tpu.exceptions import PolyaxonTPUError
+
+
+def register_dataset(
+    data_dir: Union[str, Path],
+    name: str,
+    shards: Sequence[Dict[str, np.ndarray]],
+) -> Dict[str, Any]:
+    """Write ``shards`` (list of array dicts) as a named dataset.
+
+    Returns the meta dict. Overwrites an existing registration of the same
+    name (datasets are immutable-by-convention; re-register to replace).
+    """
+    if not shards:
+        raise PolyaxonTPUError(f"Dataset {name!r} needs at least one shard")
+    root = Path(data_dir) / name
+    root.mkdir(parents=True, exist_ok=True)
+    arrays = sorted(shards[0].keys())
+    num = 0
+    for i, shard in enumerate(shards):
+        if sorted(shard.keys()) != arrays:
+            raise PolyaxonTPUError(
+                f"Shard {i} arrays {sorted(shard)} != shard 0 arrays {arrays}"
+            )
+        sizes = {len(v) for v in shard.values()}
+        if len(sizes) != 1:
+            raise PolyaxonTPUError(f"Shard {i} arrays disagree on length: {sizes}")
+        np.savez(root / f"shard-{i:05d}.npz", **shard)
+        num += sizes.pop()
+    meta = {"num_examples": num, "shards": len(shards), "arrays": arrays}
+    (root / "meta.json").write_text(json.dumps(meta))
+    return meta
+
+
+def dataset_meta(data_dir: Union[str, Path], name: str) -> Dict[str, Any]:
+    meta_path = Path(data_dir) / name / "meta.json"
+    if not meta_path.exists():
+        raise PolyaxonTPUError(
+            f"Dataset {name!r} not registered under {data_dir} "
+            f"(expected {meta_path})"
+        )
+    return json.loads(meta_path.read_text())
+
+
+def list_datasets(data_dir: Union[str, Path]) -> List[Dict[str, Any]]:
+    root = Path(data_dir)
+    out = []
+    if root.is_dir():
+        for d in sorted(root.iterdir()):
+            if (d / "meta.json").exists():
+                out.append({"name": d.name, **json.loads((d / "meta.json").read_text())})
+    return out
+
+
+class DatasetReader:
+    """Host-sharded batch iterator over a registered dataset.
+
+    Process ``process_id`` of ``num_processes`` materializes only its own
+    rows of every global batch: the global epoch permutation is derived
+    deterministically from ``seed`` + epoch (identical on every host, no
+    coordination), then each host takes its contiguous slice of each batch.
+    Partial trailing batches are dropped (static shapes — XLA recompiles on
+    shape change, so the step only ever sees ``[B/hosts, ...]``).
+    """
+
+    def __init__(
+        self,
+        data_dir: Union[str, Path],
+        name: str,
+        *,
+        global_batch: int,
+        seed: int = 0,
+        num_processes: int = 1,
+        process_id: int = 0,
+        dtype_overrides: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        if global_batch % num_processes:
+            raise PolyaxonTPUError(
+                f"Global batch {global_batch} not divisible by {num_processes} hosts"
+            )
+        self.meta = dataset_meta(data_dir, name)
+        self.root = Path(data_dir) / name
+        self.global_batch = global_batch
+        self.seed = seed
+        self.num_processes = num_processes
+        self.process_id = process_id
+        self.dtype_overrides = dtype_overrides or {}
+        # Shard files are small (tens of MB); load once, serve many epochs.
+        # A larger-than-RAM dataset would swap this for per-shard mmap.
+        arrays: Dict[str, List[np.ndarray]] = {a: [] for a in self.meta["arrays"]}
+        for i in range(self.meta["shards"]):
+            with np.load(self.root / f"shard-{i:05d}.npz") as z:
+                for a in self.meta["arrays"]:
+                    arrays[a].append(z[a])
+        self.arrays = {a: np.concatenate(v) for a, v in arrays.items()}
+        self.num_examples = self.meta["num_examples"]
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self.num_examples // self.global_batch
+
+    def epoch(self, epoch: int) -> Iterator[Dict[str, np.ndarray]]:
+        """This host's slice of every global batch of one epoch."""
+        rng = np.random.default_rng((self.seed, epoch))
+        perm = rng.permutation(self.num_examples)
+        per_host = self.global_batch // self.num_processes
+        for b in range(self.batches_per_epoch):
+            batch_idx = perm[b * self.global_batch : (b + 1) * self.global_batch]
+            lo = self.process_id * per_host
+            local_idx = batch_idx[lo : lo + per_host]
+            yield {
+                a: self._cast(a, v[local_idx]) for a, v in self.arrays.items()
+            }
+
+    def batches(self, start_step: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        """Endless stream, resumable: ``start_step`` fast-forwards the
+        epoch/batch position without materializing skipped batches — a
+        resumed run sees exactly the data it would have seen."""
+        bpe = self.batches_per_epoch
+        if bpe == 0:
+            raise PolyaxonTPUError(
+                f"Dataset has {self.num_examples} examples < global batch "
+                f"{self.global_batch}"
+            )
+        epoch, skip = divmod(start_step, bpe)
+        while True:
+            for i, batch in enumerate(self.epoch(epoch)):
+                if i < skip:
+                    continue
+                yield batch
+            skip = 0
+            epoch += 1
+
+    def _cast(self, name: str, arr: np.ndarray) -> np.ndarray:
+        want = self.dtype_overrides.get(name)
+        return arr.astype(want) if want is not None else arr
+
+
+# -- CIFAR-10 -----------------------------------------------------------------
+
+
+def load_cifar10_python(batches_dir: Union[str, Path]) -> Dict[str, Dict[str, np.ndarray]]:
+    """Parse the standard ``cifar-10-batches-py`` pickles into train/test
+    arrays (NHWC uint8 images + int labels).  The archive itself must be
+    fetched out-of-band (zero-egress platforms mount it)."""
+    import pickle
+
+    root = Path(batches_dir)
+
+    def _load(fname: str):
+        with open(root / fname, "rb") as fh:
+            d = pickle.load(fh, encoding="bytes")
+        images = (
+            np.asarray(d[b"data"], dtype=np.uint8)
+            .reshape(-1, 3, 32, 32)
+            .transpose(0, 2, 3, 1)  # NCHW → NHWC (TPU-native layout)
+        )
+        labels = np.asarray(d[b"labels"], dtype=np.int32)
+        return images, labels
+
+    train = [_load(f"data_batch_{i}") for i in range(1, 6)]
+    test_images, test_labels = _load("test_batch")
+    return {
+        "train": {
+            "images": np.concatenate([t[0] for t in train]),
+            "labels": np.concatenate([t[1] for t in train]),
+        },
+        "test": {"images": test_images, "labels": test_labels},
+    }
+
+
+def register_cifar10(
+    data_dir: Union[str, Path],
+    batches_dir: Union[str, Path],
+    *,
+    shard_size: int = 10000,
+) -> Dict[str, Any]:
+    """Register CIFAR-10 train/test splits from the standard archive dir."""
+    splits = load_cifar10_python(batches_dir)
+    out = {}
+    for split, arrays in splits.items():
+        n = len(arrays["labels"])
+        shards = [
+            {a: v[i : i + shard_size] for a, v in arrays.items()}
+            for i in range(0, n, shard_size)
+        ]
+        out[split] = register_dataset(data_dir, f"cifar10-{split}", shards)
+    return out
+
+
+def make_image_fixture(
+    data_dir: Union[str, Path],
+    name: str,
+    *,
+    num_examples: int = 512,
+    image_size: int = 32,
+    n_classes: int = 10,
+    shards: int = 2,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """A CIFAR-shaped learnable fixture dataset (class-conditional noisy
+    templates) — CI-sized stand-in for the real archive, same read path."""
+    rng = np.random.default_rng(seed)
+    templates = rng.normal(size=(n_classes, image_size, image_size, 3))
+    labels = rng.integers(0, n_classes, num_examples)
+    images = templates[labels] + 0.3 * rng.normal(
+        size=(num_examples, image_size, image_size, 3)
+    )
+    images = np.clip((images * 32 + 128), 0, 255).astype(np.uint8)
+    per = num_examples // shards
+    shard_list = [
+        {
+            "images": images[i * per : (i + 1) * per],
+            "labels": labels[i * per : (i + 1) * per].astype(np.int32),
+        }
+        for i in range(shards)
+    ]
+    return register_dataset(data_dir, name, shard_list)

@@ -31,6 +31,7 @@ class EnvVars:
     STRATEGY_OPTIONS = "POLYAXON_TPU_STRATEGY_OPTIONS"
     HEARTBEAT_INTERVAL = "POLYAXON_TPU_HEARTBEAT_INTERVAL"
     SEED = "POLYAXON_TPU_SEED"
+    DATA_DIR = "POLYAXON_TPU_DATA_DIR"
 
 
 @dataclass
@@ -51,6 +52,9 @@ class GangInfo:
     strategy_options: Dict[str, Any]
     heartbeat_interval: float
     seed: Optional[int]
+    #: The store layout's shared data/ dir (registered datasets); the
+    #: spawner resolves it so workers never re-derive layout structure.
+    data_dir: Optional[str] = None
 
     @classmethod
     def from_env(cls, env: Optional[Dict[str, str]] = None) -> "GangInfo":
@@ -71,6 +75,7 @@ class GangInfo:
             strategy_options=json.loads(e.get(EnvVars.STRATEGY_OPTIONS, "{}")),
             heartbeat_interval=float(e.get(EnvVars.HEARTBEAT_INTERVAL, "5.0")),
             seed=int(seed) if seed not in (None, "") else None,
+            data_dir=e.get(EnvVars.DATA_DIR) or None,
         )
 
 
@@ -90,6 +95,7 @@ def gang_env(
     strategy_options: Dict[str, Any],
     heartbeat_interval: float = 5.0,
     seed: Optional[int] = None,
+    data_dir: Optional[str] = None,
 ) -> Dict[str, str]:
     """Spawner-side encoder (inverse of ``GangInfo.from_env``)."""
     env = {
@@ -110,4 +116,6 @@ def gang_env(
         env[EnvVars.COORDINATOR] = coordinator
     if seed is not None:
         env[EnvVars.SEED] = str(seed)
+    if data_dir:
+        env[EnvVars.DATA_DIR] = data_dir
     return env

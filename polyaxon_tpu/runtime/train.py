@@ -62,9 +62,24 @@ def build_train_step(
     jit_init = jax.jit(init_fn, out_shardings=param_shardings)
 
     def init(rng):
+        from jax.sharding import PartitionSpec
+
         params = jit_init(rng)
-        # Optimizer state inherits placement from params via propagation.
+        # Optimizer state inherits placement from params via propagation —
+        # EXCEPT leaves with no data dependence on params (optax's step
+        # count): XLA parks those on device 0, which poisons the donated
+        # step with mixed device sets and leaves checkpoint restore without
+        # a mesh-wide template. Replicate them across the mesh explicitly.
         opt_state = jax.jit(optimizer.init)(params)
+        replicated = NamedSharding(mesh, PartitionSpec())
+        opt_state = jax.tree.map(
+            lambda x: (
+                x
+                if isinstance(getattr(x, "sharding", None), NamedSharding)
+                else jax.device_put(x, replicated)
+            ),
+            opt_state,
+        )
         return params, opt_state
 
     def _step(params, opt_state, batch, rng):

@@ -155,6 +155,10 @@ def main() -> int:
             strategy_options=info.strategy_options,
             outputs_path=str(paths.outputs),
             checkpoints_path=str(paths.checkpoints),
+            # Spawner-resolved (layout knowledge stays in StoreLayout);
+            # parent-walk only as a fallback for hand-launched workers.
+            data_path=info.data_dir or str(paths.root.parent.parent / "data"),
+            runs_root=str(paths.root.parent),
             reporter=reporter,
             seed=info.seed,
             run_uuid=info.run_uuid,

@@ -171,6 +171,7 @@ class GangSpawner:
                 strategy_options=plan.strategy_options,
                 heartbeat_interval=self.heartbeat_interval,
                 seed=run.spec.environment.seed,
+                data_dir=str(self.layout.data_dir),
             )
         )
         return env

@@ -28,6 +28,8 @@ class Context:
         strategy_options: Optional[Dict[str, Any]] = None,
         outputs_path: Optional[str] = None,
         checkpoints_path: Optional[str] = None,
+        data_path: Optional[str] = None,
+        runs_root: Optional[str] = None,
         reporter: Optional[Reporter] = None,
         seed: Optional[int] = None,
         run_uuid: Optional[str] = None,
@@ -40,6 +42,10 @@ class Context:
         self.strategy_options = strategy_options or {}
         self.outputs_path = Path(outputs_path) if outputs_path else None
         self.checkpoints_path = Path(checkpoints_path) if checkpoints_path else None
+        #: The store layout's shared data/ dir (registered datasets).
+        self.data_path = Path(data_path) if data_path else None
+        #: The layout's runs/ dir (services resolving a target run's files).
+        self.runs_root = Path(runs_root) if runs_root else None
         self.reporter = reporter
         self.seed = seed
         self.run_uuid = run_uuid
