@@ -160,16 +160,14 @@ def cnn_train(ctx: Context) -> None:
             )
 
     else:
-        # Synthetic class-conditional images: class k = noisy template k
-        # (per-example noise keeps the learnability check honest — without
-        # it the batch holds only n_classes distinct images).
+        # Synthetic class-conditional images (the fixture dataset's exact
+        # recipe — shared helper so benchmark and fixture never diverge).
+        from polyaxon_tpu.runtime.datasets import synthetic_class_images
+
         rng = np.random.default_rng(ctx.seed or 0)
-        templates = rng.normal(size=(n_classes, image_size, image_size, 3))
-        labels = rng.integers(0, n_classes, batch_size)
-        noisy = templates[labels] + 0.3 * rng.normal(
-            size=(batch_size, image_size, image_size, 3)
+        images, labels = synthetic_class_images(
+            rng, batch_size, image_size, n_classes
         )
-        images = np.clip(noisy * 32 + 128, 0, 255).astype(np.uint8)
         fixed = ts.place_batch(
             {"images": jnp.asarray(images), "labels": jnp.asarray(labels)}
         )

@@ -284,9 +284,11 @@ def forward(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T), (B, T))
 
-    # Inside the pipeline shard_map all mesh axes are manual: sharding
-    # constraints must be inert there.
-    cmesh = None if pipeline_axis else mesh
+    # Inside a fully-manual pipeline shard_map, sharding constraints must
+    # be inert; the composed mode (pp_tp) keeps the other mesh axes auto,
+    # so constraints stay live and GSPMD shards the stage body over them.
+    composed = bool(template is not None and template.pipeline_composed)
+    cmesh = None if (pipeline_axis and not composed) else mesh
     use_flash = _use_flash(c, mesh, ring_axis, pipeline_axis, T)
 
     x = params["embed"].astype(c.dtype)[tokens]  # [B,T,D]
@@ -336,24 +338,50 @@ def forward(
 
     aux = None
     if pipeline_axis is not None:
-        if c.n_experts:
-            raise NotImplementedError("pp + MoE composition not supported yet")
-        from polyaxon_tpu.parallel.pipeline import pipeline_scan
-
-        x = pipeline_scan(
-            body,
-            x,
-            positions,
-            params["block"],
-            mesh,
-            axis=pipeline_axis,
-            num_microbatches=template.num_microbatches,
-            batch_axes=rules.get("batch"),
+        from polyaxon_tpu.parallel.pipeline import (
+            pipeline_scan,
+            pipeline_scan_composed,
         )
+
+        # pp×MoE: the balance loss is reduced to a scalar INSIDE the
+        # schedule (per stage, valid ticks only) because the raw gate
+        # tensors live per-microbatch inside the shard_map.
+        aux_fn = (
+            (lambda a: moe_aux_loss(a[0], a[1], c.n_experts))
+            if c.n_experts
+            else None
+        )
+        if composed:
+            x, pp_aux = pipeline_scan_composed(
+                body,
+                x,
+                positions,
+                params["block"],
+                mesh,
+                axis=pipeline_axis,
+                num_microbatches=template.num_microbatches,
+                aux_fn=aux_fn,
+            )
+        else:
+            x, pp_aux = pipeline_scan(
+                body,
+                x,
+                positions,
+                params["block"],
+                mesh,
+                axis=pipeline_axis,
+                num_microbatches=template.num_microbatches,
+                batch_axes=rules.get("batch"),
+                aux_fn=aux_fn,
+            )
+        if c.n_experts:
+            aux = {"aux_loss": pp_aux}
     else:
-        x, aux = lax.scan(
+        x, scan_aux = lax.scan(
             lambda carry, layer: body(carry, positions, layer), x, params["block"]
         )
+        if c.n_experts:
+            aux = scan_aux
 
     x = _rmsnorm(x, params["final_norm"])
     logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(x.dtype))
@@ -381,7 +409,7 @@ def loss_fn(
         positions=batch.get("positions"),
     )
     if cfg.n_experts:
-        logits, (gates, idx) = out
+        logits, aux = out
     else:
         logits = out
     targets = batch["targets"]
@@ -393,7 +421,13 @@ def loss_fn(
     else:
         loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     if cfg.n_experts:
-        loss = loss + aux_weight * jnp.mean(
-            jax.vmap(partial(moe_aux_loss, n_experts=cfg.n_experts))(gates, idx)
-        )
+        if isinstance(aux, dict):
+            # Pipeline path: already reduced inside the GPipe schedule.
+            aux_loss = aux["aux_loss"]
+        else:
+            gates, idx = aux
+            aux_loss = jnp.mean(
+                jax.vmap(partial(moe_aux_loss, n_experts=cfg.n_experts))(gates, idx)
+            )
+        loss = loss + aux_weight * aux_loss
     return loss

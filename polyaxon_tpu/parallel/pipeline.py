@@ -30,8 +30,16 @@ def _pp_body(
     block: Callable,
     axis: str,
     n_micro: int,
+    aux_fn: Any = None,
+    batch_axis_names: Tuple[str, ...] = (),
 ):
-    """Per-device GPipe schedule. x: [B_local, T, D]; layers: local stages."""
+    """Per-device GPipe schedule. x: [B_local, T, D]; layers: local stages.
+
+    ``aux_fn(aux) -> scalar`` (optional) reduces a block's per-layer aux
+    output (e.g. MoE gate statistics) to a scalar loss; the schedule
+    accumulates it only on a stage's VALID ticks — bubble ticks run the
+    body on stale state and must not pollute the sum.
+    """
     S = lax.psum(1, axis)
     stage = lax.axis_index(axis)
     B, T, D = x.shape
@@ -40,14 +48,19 @@ def _pp_body(
     perm = [(j, (j + 1) % S) for j in range(S)]
 
     def run_stage(inp, pos):
-        out, _ = lax.scan(lambda c, layer: (block(c, pos, layer)[0], None), inp, layers)
-        return out
+        def scan_body(c, layer):
+            out, aux = block(c, pos, layer)
+            return out, (aux_fn(aux) if aux_fn is not None else 0.0)
+
+        out, layer_aux = lax.scan(scan_body, inp, layers)
+        return out, jnp.mean(layer_aux) if aux_fn is not None else 0.0
 
     outputs = jnp.zeros_like(mb)
     state = jnp.zeros_like(mb[0])
+    aux_acc = jnp.zeros((), jnp.float32)
 
     def tick(i, carry):
-        outputs, state = carry
+        outputs, state, aux_acc = carry
         feed = jnp.clip(i, 0, n_micro - 1)
         inp = jnp.where(
             stage == 0, lax.dynamic_index_in_dim(mb, feed, 0, keepdims=False), state
@@ -55,20 +68,85 @@ def _pp_body(
         pos = lax.dynamic_index_in_dim(pos_mb, feed, 0, keepdims=False)
         # Positions are identical across microbatches for standard LM
         # batches; stage>0 reuses the fed index's positions safely.
-        out = run_stage(inp, pos)
+        out, stage_aux = run_stage(inp, pos)
+        # Stage s processes real microbatches exactly on ticks [s, s+M).
+        valid = (i >= stage) & (i < stage + n_micro)
+        aux_acc = aux_acc + jnp.where(valid, stage_aux, 0.0)
         j = i - (S - 1)
         jc = jnp.clip(j, 0, n_micro - 1)
         cur = lax.dynamic_index_in_dim(outputs, jc, 0, keepdims=False)
         val = jnp.where((stage == S - 1) & (j >= 0), out, cur)
         outputs = lax.dynamic_update_index_in_dim(outputs, val, jc, 0)
         state = lax.ppermute(out, axis, perm)
-        return outputs, state
+        return outputs, state, aux_acc
 
-    outputs, _ = lax.fori_loop(0, n_micro + S - 1, tick, (outputs, state))
+    outputs, _, aux_acc = lax.fori_loop(
+        0, n_micro + S - 1, tick, (outputs, state, aux_acc)
+    )
     # Only the last stage holds real outputs; broadcast over the pipeline
     # axis so downstream (final norm + unembed) sees replicated activations.
     outputs = lax.psum(jnp.where(stage == S - 1, outputs, 0.0), axis)
-    return outputs.reshape(B, T, D)
+    # Mean over stages (each holds L/S layers) and microbatches; the aux
+    # claims replication in out_specs, so it must also be averaged over any
+    # batch-sharding axes (each data shard saw different tokens).
+    aux = lax.psum(aux_acc, axis) / (S * n_micro)
+    if batch_axis_names:
+        aux = lax.pmean(aux, batch_axis_names)
+    return outputs.reshape(B, T, D), aux
+
+
+def pipeline_scan_composed(
+    block: Callable,
+    x: jax.Array,
+    positions: jax.Array,
+    stacked_layers: Any,
+    mesh,
+    *,
+    axis: str = "pipeline",
+    num_microbatches: int = 1,
+    aux_fn: Any = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """GPipe over ``axis`` with every OTHER mesh axis left to GSPMD.
+
+    The composition mode (dp×tp×pp): ``jax.shard_map`` is manual over the
+    pipeline axis only, so inside each stage the block's logical sharding
+    constraints stay live and XLA shards attention/MLP over ``tensor`` and
+    the batch over ``data`` exactly as in the non-pipelined path.  Layer
+    stacks are manually split over stages (P(axis) leading dim) while their
+    tensor-sharded trailing dims ride through as auto axes.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_stages = mesh.shape[axis]
+    n_layers = jax.tree.leaves(stacked_layers)[0].shape[0]
+    if n_layers % n_stages:
+        raise RuntimeLayerError(
+            f"{n_layers} layers not divisible into {n_stages} pipeline stages"
+        )
+    if x.shape[0] % num_microbatches:
+        raise RuntimeLayerError(
+            f"Global batch {x.shape[0]} not divisible by "
+            f"{num_microbatches} microbatches"
+        )
+    layer_spec = jax.tree.map(lambda _: P(axis), stacked_layers)
+    fn = jax.shard_map(
+        partial(
+            _pp_body,
+            block=block,
+            axis=axis,
+            n_micro=num_microbatches,
+            aux_fn=aux_fn,
+            # Auto axes are GSPMD-global inside the body: the aux scalar is
+            # already a full-batch value, no pmean over data needed.
+            batch_axis_names=(),
+        ),
+        mesh=mesh,
+        in_specs=(P(), P(), layer_spec),
+        out_specs=(P(), P()),
+        axis_names={axis},
+        check_vma=False,
+    )
+    return fn(x, positions, stacked_layers)
 
 
 def pipeline_scan(
@@ -81,12 +159,16 @@ def pipeline_scan(
     axis: str = "pipeline",
     num_microbatches: int = 1,
     batch_axes: Union[str, Tuple[str, ...], None] = None,
-) -> jax.Array:
+    aux_fn: Any = None,
+) -> Tuple[jax.Array, jax.Array]:
     """Drop-in replacement for the layer ``lax.scan``, pipelined over ``axis``.
 
     ``block(x, positions, layer) -> (x, aux)`` is the same body the dense
     path scans. The stacked ``layers`` leading dim must divide by the
     pipeline axis size, and the local batch by ``num_microbatches``.
+    Returns ``(outputs, aux_scalar)`` — aux is the mean of
+    ``aux_fn(block_aux)`` over layers and microbatches (0.0 without aux_fn),
+    which is how MoE's load-balancing loss crosses the shard_map boundary.
     """
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
@@ -114,11 +196,23 @@ def pipeline_scan(
     x_spec = P(batch_axes, None, None)
     pos_spec = P(batch_axes, None)
     layer_spec = jax.tree.map(lambda _: P(axis), stacked_layers)
+    batch_axis_names = (
+        (batch_axes,)
+        if isinstance(batch_axes, str)
+        else tuple(a for a in (batch_axes or ()) if a in mesh.shape)
+    )
     fn = shard_map(
-        partial(_pp_body, block=block, axis=axis, n_micro=num_microbatches),
+        partial(
+            _pp_body,
+            block=block,
+            axis=axis,
+            n_micro=num_microbatches,
+            aux_fn=aux_fn,
+            batch_axis_names=batch_axis_names,
+        ),
         mesh=mesh,
         in_specs=(x_spec, pos_spec, layer_spec),
-        out_specs=x_spec,
+        out_specs=(x_spec, P()),
         check_rep=False,
     )
     return fn(x, positions, stacked_layers)

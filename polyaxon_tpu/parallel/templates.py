@@ -54,6 +54,10 @@ class StrategyTemplate:
     pipeline_axis: Optional[str] = None
     #: microbatch count for the pipeline schedule
     num_microbatches: int = 1
+    #: composition mode: the pipeline shard_map is manual over
+    #: ``pipeline_axis`` ONLY, leaving data/tensor axes to GSPMD so the
+    #: block's sharding constraints stay live inside stages (dp×tp×pp)
+    pipeline_composed: bool = False
     options: Dict[str, Any] = field(default_factory=dict)
 
     def batch_spec(self):
@@ -129,6 +133,33 @@ def template_for(
             data,
             pipeline_axis="pipeline",
             num_microbatches=int(options.get("num_microbatches", mesh_axes["pipeline"])),
+            options=options,
+        )
+
+    if strategy == "pp_tp":
+        # 3-axis composition: batch over data, attention/MLP over tensor,
+        # layers over pipeline — the scaling-book "combine all three"
+        # recipe as one template.
+        for ax in ("pipeline", "tensor"):
+            if ax not in mesh_axes:
+                raise RuntimeLayerError(f"pp_tp strategy needs a '{ax}' mesh axis")
+        rules = {
+            **batch_rules,
+            "layers": "pipeline",
+            "heads": "tensor",
+            "mlp": "tensor",
+            "vocab": "tensor",
+            "attn_heads": "tensor",
+        }
+        return StrategyTemplate(
+            "pp_tp",
+            rules,
+            data,
+            pipeline_axis="pipeline",
+            pipeline_composed=True,
+            num_microbatches=int(
+                options.get("num_microbatches", mesh_axes["pipeline"])
+            ),
             options=options,
         )
 

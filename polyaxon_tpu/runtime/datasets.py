@@ -127,12 +127,17 @@ class DatasetReader:
     def batches_per_epoch(self) -> int:
         return self.num_examples // self.global_batch
 
-    def epoch(self, epoch: int) -> Iterator[Dict[str, np.ndarray]]:
-        """This host's slice of every global batch of one epoch."""
+    def epoch(
+        self, epoch: int, start_batch: int = 0
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        """This host's slice of each global batch, from ``start_batch`` on.
+
+        Skipped batches cost only the (already computed) permutation — no
+        row gathers, so a deep resume is O(1) per skipped batch."""
         rng = np.random.default_rng((self.seed, epoch))
         perm = rng.permutation(self.num_examples)
         per_host = self.global_batch // self.num_processes
-        for b in range(self.batches_per_epoch):
+        for b in range(start_batch, self.batches_per_epoch):
             batch_idx = perm[b * self.global_batch : (b + 1) * self.global_batch]
             lo = self.process_id * per_host
             local_idx = batch_idx[lo : lo + per_host]
@@ -152,10 +157,7 @@ class DatasetReader:
             )
         epoch, skip = divmod(start_step, bpe)
         while True:
-            for i, batch in enumerate(self.epoch(epoch)):
-                if i < skip:
-                    continue
-                yield batch
+            yield from self.epoch(epoch, start_batch=skip)
             skip = 0
             epoch += 1
 
@@ -216,6 +218,27 @@ def register_cifar10(
     return out
 
 
+def synthetic_class_images(
+    rng: np.random.Generator,
+    num_examples: int,
+    image_size: int,
+    n_classes: int,
+) -> tuple:
+    """Class-conditional noisy-template images, uint8 NHWC.
+
+    THE synthetic image recipe — shared by the fixture dataset and
+    ``cnn_train``'s no-dataset benchmark branch so the two can never
+    diverge. Per-example noise keeps the learnability check honest (without
+    it a batch holds only ``n_classes`` distinct images)."""
+    templates = rng.normal(size=(n_classes, image_size, image_size, 3))
+    labels = rng.integers(0, n_classes, num_examples)
+    noisy = templates[labels] + 0.3 * rng.normal(
+        size=(num_examples, image_size, image_size, 3)
+    )
+    images = np.clip(noisy * 32 + 128, 0, 255).astype(np.uint8)
+    return images, labels.astype(np.int32)
+
+
 def make_image_fixture(
     data_dir: Union[str, Path],
     name: str,
@@ -229,12 +252,9 @@ def make_image_fixture(
     """A CIFAR-shaped learnable fixture dataset (class-conditional noisy
     templates) — CI-sized stand-in for the real archive, same read path."""
     rng = np.random.default_rng(seed)
-    templates = rng.normal(size=(n_classes, image_size, image_size, 3))
-    labels = rng.integers(0, n_classes, num_examples)
-    images = templates[labels] + 0.3 * rng.normal(
-        size=(num_examples, image_size, image_size, 3)
+    images, labels = synthetic_class_images(
+        rng, num_examples, image_size, n_classes
     )
-    images = np.clip((images * 32 + 128), 0, 255).astype(np.uint8)
     per = num_examples // shards
     shard_list = [
         {

@@ -61,25 +61,37 @@ def build_train_step(
 
     jit_init = jax.jit(init_fn, out_shardings=param_shardings)
 
-    def init(rng):
+    def _opt_state_shardings(params):
+        """Shardings for the optimizer state: any sub-tree that mirrors the
+        param tree (optax's mu/nu/trace) gets the param shardings leaf for
+        leaf; everything else (step counts, empty states) replicates.
+
+        ``jax.jit(optimizer.init)`` alone gets this wrong in both
+        directions — leaves with no data dependence on params (the count)
+        land on device 0, and without out_shardings nothing forces mu/nu
+        onto the params' placement."""
         from jax.sharding import PartitionSpec
 
-        params = jit_init(rng)
-        # Optimizer state inherits placement from params via propagation —
-        # EXCEPT leaves with no data dependence on params (optax's step
-        # count): XLA parks those on device 0, which poisons the donated
-        # step with mixed device sets and leaves checkpoint restore without
-        # a mesh-wide template. Replicate them across the mesh explicitly.
-        opt_state = jax.jit(optimizer.init)(params)
         replicated = NamedSharding(mesh, PartitionSpec())
-        opt_state = jax.tree.map(
-            lambda x: (
-                x
-                if isinstance(getattr(x, "sharding", None), NamedSharding)
-                else jax.device_put(x, replicated)
-            ),
-            opt_state,
-        )
+        param_treedef = jax.tree.structure(params)
+
+        def rec(node):
+            if jax.tree.structure(node) == param_treedef:
+                return param_shardings
+            if hasattr(node, "_fields"):  # optax's namedtuple states
+                return type(node)(*(rec(c) for c in node))
+            if isinstance(node, (tuple, list)):
+                return type(node)(rec(c) for c in node)
+            return replicated
+
+        abstract = jax.eval_shape(optimizer.init, params)
+        return rec(abstract)
+
+    def init(rng):
+        params = jit_init(rng)
+        opt_state = jax.jit(
+            optimizer.init, out_shardings=_opt_state_shardings(params)
+        )(params)
         return params, opt_state
 
     def _step(params, opt_state, batch, rng):
