@@ -35,6 +35,10 @@ class GangPlan:
     #: Service kinds only (notebook/tensorboard): the port the service must
     #: bind. None = not a service; 0 = allocate at dispatch time.
     service_port: Optional[int] = None
+    #: Cross-slice (DCN) mesh axes, subset of ``mesh_axes``; empty for
+    #: single-slice gangs. The worker builds a hybrid device mesh from the
+    #: split so DCN axes never land on ICI-hungry dimensions.
+    dcn_axes: Dict[str, int] = field(default_factory=dict)
 
     @property
     def world_size(self) -> int:
@@ -67,9 +71,13 @@ def compile_gang_plan(spec: BaseSpecification) -> GangPlan:
     """Emit the concrete gang topology for a runnable spec."""
     topo = spec.environment.topology
     try:
-        mesh_axes = topo.resolved_mesh()
+        ici_axes = topo.resolved_mesh()
+        dcn_axes = topo.resolved_dcn()
     except ValueError as e:
         raise CompilerError(str(e)) from e
+    # The combined logical mesh (templates consume it); DCN axes lead so the
+    # hybrid mesh builder places them across slices.
+    mesh_axes = {**dcn_axes, **ici_axes}
     # Service kinds carry a port in the plan (reference: the notebook/
     # tensorboard deployments' containerPort + service objects,
     # ``polypod/tensorboard.py:32``); 0 defers allocation to dispatch.
@@ -80,7 +88,7 @@ def compile_gang_plan(spec: BaseSpecification) -> GangPlan:
     if service_port == 0 and spec.declarations.get("port"):
         service_port = int(spec.declarations["port"])
     return GangPlan(
-        num_hosts=int(topo.num_hosts),
+        num_hosts=int(topo.num_hosts) * int(topo.num_slices),
         devices_per_host=topo.devices_per_host,
         mesh_axes=mesh_axes,
         strategy=topo.strategy,
@@ -90,4 +98,5 @@ def compile_gang_plan(spec: BaseSpecification) -> GangPlan:
         max_restarts=spec.environment.restart_policy.max_restarts,
         backoff_seconds=spec.environment.restart_policy.backoff_seconds,
         service_port=service_port,
+        dcn_axes=dcn_axes,
     )

@@ -27,6 +27,7 @@ class EnvVars:
     DEVICES_PER_HOST = "POLYAXON_TPU_DEVICES_PER_HOST"
     ACCELERATOR = "POLYAXON_TPU_ACCELERATOR"
     MESH = "POLYAXON_TPU_MESH"
+    MESH_DCN = "POLYAXON_TPU_MESH_DCN"
     STRATEGY = "POLYAXON_TPU_STRATEGY"
     STRATEGY_OPTIONS = "POLYAXON_TPU_STRATEGY_OPTIONS"
     HEARTBEAT_INTERVAL = "POLYAXON_TPU_HEARTBEAT_INTERVAL"
@@ -48,6 +49,8 @@ class GangInfo:
     devices_per_host: int
     accelerator: str
     mesh_axes: Dict[str, int]
+    #: subset of mesh_axes spanning slices (DCN); empty for single-slice
+    dcn_axes: Dict[str, int]
     strategy: str
     strategy_options: Dict[str, Any]
     heartbeat_interval: float
@@ -71,6 +74,7 @@ class GangInfo:
             devices_per_host=int(e.get(EnvVars.DEVICES_PER_HOST, "1")),
             accelerator=e.get(EnvVars.ACCELERATOR, "cpu"),
             mesh_axes=json.loads(e.get(EnvVars.MESH, "{}")),
+            dcn_axes=json.loads(e.get(EnvVars.MESH_DCN, "{}")),
             strategy=e.get(EnvVars.STRATEGY, "ddp"),
             strategy_options=json.loads(e.get(EnvVars.STRATEGY_OPTIONS, "{}")),
             heartbeat_interval=float(e.get(EnvVars.HEARTBEAT_INTERVAL, "5.0")),
@@ -92,6 +96,7 @@ def gang_env(
     accelerator: str,
     mesh_axes: Dict[str, int],
     strategy: str,
+    dcn_axes: Optional[Dict[str, int]] = None,
     strategy_options: Dict[str, Any],
     heartbeat_interval: float = 5.0,
     seed: Optional[int] = None,
@@ -108,6 +113,7 @@ def gang_env(
         EnvVars.DEVICES_PER_HOST: str(devices_per_host),
         EnvVars.ACCELERATOR: accelerator,
         EnvVars.MESH: json.dumps(mesh_axes),
+        EnvVars.MESH_DCN: json.dumps(dcn_axes or {}),
         EnvVars.STRATEGY: strategy,
         EnvVars.STRATEGY_OPTIONS: json.dumps(strategy_options),
         EnvVars.HEARTBEAT_INTERVAL: str(heartbeat_interval),

@@ -18,8 +18,19 @@ import numpy as np
 from polyaxon_tpu.exceptions import RuntimeLayerError
 
 
-def build_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
-    """Build a ``jax.sharding.Mesh`` over all (or the given) devices."""
+def build_mesh(
+    axes: Dict[str, int],
+    devices: Optional[Sequence] = None,
+    dcn_axes: Optional[Dict[str, int]] = None,
+):
+    """Build a ``jax.sharding.Mesh`` over all (or the given) devices.
+
+    ``dcn_axes`` (a subset of ``axes``, by name) marks axes spanning
+    SLICES: the hybrid builder assigns them across slice boundaries (slow
+    DCN links) and lays the remaining ICI axes within each slice — the
+    multi-slice/megascale recipe (data-like parallelism over DCN, tensor/
+    sequence/pipeline over ICI).
+    """
     import jax
     from jax.experimental import mesh_utils
     from jax.sharding import Mesh
@@ -32,6 +43,47 @@ def build_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
         raise RuntimeLayerError(
             f"Mesh axes {axes} need {n} devices, have {len(devices)}"
         )
+    dcn_axes = dcn_axes or {}
+    if dcn_axes:
+        unknown = set(dcn_axes) - set(axes)
+        if unknown:
+            raise RuntimeLayerError(f"dcn axes {unknown} not in mesh axes {axes}")
+        mismatched = {a for a, size in dcn_axes.items() if axes[a] != size}
+        if mismatched:
+            raise RuntimeLayerError(
+                f"dcn axis sizes disagree with mesh axes for {sorted(mismatched)}: "
+                f"dcn={dcn_axes} mesh={axes}"
+            )
+        # Reorder: DCN axes lead, ICI axes follow (the spec compiler already
+        # emits this order; re-assert it here for direct callers).
+        names = tuple(dcn_axes) + tuple(a for a in axes if a not in dcn_axes)
+        sizes = {**axes}
+        shape = tuple(sizes[a] for a in names)
+        # create_hybrid_device_mesh wants same-rank shapes with elementwise
+        # product = axis size: a pure-DCN axis is 1 on the ICI side and
+        # vice versa.
+        ici_shape = tuple(1 if a in dcn_axes else sizes[a] for a in names)
+        dcn_shape = tuple(sizes[a] if a in dcn_axes else 1 for a in names)
+        # Route on real slice metadata: the hybrid builder only when the
+        # devices genuinely span that many slices; a mismatch on hardware
+        # is a misconfiguration that must surface (a naive reshape would
+        # silently put ICI axes across DCN); CPU/virtual meshes (single or
+        # absent slice id) reshape with process-contiguous blocks playing
+        # the slices.
+        n_slices = int(np.prod(tuple(dcn_axes.values())))
+        slice_ids = {getattr(d, "slice_index", None) for d in devices}
+        if None in slice_ids or len(slice_ids) == 1:
+            dev_array = np.asarray(list(devices)).reshape(shape)
+        elif len(slice_ids) == n_slices:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=list(devices)
+            )
+        else:
+            raise RuntimeLayerError(
+                f"Topology declares {n_slices} slices over {dcn_axes} but the "
+                f"devices span {len(slice_ids)} slices"
+            )
+        return Mesh(dev_array, names)
     try:
         dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
     except (ValueError, AssertionError, NotImplementedError):

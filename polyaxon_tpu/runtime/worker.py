@@ -142,7 +142,7 @@ def main() -> int:
 
         mesh = None
         if info.mesh_axes:
-            mesh = build_mesh(info.mesh_axes)
+            mesh = build_mesh(info.mesh_axes, dcn_axes=info.dcn_axes)
 
         params = dict(spec.declarations)
         params.update(run_cfg.kwargs)

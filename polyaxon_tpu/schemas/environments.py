@@ -51,7 +51,10 @@ ACCELERATOR_CATALOG: Dict[str, Tuple[int, int]] = {
 #: innermost (ICI-bandwidth-hungry: tensor) last.
 CANONICAL_AXES = ("replica", "data", "fsdp", "pipeline", "expert", "sequence", "tensor")
 
-STRATEGIES = ("ddp", "fsdp", "tp", "tp_dp", "pp", "sp_ring", "ulysses", "ep", "custom")
+STRATEGIES = (
+    "ddp", "fsdp", "tp", "tp_dp", "pp", "pp_tp", "sp_ring", "ulysses", "ep",
+    "custom",
+)
 
 
 class MeshConfig(BaseModel):
@@ -104,6 +107,12 @@ class TopologyConfig(BaseModel):
     num_hosts: Optional[int] = Field(default=None, ge=1)
     num_devices: Optional[int] = Field(default=None, ge=1)
     mesh: Optional[MeshConfig] = None
+    #: Multi-slice (DCN/megascale): per-slice topology above, slice count
+    #: here. The dcn_axis becomes the leading mesh axis spanning slices —
+    #: keep it a data-like axis (default "replica") so only the gradient
+    #: all-reduce rides DCN while bandwidth-hungry axes stay on ICI.
+    num_slices: int = Field(default=1, ge=1)
+    dcn_axis: str = "replica"
     strategy: str = "ddp"
     #: Extra knobs for templates (e.g. microbatches for pp, ring chunk size).
     strategy_options: Dict[str, Any] = Field(default_factory=dict)
@@ -146,13 +155,37 @@ class TopologyConfig(BaseModel):
             )
         if self.mesh is not None:
             self.mesh.resolve(self.num_devices)  # raises if inconsistent
+        if self.num_slices > 1:
+            # The cross-slice axis must be data-like: anything else (tensor/
+            # sequence/pipeline) would put bandwidth-hungry collectives on
+            # the slow DCN link. And it must not collide with a per-slice
+            # axis — this check runs against the RESOLVED mesh so the
+            # default {'data': N} case is covered too.
+            data_like = ("replica", "data", "fsdp")
+            if self.dcn_axis not in data_like:
+                raise ValueError(
+                    f"dcn_axis {self.dcn_axis!r} must be a data-like axis "
+                    f"{data_like}: cross-slice (DCN) bandwidth only suits "
+                    "batch-gradient traffic"
+                )
+            if self.dcn_axis in self.resolved_mesh():
+                raise ValueError(
+                    f"dcn_axis {self.dcn_axis!r} collides with a per-slice "
+                    "(ICI) mesh axis; the cross-slice axis must differ"
+                )
         return self
 
     def resolved_mesh(self) -> Dict[str, int]:
-        """The concrete axis->size mapping (default: pure data parallel)."""
+        """Per-slice (ICI) axis->size mapping (default: pure data parallel)."""
         if self.mesh is None:
             return {"data": int(self.num_devices)}
         return self.mesh.resolve(int(self.num_devices))
+
+    def resolved_dcn(self) -> Dict[str, int]:
+        """The cross-slice (DCN) axes; empty for single-slice runs."""
+        if self.num_slices <= 1:
+            return {}
+        return {self.dcn_axis: int(self.num_slices)}
 
     @property
     def devices_per_host(self) -> int:

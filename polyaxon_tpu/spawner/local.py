@@ -168,6 +168,7 @@ class GangSpawner:
                 accelerator=plan.accelerator,
                 mesh_axes=plan.mesh_axes,
                 strategy=plan.strategy,
+                dcn_axes=plan.dcn_axes,
                 strategy_options=plan.strategy_options,
                 heartbeat_interval=self.heartbeat_interval,
                 seed=run.spec.environment.seed,

@@ -58,3 +58,43 @@ class TestDistributedGang:
         assert "final loss" in logs
         first = orch.registry.get_metrics(run.id)[0]["values"]["loss"]
         assert done.last_metric["loss"] < first
+
+    def test_multi_slice_gang_trains_over_dcn_axis(self, orch):
+        """num_slices=2: one process per slice, the replica (DCN) axis
+        leads the hybrid mesh, and the LM trains across the slice boundary
+        (gloo stands in for DCN on CPU)."""
+        run = orch.submit(
+            {
+                "kind": "experiment",
+                "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:lm_train"},
+                "declarations": {
+                    "steps": 3,
+                    "batch": 4,
+                    "seq": 16,
+                    "d_model": 32,
+                    "n_layers": 2,
+                    "n_heads": 4,
+                    "head_dim": 8,
+                    "d_ff": 64,
+                    "vocab_size": 64,
+                },
+                "environment": {
+                    "seed": 5,
+                    "topology": {
+                        "accelerator": "cpu",
+                        "num_devices": 2,
+                        "num_hosts": 1,
+                        "num_slices": 2,
+                        "strategy": "ddp",
+                    },
+                },
+            },
+            name="multislice-e2e",
+        )
+        done = orch.wait(run.id, timeout=300)
+        logs = "\n".join(l["line"] for l in orch.registry.get_logs(run.id))
+        assert done.status == S.SUCCEEDED, logs
+        # One gang process per slice.
+        assert len(orch.registry.get_processes(run.id)) == 2
+        assert "lm_train done" in logs
+        assert done.last_metric.get("tokens_per_s", 0) > 0
