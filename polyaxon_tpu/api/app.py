@@ -105,6 +105,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 project=body.get("project", "default"),
                 name=body.get("name"),
                 tags=body.get("tags"),
+                actor=request.get("actor"),
             )
         except PolyaxonTPUError as e:
             return web.json_response({"error": str(e)}, status=400)
@@ -116,26 +117,33 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         statuses = q.getall("status", []) or None
         limit = _int_param(request, "limit", 100)
         offset = _int_param(request, "offset", 0)
-        # With a DSL filter the full candidate set is fetched (the filter
-        # must run BEFORE pagination or matches past the first page
-        # vanish); without one, pagination pushes down to SQL.
-        has_query = "q" in q
+        # DSL conditions on real columns push down to SQL WHERE; only
+        # JSON-payload conditions (metric.*, declarations.*, tags) filter
+        # in process — and only those force fetch-then-paginate.
+        from polyaxon_tpu.query import (
+            QueryError,
+            apply_query,
+            compile_to_sql,
+            parse_query,
+        )
+
+        try:
+            conds = parse_query(q.get("q"))
+            clauses, params, residual = compile_to_sql(conds)
+        except QueryError as e:
+            return web.json_response({"error": str(e)}, status=400)
         runs = reg.list_runs(
             kind=q.get("kind"),
             project=q.get("project"),
             group_id=_int_param(request, "group_id"),
             pipeline_id=_int_param(request, "pipeline_id"),
             statuses=statuses,
-            limit=None if has_query else limit,
-            offset=0 if has_query else offset,
+            extra_where=(clauses, params) if clauses else None,
+            limit=None if residual else limit,
+            offset=0 if residual else offset,
         )
-        if has_query:  # search DSL, e.g. q=status:running,metric.loss:<0.5
-            from polyaxon_tpu.query import QueryError, apply_query
-
-            try:
-                runs = apply_query(runs, q["q"])
-            except QueryError as e:
-                return web.json_response({"error": str(e)}, status=400)
+        if residual:
+            runs = apply_query(runs, conditions=residual)
             runs = runs[offset : offset + limit]
         return web.json_response({"results": [run_to_dict(r) for r in runs]})
 
@@ -146,25 +154,25 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/stop")
     async def stop_run(request):
         run = _run_or_404(request)
-        orch.stop_run(run.id)
+        orch.stop_run(run.id, actor=request.get("actor"))
         return web.json_response({"ok": True})
 
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/restart")
     async def restart_run(request):
         run = _run_or_404(request)
-        clone = orch.clone_run(run.id, strategy="restart")
+        clone = orch.clone_run(run.id, strategy="restart", actor=request.get("actor"))
         return web.json_response(run_to_dict(clone), status=201)
 
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/resume")
     async def resume_run(request):
         run = _run_or_404(request)
-        clone = orch.clone_run(run.id, strategy="resume")
+        clone = orch.clone_run(run.id, strategy="resume", actor=request.get("actor"))
         return web.json_response(run_to_dict(clone), status=201)
 
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/copy")
     async def copy_run(request):
         run = _run_or_404(request)
-        clone = orch.clone_run(run.id, strategy="copy")
+        clone = orch.clone_run(run.id, strategy="copy", actor=request.get("actor"))
         return web.json_response(run_to_dict(clone), status=201)
 
     # -- sub-resources --------------------------------------------------------
@@ -248,6 +256,128 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         await resp.write_eof()
         return resp
 
+    # -- projects (reference api/projects/) ------------------------------------
+    @routes.post(f"{API_PREFIX}/projects")
+    async def create_project(request):
+        body = await request.json()
+        try:
+            project = reg.create_project(
+                body["name"], description=body.get("description")
+            )
+        except KeyError:
+            return web.json_response({"error": "project needs a name"}, status=400)
+        except PolyaxonTPUError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(project, status=201)
+
+    @routes.get(f"{API_PREFIX}/projects")
+    async def list_projects(request):
+        return web.json_response({"results": reg.list_projects()})
+
+    @routes.get(f"{API_PREFIX}/projects/{{name}}")
+    async def get_project(request):
+        project = reg.get_project(request.match_info["name"])
+        if project is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "no such project"}),
+                content_type="application/json",
+            )
+        return web.json_response(project)
+
+    @routes.delete(f"{API_PREFIX}/projects/{{name}}")
+    async def delete_project(request):
+        try:
+            removed = reg.delete_project(request.match_info["name"])
+        except PolyaxonTPUError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if not removed:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "no such project"}),
+                content_type="application/json",
+            )
+        return web.json_response({"ok": True})
+
+    # -- saved searches (reference api/searches/) -------------------------------
+    @routes.post(f"{API_PREFIX}/searches")
+    async def create_search(request):
+        from polyaxon_tpu.query import QueryError, compile_to_sql, parse_query
+
+        body = await request.json()
+        try:
+            # Validate at save time — a stored search must never 400 later.
+            compile_to_sql(parse_query(body["query"]))
+            search = reg.create_search(
+                body["name"], body["query"], owner=request.get("actor")
+            )
+        except KeyError:
+            return web.json_response(
+                {"error": "search needs name and query"}, status=400
+            )
+        except (QueryError, PolyaxonTPUError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(search, status=201)
+
+    @routes.get(f"{API_PREFIX}/searches")
+    async def list_searches(request):
+        return web.json_response({"results": reg.list_searches()})
+
+    @routes.delete(f"{API_PREFIX}/searches/{{name}}")
+    async def delete_search(request):
+        if not reg.delete_search(request.match_info["name"]):
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "no such search"}),
+                content_type="application/json",
+            )
+        return web.json_response({"ok": True})
+
+    @routes.get(f"{API_PREFIX}/searches/{{name}}/runs")
+    async def execute_search(request):
+        from polyaxon_tpu.query import apply_query, compile_to_sql, parse_query
+
+        search = reg.get_search(request.match_info["name"])
+        if search is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "no such search"}),
+                content_type="application/json",
+            )
+        clauses, params, residual = compile_to_sql(parse_query(search["query"]))
+        limit = _int_param(request, "limit", 100)
+        runs = reg.list_runs(
+            extra_where=(clauses, params) if clauses else None,
+            limit=None if residual else limit,
+        )
+        if residual:
+            runs = apply_query(runs, conditions=residual)[:limit]
+        return web.json_response({"results": [run_to_dict(r) for r in runs]})
+
+    # -- bookmarks (reference api/bookmarks/) ----------------------------------
+    def _bookmark_owner(request) -> str:
+        # '' == anonymous, shared with local-CLI bookmarks on the same
+        # base dir; authenticated users get per-user bookmarks.
+        actor = request.get("actor")
+        return "" if actor in (None, "anonymous") else actor
+
+    @routes.post(f"{API_PREFIX}/runs/{{run_id}}/bookmark")
+    async def add_bookmark(request):
+        run = _run_or_404(request)
+        reg.add_bookmark(run.id, owner=_bookmark_owner(request))
+        return web.json_response({"ok": True}, status=201)
+
+    @routes.delete(f"{API_PREFIX}/runs/{{run_id}}/bookmark")
+    async def remove_bookmark(request):
+        run = _run_or_404(request)
+        if not reg.remove_bookmark(run.id, owner=_bookmark_owner(request)):
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "not bookmarked"}),
+                content_type="application/json",
+            )
+        return web.json_response({"ok": True})
+
+    @routes.get(f"{API_PREFIX}/bookmarks")
+    async def list_bookmarks(request):
+        runs = reg.list_bookmarked_runs(owner=_bookmark_owner(request))
+        return web.json_response({"results": [run_to_dict(r) for r in runs]})
+
     # -- devices (accelerator inventory) --------------------------------------
     @routes.get(f"{API_PREFIX}/devices")
     async def list_devices(request):
@@ -263,6 +393,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 body["accelerator"],
                 int(body["chips"]),
                 num_hosts=int(body.get("num_hosts", 1)),
+                actor=request.get("actor"),
             )
         except (KeyError, TypeError, ValueError) as e:
             return web.json_response(
@@ -319,26 +450,83 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             request, lambda rid, cur: reg.get_metrics(rid, since_id=cur)
         )
 
+    # -- users (per-user tokens; reference scopes/ + user models) --------------
+    def _resolve_actor(request):
+        """(actor, role) for the supplied bearer token; None = bad token.
+
+        The shared bootstrap token maps to the 'root' admin; user tokens
+        are looked up hashed in the registry.
+        """
+        import hmac
+
+        supplied = request.headers.get("Authorization", "")
+        if not supplied.startswith("Bearer "):
+            return None
+        token = supplied[len("Bearer "):]
+        if auth_token and hmac.compare_digest(
+            token.encode("utf-8", "surrogateescape"), auth_token.encode()
+        ):
+            return ("root", "admin")
+        user = reg.get_user_by_token(token)
+        if user is not None:
+            return (user["username"], user["role"])
+        return None
+
+    def _require_admin(request):
+        if request.get("role") != "admin":
+            raise web.HTTPForbidden(
+                text=json.dumps({"error": "admin role required"}),
+                content_type="application/json",
+            )
+
+    @routes.post(f"{API_PREFIX}/users")
+    async def create_user(request):
+        _require_admin(request)
+        body = await request.json()
+        try:
+            user, token = reg.create_user(
+                body["username"], role=body.get("role", "user")
+            )
+        except (KeyError, PolyaxonTPUError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        # The token is shown exactly once; only its hash is stored.
+        return web.json_response({**user, "token": token}, status=201)
+
+    @routes.get(f"{API_PREFIX}/users")
+    async def list_users(request):
+        _require_admin(request)
+        return web.json_response({"results": reg.list_users()})
+
+    @routes.delete(f"{API_PREFIX}/users/{{username}}")
+    async def remove_user(request):
+        _require_admin(request)
+        if not reg.remove_user(request.match_info["username"]):
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "no such user"}),
+                content_type="application/json",
+            )
+        return web.json_response({"ok": True})
+
     @web.middleware
     async def auth_middleware(request, handler):
         # "/" (the static dashboard shell — no data in it) and the health
         # endpoint stay open; the dashboard's API fetches carry the bearer
-        # token the user supplies once via ?token=.
+        # token the user supplies once via ?token=.  Auth is required when
+        # a bootstrap token is configured OR any user exists (checked per
+        # request — users can be minted at runtime).
         open_paths = ("/", f"{API_PREFIX}/status")
-        if auth_token and request.path not in open_paths:
-            import hmac
-
-            supplied = request.headers.get("Authorization", "")
-            # Compare bytes: compare_digest(str, str) raises on non-ASCII,
-            # which would turn a garbage header into a 500 instead of a 401.
-            expected = f"Bearer {auth_token}".encode()
-            if not hmac.compare_digest(
-                supplied.encode("utf-8", "surrogateescape"), expected
-            ):
+        required = bool(auth_token) or reg.has_users()
+        if required and request.path not in open_paths:
+            resolved = _resolve_actor(request)
+            if resolved is None:
                 return web.json_response({"error": "unauthorized"}, status=401)
+            request["actor"], request["role"] = resolved
+        else:
+            # Open mode (dev/tests): every caller is the anonymous admin.
+            request["actor"], request["role"] = "anonymous", "admin"
         return await handler(request)
 
-    app = web.Application(middlewares=[auth_middleware] if auth_token else [])
+    app = web.Application(middlewares=[auth_middleware])
     app.add_routes(routes)
     app["orchestrator"] = orch
     return app

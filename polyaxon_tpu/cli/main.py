@@ -20,6 +20,14 @@ from pathlib import Path
 from typing import Any, Dict, Optional
 
 DEFAULT_BASE = os.environ.get("POLYAXON_TPU_HOME", "~/.polyaxon_tpu")
+AUTH_FILE = Path(DEFAULT_BASE).expanduser() / "auth.json"
+
+
+def _stored_auth() -> dict:
+    try:
+        return json.loads(AUTH_FILE.read_text())
+    except (OSError, ValueError):
+        return {}
 
 
 class RemoteClient:
@@ -29,7 +37,13 @@ class RemoteClient:
         self.base = host.rstrip("/")
         if not self.base.startswith("http"):
             self.base = f"http://{self.base}"
-        self.token = token or os.environ.get("POLYAXON_TPU_AUTH_TOKEN")
+        # Priority: explicit flag > env > `polyaxon-tpu login` stored auth.
+        stored = _stored_auth()
+        self.token = (
+            token
+            or os.environ.get("POLYAXON_TPU_AUTH_TOKEN")
+            or (stored.get("token") if stored.get("host") in (host, self.base) else None)
+        )
 
     def _request(self, method: str, path: str, body: Optional[dict] = None) -> Any:
         headers = {"Content-Type": "application/json"}
@@ -94,6 +108,49 @@ class RemoteClient:
 
     def list_artifacts(self, run_id):
         return self._request("GET", f"/api/v1/runs/{run_id}/artifacts")["results"]
+
+    def create_user(self, username, role):
+        return self._request(
+            "POST", "/api/v1/users", {"username": username, "role": role}
+        )
+
+    def list_users(self):
+        return self._request("GET", "/api/v1/users")["results"]
+
+    def remove_user(self, username):
+        return self._request("DELETE", f"/api/v1/users/{username}")
+
+    def create_search(self, name, query):
+        return self._request("POST", "/api/v1/searches", {"name": name, "query": query})
+
+    def list_searches(self):
+        return self._request("GET", "/api/v1/searches")["results"]
+
+    def delete_search(self, name):
+        return self._request("DELETE", f"/api/v1/searches/{name}")
+
+    def execute_search(self, name):
+        return self._request("GET", f"/api/v1/searches/{name}/runs")["results"]
+
+    def create_project(self, name, description):
+        return self._request(
+            "POST", "/api/v1/projects", {"name": name, "description": description}
+        )
+
+    def list_projects(self):
+        return self._request("GET", "/api/v1/projects")["results"]
+
+    def delete_project(self, name):
+        return self._request("DELETE", f"/api/v1/projects/{name}")
+
+    def add_bookmark(self, run_id):
+        return self._request("POST", f"/api/v1/runs/{run_id}/bookmark")
+
+    def remove_bookmark(self, run_id):
+        return self._request("DELETE", f"/api/v1/runs/{run_id}/bookmark")
+
+    def list_bookmarks(self):
+        return self._request("GET", "/api/v1/bookmarks")["results"]
 
     def open_artifact(self, run_id, key):
         """A readable stream over the artifact (caller closes)."""
@@ -171,6 +228,69 @@ class LocalClient:
 
     def list_artifacts(self, run_id):
         return self.orch.list_artifacts(int(run_id))
+
+    def create_user(self, username, role):
+        user, token = self.orch.registry.create_user(username, role=role)
+        return {**user, "token": token}
+
+    def list_users(self):
+        return self.orch.registry.list_users()
+
+    def remove_user(self, username):
+        if not self.orch.registry.remove_user(username):
+            raise SystemExit(f"no user named {username!r}")
+        return {"ok": True}
+
+    def create_search(self, name, query):
+        from polyaxon_tpu.query import compile_to_sql, parse_query
+
+        # Field validation too (same as the API): a stored search must
+        # never blow up at ps --search time.
+        compile_to_sql(parse_query(query))
+        return self.orch.registry.create_search(name, query)
+
+    def list_searches(self):
+        return self.orch.registry.list_searches()
+
+    def delete_search(self, name):
+        if not self.orch.registry.delete_search(name):
+            raise SystemExit(f"no search named {name!r}")
+        return {"ok": True}
+
+    def execute_search(self, name):
+        search = self.orch.registry.get_search(name)
+        if search is None:
+            raise SystemExit(f"no search named {name!r}")
+        from polyaxon_tpu.query import apply_query
+
+        runs = apply_query(self.orch.registry.list_runs(), search["query"])
+        return [self._to_dict(r) for r in runs]
+
+    def create_project(self, name, description):
+        return self.orch.registry.create_project(name, description=description)
+
+    def list_projects(self):
+        return self.orch.registry.list_projects()
+
+    def delete_project(self, name):
+        if not self.orch.registry.delete_project(name):
+            raise SystemExit(f"no project named {name!r}")
+        return {"ok": True}
+
+    def add_bookmark(self, run_id):
+        # Owner '' == anonymous — the same convention the API middleware
+        # maps its open-mode actor to, so local and serve modes share
+        # bookmarks on a common base dir.
+        self.orch.registry.add_bookmark(int(run_id))
+        return {"ok": True}
+
+    def remove_bookmark(self, run_id):
+        if not self.orch.registry.remove_bookmark(int(run_id)):
+            raise SystemExit("not bookmarked")
+        return {"ok": True}
+
+    def list_bookmarks(self):
+        return [self._to_dict(r) for r in self.orch.registry.list_bookmarked_runs()]
 
     def open_artifact(self, run_id, key):
         f = self.orch.open_artifact(int(run_id), key)
@@ -251,6 +371,7 @@ def main(argv=None) -> int:
     p_ps.add_argument(
         "-q", "--query", help='filter DSL, e.g. "status:running,metric.loss:<0.5"'
     )
+    p_ps.add_argument("--search", help="run a saved search by name")
 
     p_get = sub.add_parser("get", help="show one run as json")
     p_get.add_argument("run_id")
@@ -297,11 +418,63 @@ def main(argv=None) -> int:
     p_art_pull.add_argument("key")
     p_art_pull.add_argument("-o", "--output", help="write here (default: stdout)")
 
+    p_proj = sub.add_parser("projects", help="project metadata")
+    proj_sub = p_proj.add_subparsers(dest="projects_command", required=True)
+    p_proj_add = proj_sub.add_parser("add", help="register a project")
+    p_proj_add.add_argument("name")
+    p_proj_add.add_argument("--description")
+    proj_sub.add_parser("list", help="projects with run counts")
+    p_proj_rm = proj_sub.add_parser("remove", help="delete an empty project")
+    p_proj_rm.add_argument("name")
+
+    p_search = sub.add_parser("searches", help="saved run searches")
+    search_sub = p_search.add_subparsers(dest="searches_command", required=True)
+    p_search_add = search_sub.add_parser("add", help="save a query under a name")
+    p_search_add.add_argument("name")
+    p_search_add.add_argument("query")
+    search_sub.add_parser("list", help="list saved searches")
+    p_search_rm = search_sub.add_parser("remove", help="delete a saved search")
+    p_search_rm.add_argument("name")
+
+    p_bm = sub.add_parser("bookmark", help="bookmark a run")
+    p_bm.add_argument("run_id")
+    p_bm.add_argument("-d", "--delete", action="store_true", help="remove instead")
+    sub.add_parser("bookmarks", help="list bookmarked runs")
+
+    p_login = sub.add_parser("login", help="store an API host + token")
+    p_login.add_argument("--api-host", required=True, help="API server address")
+    p_login.add_argument("--api-token", required=True, help="your user token")
+
+    p_users = sub.add_parser("users", help="manage users (admin)")
+    users_sub = p_users.add_subparsers(dest="users_command", required=True)
+    p_users_add = users_sub.add_parser("add", help="create a user, print their token")
+    p_users_add.add_argument("username")
+    p_users_add.add_argument("--role", default="user", choices=("user", "admin"))
+    users_sub.add_parser("list", help="list users")
+    p_users_rm = users_sub.add_parser("remove", help="delete a user")
+    p_users_rm.add_argument("username")
+
     p_serve = sub.add_parser("serve", help="run the API service")
     p_serve.add_argument("--port", type=int, default=8000)
     p_serve.add_argument("--bind", default="127.0.0.1")
 
     args = parser.parse_args(argv)
+
+    if args.command == "login":
+        host = args.api_host.rstrip("/")
+        if not host.startswith("http"):
+            host = f"http://{host}"  # the normalization RemoteClient applies
+        AUTH_FILE.parent.mkdir(parents=True, exist_ok=True)
+        # 0600 from birth — no window where the token is world-readable.
+        import os as _os
+
+        fd = _os.open(
+            AUTH_FILE, _os.O_WRONLY | _os.O_CREAT | _os.O_TRUNC, 0o600
+        )
+        with _os.fdopen(fd, "w") as fh:
+            fh.write(json.dumps({"host": host, "token": args.api_token}))
+        print(f"stored credentials for {host} in {AUTH_FILE}", file=sys.stderr)
+        return 0
 
     if args.command == "serve":
         from polyaxon_tpu.api.app import serve
@@ -326,14 +499,17 @@ def main(argv=None) -> int:
             print(json.dumps(run, indent=2, default=str))
             return 0
         if args.command == "ps":
-            _print_runs(
-                client.list(
-                    project=args.project,
-                    kind=args.kind,
-                    limit=args.limit,
-                    q=args.query,
+            if args.search:
+                _print_runs(client.execute_search(args.search))
+            else:
+                _print_runs(
+                    client.list(
+                        project=args.project,
+                        kind=args.kind,
+                        limit=args.limit,
+                        q=args.query,
+                    )
                 )
-            )
             return 0
         if args.command == "get":
             print(json.dumps(client.get(args.run_id), indent=2, default=str))
@@ -389,6 +565,63 @@ def main(argv=None) -> int:
                         print(f"wrote {args.output}", file=sys.stderr)
                     else:
                         shutil.copyfileobj(src, sys.stdout.buffer)
+            return 0
+        if args.command == "projects":
+            if args.projects_command == "add":
+                print(json.dumps(client.create_project(args.name, args.description)))
+            elif args.projects_command == "list":
+                fmt = "{:16}  {:>6}  {:}"
+                print(fmt.format("NAME", "RUNS", "DESCRIPTION"))
+                for pr in client.list_projects():
+                    print(fmt.format(pr["name"], pr["num_runs"], pr.get("description") or ""))
+            elif args.projects_command == "remove":
+                client.delete_project(args.name)
+                print("removed", file=sys.stderr)
+            return 0
+        if args.command == "searches":
+            if args.searches_command == "add":
+                print(json.dumps(client.create_search(args.name, args.query)))
+            elif args.searches_command == "list":
+                for sr in client.list_searches():
+                    print(f"{sr['name']:20} {sr['query']}")
+            elif args.searches_command == "remove":
+                client.delete_search(args.name)
+                print("removed", file=sys.stderr)
+            return 0
+        if args.command == "bookmark":
+            if args.delete:
+                client.remove_bookmark(args.run_id)
+                print("unbookmarked", file=sys.stderr)
+            else:
+                client.add_bookmark(args.run_id)
+                print("bookmarked", file=sys.stderr)
+            return 0
+        if args.command == "bookmarks":
+            _print_runs(client.list_bookmarks())
+            return 0
+        if args.command == "users":
+            if args.users_command == "add":
+                user = client.create_user(args.username, args.role)
+                print(
+                    f"user {user['username']} ({user['role']}) created; token "
+                    "(shown once):",
+                    file=sys.stderr,
+                )
+                print(user["token"])
+            elif args.users_command == "list":
+                fmt = "{:>4}  {:16}  {:6}  {:}"
+                print(fmt.format("ID", "USERNAME", "ROLE", "LAST USED"))
+                for u in client.list_users():
+                    last = u.get("last_used_at")
+                    print(
+                        fmt.format(
+                            u["id"], u["username"], u["role"],
+                            f"{last:.0f}" if last else "-",
+                        )
+                    )
+            elif args.users_command == "remove":
+                client.remove_user(args.username)
+                print("removed", file=sys.stderr)
             return 0
         if args.command == "devices":
             if args.devices_command == "list":

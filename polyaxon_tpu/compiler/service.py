@@ -48,6 +48,13 @@ class GangPlan:
     def num_devices(self) -> int:
         return self.num_hosts * self.devices_per_host
 
+    @property
+    def num_slices(self) -> int:
+        n = 1
+        for size in self.dcn_axes.values():
+            n *= int(size)
+        return n
+
 
 def compile_spec(
     values: Union[str, Dict[str, Any], BaseSpecification],

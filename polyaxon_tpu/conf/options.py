@@ -73,6 +73,19 @@ _ALL = [
     Option("stores.artifacts_url", str, "",
            "durable artifact store (file:///path or gs://bucket/prefix); "
            "'' disables off-box sync"),
+    Option("notifier.webhook_url", str, "",
+           "notification webhook endpoint ('' = off)"),
+    Option("notifier.webhook_kind", str, "",
+           "payload dialect: slack|discord|mattermost|pagerduty|'' (raw JSON)"),
+    Option("notifier.pagerduty_routing_key", str, "",
+           "Events-API-v2 integration key (webhook_kind=pagerduty)"),
+    Option("notifier.email_host", str, "", "SMTP host ('' = email off)"),
+    Option("notifier.email_port", int, 25, "SMTP port"),
+    Option("notifier.email_from", str, "polyaxon-tpu@localhost", "sender address"),
+    Option("notifier.email_to", str, "", "comma-separated recipients"),
+    Option("notifier.email_tls", bool, False, "STARTTLS before sending"),
+    Option("notifier.email_user", str, "", "SMTP login ('' = no auth)"),
+    Option("notifier.email_password", str, "", "SMTP password"),
     Option("groups.max_concurrency", int, 64,
            "upper bound on a sweep's concurrency setting"),
     Option("restarts.max_allowed", int, 10,

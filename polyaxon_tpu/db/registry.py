@@ -145,6 +145,37 @@ CREATE TABLE IF NOT EXISTS options (
     value TEXT NOT NULL
 );
 
+CREATE TABLE IF NOT EXISTS projects (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT UNIQUE NOT NULL,
+    description TEXT,
+    created_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS searches (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT UNIQUE NOT NULL,
+    query TEXT NOT NULL,
+    owner TEXT,
+    created_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS bookmarks (
+    run_id INTEGER NOT NULL,
+    owner TEXT NOT NULL DEFAULT '',
+    created_at REAL NOT NULL,
+    PRIMARY KEY (run_id, owner)
+);
+
+CREATE TABLE IF NOT EXISTS users (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    username TEXT UNIQUE NOT NULL,
+    token_hash TEXT UNIQUE NOT NULL,
+    role TEXT NOT NULL DEFAULT 'user',
+    created_at REAL NOT NULL,
+    last_used_at REAL
+);
+
 CREATE TABLE IF NOT EXISTS devices (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
     name TEXT UNIQUE NOT NULL,
@@ -354,8 +385,15 @@ class RunRegistry:
         statuses: Optional[Sequence[str]] = None,
         limit: Optional[int] = None,
         offset: int = 0,
+        extra_where: Optional[Tuple[Sequence[str], Sequence[Any]]] = None,
     ) -> List[Run]:
+        """``extra_where`` is (clauses, params) compiled by the query DSL
+        builder — pushed-down conditions on real columns (the reference
+        compiles its DSL into queryset filters, ``query/builder.py:18-31``)."""
         clauses, params = [], []
+        if extra_where is not None:
+            clauses.extend(extra_where[0])
+            params.extend(extra_where[1])
         if kind is not None:
             clauses.append("kind = ?")
             params.append(kind)
@@ -654,17 +692,22 @@ class RunRegistry:
         return cur.rowcount > 0
 
     def acquire_device(
-        self, run_id: int, accelerator: str, chips: int
+        self, run_id: int, accelerator: str, chips: int, num_slices: int = 1
     ) -> Optional[Dict[str, Any]]:
-        """Claim the smallest free slice of the accelerator's family with at
-        least ``chips`` chips.
+        """Claim free slice(s) of the accelerator's family totalling
+        ``chips`` chips: ``num_slices`` smallest-fit rows of ``chips /
+        num_slices`` each (a multi-slice gang spans whole slices — one
+        device row per slice).
 
-        Returns the claimed slice row; ``None`` when the family has
-        inventory but no fitting slice is free (caller queues the run); or
-        ``{"unmanaged": True}`` when the family has NO registered inventory
-        at all (admission control off — every run admitted).  Idempotent per
-        run: a re-dispatched start re-uses the already-held slice.
+        Returns the (first) claimed slice row; ``None`` when the family has
+        inventory but not enough fitting slices free (caller queues the
+        run); or ``{"unmanaged": True}`` when the family has NO registered
+        inventory at all (admission control off — every run admitted).
+        Idempotent per run: a re-dispatched start re-uses the already-held
+        slices. All-or-nothing: a partial fit claims nothing.
         """
+        num_slices = max(1, int(num_slices))
+        per_slice = max(1, chips // num_slices)
         with self._lock, self._conn() as conn:
             conn.execute("BEGIN IMMEDIATE")
             held = conn.execute(
@@ -675,22 +718,27 @@ class RunRegistry:
                 # claim anything (and must not release on its failure path).
                 return {**dict(held), "already_held": True}
             managed, free_clause, free_params = self._family_fit(
-                conn, accelerator, chips
+                conn, accelerator, per_slice
             )
             if managed == 0:
                 return {"unmanaged": True}
-            row = conn.execute(
+            rows = conn.execute(
                 f"""SELECT * FROM devices WHERE {free_clause}
-                    ORDER BY chips ASC, id ASC LIMIT 1""",
-                free_params,
-            ).fetchone()
-            if row is None:
+                    ORDER BY chips ASC, id ASC LIMIT ?""",
+                (*free_params, num_slices),
+            ).fetchall()
+            if len(rows) < num_slices:
                 return None
-            conn.execute(
-                "UPDATE devices SET run_id = ?, updated_at = ? WHERE id = ?",
-                (run_id, time.time(), row["id"]),
-            )
-            return {**dict(row), "run_id": run_id}
+            now = time.time()
+            for row in rows:
+                conn.execute(
+                    "UPDATE devices SET run_id = ?, updated_at = ? WHERE id = ?",
+                    (run_id, now, row["id"]),
+                )
+            claimed = {**dict(rows[0]), "run_id": run_id}
+            if num_slices > 1:
+                claimed["slices"] = [r["name"] for r in rows]
+            return claimed
 
     def release_devices(self, run_id: int) -> int:
         """Free every slice held by ``run_id``; returns how many were held."""
@@ -875,6 +923,190 @@ class RunRegistry:
                 (cutoff, cutoff),
             ).rowcount
         return {"activity": act, "logs": logs}
+
+    # -- projects (entity metadata over runs.project) --------------------------
+    def create_project(
+        self, name: str, description: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Parity: reference project CRUD (``api/projects/``); runs keep a
+        plain ``project`` string column, this table carries the metadata."""
+        try:
+            with self._lock, self._conn() as conn:
+                cur = conn.execute(
+                    "INSERT INTO projects (name, description, created_at)"
+                    " VALUES (?, ?, ?)",
+                    (name, description, time.time()),
+                )
+        except sqlite3.IntegrityError as e:
+            raise RegistryError(f"Project {name!r} already exists") from e
+        return {"id": cur.lastrowid, "name": name, "description": description}
+
+    def list_projects(self) -> List[Dict[str, Any]]:
+        """Registered projects ∪ projects implied by runs, with run counts."""
+        rows = self._conn().execute(
+            """SELECT p.id AS id, p.name AS name, p.description AS description,
+                      p.created_at AS created_at, COUNT(r.id) AS num_runs
+               FROM projects p LEFT JOIN runs r ON r.project = p.name
+               GROUP BY p.id
+               UNION ALL
+               SELECT NULL AS id, r.project AS name, NULL AS description,
+                      MIN(r.created_at) AS created_at, COUNT(*) AS num_runs
+               FROM runs r
+               WHERE r.project NOT IN (SELECT name FROM projects)
+               GROUP BY r.project
+               ORDER BY 2"""
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    def get_project(self, name: str) -> Optional[Dict[str, Any]]:
+        row = self._conn().execute(
+            "SELECT id, name, description, created_at FROM projects WHERE name = ?",
+            (name,),
+        ).fetchone()
+        if row is None:
+            return None
+        out = dict(row)
+        out["num_runs"] = self._conn().execute(
+            "SELECT COUNT(*) FROM runs WHERE project = ?", (name,)
+        ).fetchone()[0]
+        return out
+
+    def delete_project(self, name: str) -> bool:
+        """Refuses while runs still reference it (archive them first)."""
+        n = self._conn().execute(
+            "SELECT COUNT(*) FROM runs WHERE project = ?", (name,)
+        ).fetchone()[0]
+        if n:
+            raise RegistryError(f"Project {name!r} still has {n} runs")
+        with self._lock, self._conn() as conn:
+            cur = conn.execute("DELETE FROM projects WHERE name = ?", (name,))
+            return cur.rowcount > 0
+
+    # -- saved searches (reference api/searches/) ------------------------------
+    def create_search(
+        self, name: str, query: str, owner: Optional[str] = None
+    ) -> Dict[str, Any]:
+        try:
+            with self._lock, self._conn() as conn:
+                cur = conn.execute(
+                    "INSERT INTO searches (name, query, owner, created_at)"
+                    " VALUES (?, ?, ?, ?)",
+                    (name, query, owner, time.time()),
+                )
+        except sqlite3.IntegrityError as e:
+            raise RegistryError(f"Search {name!r} already exists") from e
+        return {"id": cur.lastrowid, "name": name, "query": query, "owner": owner}
+
+    def list_searches(self) -> List[Dict[str, Any]]:
+        rows = self._conn().execute(
+            "SELECT id, name, query, owner, created_at FROM searches ORDER BY name"
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    def get_search(self, name: str) -> Optional[Dict[str, Any]]:
+        row = self._conn().execute(
+            "SELECT id, name, query, owner FROM searches WHERE name = ?", (name,)
+        ).fetchone()
+        return dict(row) if row else None
+
+    def delete_search(self, name: str) -> bool:
+        with self._lock, self._conn() as conn:
+            cur = conn.execute("DELETE FROM searches WHERE name = ?", (name,))
+            return cur.rowcount > 0
+
+    # -- bookmarks (reference api/bookmarks/) ----------------------------------
+    def add_bookmark(self, run_id: int, owner: str = "") -> None:
+        self.get_run(run_id)  # 404 before write
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                "INSERT OR IGNORE INTO bookmarks (run_id, owner, created_at)"
+                " VALUES (?, ?, ?)",
+                (run_id, owner, time.time()),
+            )
+
+    def remove_bookmark(self, run_id: int, owner: str = "") -> bool:
+        with self._lock, self._conn() as conn:
+            cur = conn.execute(
+                "DELETE FROM bookmarks WHERE run_id = ? AND owner = ?",
+                (run_id, owner),
+            )
+            return cur.rowcount > 0
+
+    def list_bookmarked_runs(self, owner: str = "") -> List[Run]:
+        rows = self._conn().execute(
+            """SELECT runs.* FROM runs
+               JOIN bookmarks ON bookmarks.run_id = runs.id
+               WHERE bookmarks.owner = ? ORDER BY bookmarks.created_at DESC""",
+            (owner,),
+        ).fetchall()
+        return [_row_to_run(r) for r in rows]
+
+    # -- users (per-user API tokens) -------------------------------------------
+    @staticmethod
+    def _token_hash(token: str) -> str:
+        import hashlib
+
+        # surrogateescape: a garbage (non-UTF-8) Authorization header must
+        # hash to a non-match, not raise into a 500.
+        return hashlib.sha256(
+            token.encode("utf-8", "surrogateescape")
+        ).hexdigest()
+
+    def create_user(self, username: str, role: str = "user") -> Tuple[Dict[str, Any], str]:
+        """Create a user and mint their token (returned ONCE, stored hashed).
+
+        Parity: reference users + per-user auth tokens (``scopes/``,
+        ``db/models`` user tables) — collapsed to username/role/token.
+        """
+        import secrets
+
+        if role not in ("admin", "user"):
+            raise RegistryError(f"Unknown role {role!r} (admin|user)")
+        token = secrets.token_hex(20)
+        try:
+            with self._lock, self._conn() as conn:
+                cur = conn.execute(
+                    "INSERT INTO users (username, token_hash, role, created_at)"
+                    " VALUES (?, ?, ?, ?)",
+                    (username, self._token_hash(token), role, time.time()),
+                )
+                user_id = cur.lastrowid
+        except sqlite3.IntegrityError as e:
+            raise RegistryError(f"User {username!r} already exists") from e
+        return {"id": user_id, "username": username, "role": role}, token
+
+    def get_user_by_token(self, token: str) -> Optional[Dict[str, Any]]:
+        row = self._conn().execute(
+            "SELECT id, username, role, last_used_at FROM users WHERE token_hash = ?",
+            (self._token_hash(token),),
+        ).fetchone()
+        if row is None:
+            return None
+        now = time.time()
+        # last_used_at is observability, not security: refresh at most once
+        # a minute so the hot auth path isn't a write transaction per call.
+        if row["last_used_at"] is None or now - row["last_used_at"] > 60.0:
+            with self._lock, self._conn() as conn:
+                conn.execute(
+                    "UPDATE users SET last_used_at = ? WHERE id = ?",
+                    (now, row["id"]),
+                )
+        return {k: row[k] for k in ("id", "username", "role")}
+
+    def list_users(self) -> List[Dict[str, Any]]:
+        rows = self._conn().execute(
+            "SELECT id, username, role, created_at, last_used_at FROM users"
+            " ORDER BY username"
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    def remove_user(self, username: str) -> bool:
+        with self._lock, self._conn() as conn:
+            cur = conn.execute("DELETE FROM users WHERE username = ?", (username,))
+            return cur.rowcount > 0
+
+    def has_users(self) -> bool:
+        return self._conn().execute("SELECT 1 FROM users LIMIT 1").fetchone() is not None
 
     # -- options (DB-backed conf store) ---------------------------------------
     def set_option(self, key: str, value: Any) -> None:

@@ -139,9 +139,11 @@ def register_hp_tasks(ctx: HPContext) -> None:
         # concurrency (SURVEY §7: trials×slices packing): dispatching more
         # trials than the inventory fits would just park them at admission.
         topo = group.spec.environment.topology
-        free = reg.free_slice_count(topo.accelerator, int(topo.num_devices))
+        per_slice = int(topo.num_devices)
+        free = reg.free_slice_count(topo.accelerator, per_slice)
         if free is not None:
-            window = min(window, free)
+            # A multi-slice trial consumes num_slices whole slices.
+            window = min(window, free // max(1, int(topo.num_slices)))
         for t in pending[:window]:
             # Mark the trial dispatched BEFORE sending: a trial sitting in
             # the bus queue must not look pending to the next HP_START

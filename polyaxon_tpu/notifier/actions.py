@@ -63,12 +63,124 @@ class CallbackAction(Action):
         self.fn(payload)
 
 
+def _event_summary(payload: Payload) -> str:
+    event = payload.get("event_type", "event")
+    ctx = {k: v for k, v in payload.items() if k != "event_type"}
+    detail = ", ".join(f"{k}={v}" for k, v in sorted(ctx.items()))
+    return f"polyaxon-tpu {event} {detail}"
+
+
 def slack_shaper(payload: Payload) -> Payload:
     """Shape a platform event as a Slack webhook message."""
     event = payload.get("event_type", "event")
     ctx = {k: v for k, v in payload.items() if k != "event_type"}
     detail = ", ".join(f"{k}={v}" for k, v in sorted(ctx.items()))
     return {"text": f":robot_face: polyaxon-tpu *{event}* {detail}"}
+
+
+def discord_shaper(payload: Payload) -> Payload:
+    """Discord webhook dialect (reference discord_webhook.py)."""
+    return {"content": _event_summary(payload)}
+
+
+def mattermost_shaper(payload: Payload) -> Payload:
+    """Mattermost incoming-webhook dialect (reference mattermost_webhook.py)."""
+    event = payload.get("event_type", "event")
+    ctx = {k: v for k, v in payload.items() if k != "event_type"}
+    detail = ", ".join(f"{k}={v}" for k, v in sorted(ctx.items()))
+    return {"text": f"**{event}** {detail}", "username": "polyaxon-tpu"}
+
+
+def pagerduty_shaper(routing_key: str) -> Callable[[Payload], Payload]:
+    """PagerDuty Events-API-v2 dialect (reference pagerduty_webhook.py).
+
+    A factory: PagerDuty needs the integration routing key in the body.
+    Failure-ish events page as errors, everything else as info.
+    """
+
+    def shape(payload: Payload) -> Payload:
+        event = payload.get("event_type", "")
+        severity = (
+            "error"
+            if event.endswith((".failed", ".zombie"))
+            else "info"
+        )
+        return {
+            "routing_key": routing_key,
+            "event_action": "trigger",
+            "payload": {
+                "summary": _event_summary(payload),
+                "source": "polyaxon-tpu",
+                "severity": severity,
+                "custom_details": {
+                    k: v for k, v in payload.items() if k != "event_type"
+                },
+            },
+        }
+
+    return shape
+
+
+#: Named webhook dialects selectable from conf (notifier.webhook_kind).
+SHAPERS: Dict[str, Callable[[Payload], Payload]] = {
+    "slack": slack_shaper,
+    "discord": discord_shaper,
+    "mattermost": mattermost_shaper,
+}
+
+
+class EmailAction(Action):
+    """SMTP notification (reference ``actions/registry/email_action.py``).
+
+    ``transport`` is injectable for tests; the default speaks smtplib with
+    optional STARTTLS + login.
+    """
+
+    name = "email"
+    async_dispatch = True
+
+    def __init__(
+        self,
+        *,
+        host: str,
+        sender: str,
+        recipients,
+        port: int = 25,
+        use_tls: bool = False,
+        username: Optional[str] = None,
+        password: Optional[str] = None,
+        timeout: float = 10.0,
+        transport: Optional[Callable[[str, Payload], None]] = None,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.sender = sender
+        self.recipients = list(recipients)
+        self.use_tls = use_tls
+        self.username = username
+        self.password = password
+        self.timeout = timeout
+        self._transport = transport
+
+    def _execute(self, payload: Payload) -> None:
+        from email.message import EmailMessage
+
+        msg = EmailMessage()
+        msg["Subject"] = _event_summary(payload)[:120]
+        msg["From"] = self.sender
+        msg["To"] = ", ".join(self.recipients)
+        msg.set_content(json.dumps(payload, indent=2, default=str))
+        if self._transport is not None:
+            self._transport(msg.as_string(), payload)
+            return
+        import smtplib
+
+        with smtplib.SMTP(self.host, self.port, timeout=self.timeout) as smtp:
+            if self.use_tls:
+                smtp.starttls()
+            if self.username:
+                smtp.login(self.username, self.password or "")
+            smtp.send_message(msg)
 
 
 class WebhookAction(Action):

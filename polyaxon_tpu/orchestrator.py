@@ -86,20 +86,47 @@ class Orchestrator:
         self.auditor.subscribe(self.executor)
         import os as _os
 
-        webhook = _os.environ.get("POLYAXON_TPU_WEBHOOK_URL")
+        # Opt-in done/failed notifications (reference notifier/actions +
+        # actions/registry/webhooks). Conf-driven; the legacy env vars keep
+        # working through the option store's env resolution order.
+        webhook = conf.get("notifier.webhook_url") or _os.environ.get(
+            "POLYAXON_TPU_WEBHOOK_URL"
+        )
+        kind = conf.get("notifier.webhook_kind") or _os.environ.get(
+            "POLYAXON_TPU_WEBHOOK_KIND", ""
+        )
+        actions = []
         if webhook:
-            # Opt-in done/failed notifications (reference notifier/actions).
-            from polyaxon_tpu.notifier import Notifier, WebhookAction
-            from polyaxon_tpu.notifier.actions import slack_shaper
+            from polyaxon_tpu.notifier import WebhookAction
+            from polyaxon_tpu.notifier.actions import SHAPERS, pagerduty_shaper
 
-            shaper = (
-                slack_shaper
-                if _os.environ.get("POLYAXON_TPU_WEBHOOK_KIND") == "slack"
-                else None
+            if kind == "pagerduty":
+                shaper = pagerduty_shaper(conf.get("notifier.pagerduty_routing_key"))
+            else:
+                shaper = SHAPERS.get(kind)
+            actions.append(WebhookAction(webhook, shaper=shaper))
+        email_host = conf.get("notifier.email_host")
+        email_to = conf.get("notifier.email_to")
+        if email_host and email_to:
+            from polyaxon_tpu.notifier.actions import EmailAction
+
+            actions.append(
+                EmailAction(
+                    host=email_host,
+                    port=conf.get("notifier.email_port"),
+                    sender=conf.get("notifier.email_from"),
+                    recipients=[r.strip() for r in email_to.split(",") if r.strip()],
+                    use_tls=conf.get("notifier.email_tls"),
+                    username=conf.get("notifier.email_user") or None,
+                    password=conf.get("notifier.email_password") or None,
+                )
             )
+        if actions:
+            from polyaxon_tpu.notifier import Notifier
+
             self.auditor.subscribe(
                 Notifier(
-                    [WebhookAction(webhook, shaper=shaper)],
+                    actions,
                     event_types=[
                         EventTypes.EXPERIMENT_SUCCEEDED,
                         EventTypes.EXPERIMENT_FAILED,
@@ -337,6 +364,7 @@ class Orchestrator:
         project: str = "default",
         name: Optional[str] = None,
         tags: Optional[list] = None,
+        actor: Optional[str] = None,
     ) -> Run:
         """Create a run from a spec and fire its created event.
 
@@ -356,11 +384,19 @@ class Orchestrator:
         event_type, key = created_events.get(
             run.kind, (EventTypes.EXPERIMENT_CREATED, "run_id")
         )
-        self.auditor.record(event_type, **{key: run.id})
+        # Actor attribution (reference events carry actor attributes,
+        # ``events/event.py:41``): who did it rides the activity feed.
+        extra = {"actor": actor} if actor else {}
+        self.auditor.record(event_type, **{key: run.id}, **extra)
         return run
 
     def register_device(
-        self, name: str, accelerator: str, chips: int, num_hosts: int = 1
+        self,
+        name: str,
+        accelerator: str,
+        chips: int,
+        num_hosts: int = 1,
+        actor: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Add slice capacity and immediately re-kick admission — queued
         runs and window-clamped sweeps must not wait for an unrelated run
@@ -368,25 +404,38 @@ class Orchestrator:
         device = self.registry.register_device(
             name, accelerator, chips, num_hosts=num_hosts
         )
+        self.auditor.record(
+            EventTypes.CLUSTER_NODE_UPDATED,
+            device=name,
+            **({"actor": actor} if actor else {}),
+        )
         self.bus.send(SchedulerTasks.ADMISSION_CHECK, {})
         return device
 
-    def stop_run(self, run_id: int) -> None:
+    def stop_run(self, run_id: int, actor: Optional[str] = None) -> None:
         run = self.registry.get_run(run_id)
+        extra = {"actor": actor} if actor else {}
         if run.kind == Kinds.GROUP:
             # Stop all trials, then the group itself.
             for trial in self.registry.list_runs(group_id=run_id):
                 if not trial.is_done:
                     self.bus.send(SchedulerTasks.EXPERIMENTS_STOP, {"run_id": trial.id})
             if self.registry.set_status(run_id, S.STOPPED):
-                self.auditor.record(EventTypes.GROUP_STOPPED, group_id=run_id)
+                self.auditor.record(EventTypes.GROUP_STOPPED, group_id=run_id, **extra)
             return
-        self.bus.send(SchedulerTasks.EXPERIMENTS_STOP, {"run_id": run_id})
+        # The actor rides the stop task so the scheduler's single real
+        # stop event carries who asked for it — no phantom/duplicate stops
+        # in the feed.
+        self.bus.send(
+            SchedulerTasks.EXPERIMENTS_STOP, {"run_id": run_id, **extra}
+        )
 
     def get_run(self, run_id: Union[int, str]) -> Run:
         return self.registry.get_run(run_id)
 
-    def clone_run(self, run_id: int, strategy: str = "restart") -> Run:
+    def clone_run(
+        self, run_id: int, strategy: str = "restart", actor: Optional[str] = None
+    ) -> Run:
         """Restart / resume / copy a run as a new run.
 
         Parity: reference restart/resume/copy views
@@ -437,7 +486,9 @@ class Orchestrator:
             if strategy == "resume"
             else EventTypes.EXPERIMENT_CREATED
         )
-        self.auditor.record(event, run_id=run.id)
+        self.auditor.record(
+            event, run_id=run.id, **({"actor": actor} if actor else {})
+        )
         return self.registry.get_run(run.id)
 
     def list_artifacts(self, run_id: Union[int, str]) -> list:

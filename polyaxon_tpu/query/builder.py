@@ -73,3 +73,53 @@ def apply_query(
     """Filter runs by a query string (AND of all its conditions)."""
     conds = list(conditions or []) or parse_query(query)
     return [r for r in runs if all(_matches(r, c) for c in conds)]
+
+
+def compile_to_sql(
+    conditions: Sequence[Condition],
+) -> tuple:
+    """Split conditions into (sql_clauses, params, residual_conditions).
+
+    Conditions on real ``runs`` columns compile to WHERE fragments (the
+    reference's queryset pushdown); JSON-payload fields (``metric.*``,
+    ``declarations.*``, ``tags``) stay residual for the in-process filter.
+    NULL handling mirrors the Python semantics exactly: a NULL column never
+    matches a positive condition and always matches a negated one.
+    """
+    clauses: List[str] = []
+    params: List[Any] = []
+    residual: List[Condition] = []
+    for cond in conditions:
+        if cond.field not in _FIELDS:
+            if not (
+                cond.field.startswith(("metric.", "declarations.", "params."))
+                or cond.field == "tags"
+            ):
+                # Same validation the in-process path gives — unknown
+                # fields must 400, not silently match everything.
+                raise QueryError(
+                    f"Unknown query field {cond.field!r} (plain fields: "
+                    f"{sorted(_FIELDS)}; JSON fields: metric.<name>, "
+                    "declarations.<name>, tags)"
+                )
+            residual.append(cond)
+            continue
+        col = cond.field  # _FIELDS is a fixed allowlist — never user text
+        if cond.op == "eq":
+            frag, ps = f"{col} = ?", [cond.value]
+        elif cond.op == "in":
+            frag = f"{col} IN ({','.join('?' * len(cond.value))})"
+            ps = list(cond.value)
+        elif cond.op == "range":
+            frag, ps = f"{col} BETWEEN ? AND ?", list(cond.value)
+        elif cond.op in ("gt", "gte", "lt", "lte"):
+            sym = {"gt": ">", "gte": ">=", "lt": "<", "lte": "<="}[cond.op]
+            frag, ps = f"{col} {sym} ?", [cond.value]
+        else:  # pragma: no cover - parser emits only the ops above
+            residual.append(cond)
+            continue
+        if cond.negated:
+            frag = f"(NOT ({frag}) OR {col} IS NULL)"
+        clauses.append(frag)
+        params.extend(ps)
+    return clauses, params, residual

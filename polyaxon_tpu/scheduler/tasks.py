@@ -55,7 +55,9 @@ class SchedulerContext:
     artifact_store: Optional[object] = None
 
 
-def _record_done(ctx: SchedulerContext, run_id: int, status: str) -> None:
+def _record_done(
+    ctx: SchedulerContext, run_id: int, status: str, actor: Optional[str] = None
+) -> None:
     # Terminal = the gang's slice goes back into the inventory; freed
     # capacity immediately re-dispatches runs queued at admission.
     if ctx.registry.release_devices(run_id):
@@ -69,8 +71,9 @@ def _record_done(ctx: SchedulerContext, run_id: int, status: str) -> None:
         S.FAILED: EventTypes.EXPERIMENT_FAILED,
         S.STOPPED: EventTypes.EXPERIMENT_STOPPED,
     }
+    extra = {"actor": actor} if actor else {}
     if status in by_status:
-        ctx.auditor.record(by_status[status], run_id=run_id)
+        ctx.auditor.record(by_status[status], run_id=run_id, **extra)
     ctx.auditor.record(
         EventTypes.EXPERIMENT_DONE,
         run_id=run_id,
@@ -123,7 +126,9 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
         # k8s-delegated placement; here an explicit slice inventory). No
         # inventory for the family → admission is off; otherwise the run
         # holds a whole slice from SCHEDULED until terminal.
-        device = reg.acquire_device(run_id, plan.accelerator, plan.num_devices)
+        device = reg.acquire_device(
+            run_id, plan.accelerator, plan.num_devices, num_slices=plan.num_slices
+        )
         if device is None:
             # Queue at admission: the QUEUED re-dispatch cron and the
             # release hook both retry this run later.
@@ -293,7 +298,9 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
         _reschedule_monitor(run_id)
 
     @bus.register(SchedulerTasks.EXPERIMENTS_STOP)
-    def experiments_stop(run_id: int, cleanup: bool = False) -> None:
+    def experiments_stop(
+        run_id: int, cleanup: bool = False, actor: Optional[str] = None
+    ) -> None:
         handle = ctx.gangs.pop(run_id, None)
         if handle is not None:
             ctx.spawner.stop(handle)
@@ -308,7 +315,7 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             if p["status"] not in (S.SUCCEEDED, S.FAILED, S.STOPPED):
                 reg.upsert_process(run_id, p["process_id"], status=S.STOPPED)
         reg.set_status(run_id, S.STOPPED)
-        _record_done(ctx, run_id, S.STOPPED)
+        _record_done(ctx, run_id, S.STOPPED, actor=actor)
 
     @bus.register(SchedulerTasks.ARTIFACTS_SYNC)
     def artifacts_sync(run_id: int) -> None:

@@ -262,3 +262,78 @@ class TestAuthAndDashboard:
             return True
 
         assert drive(orch, body)
+
+
+class TestEntityAPIs:
+    def test_projects_crud(self, orch):
+        async def body(client):
+            resp = await client.post(
+                "/api/v1/projects", json={"name": "vision", "description": "imgs"}
+            )
+            assert resp.status == 201
+            resp = await client.post("/api/v1/projects", json={"name": "vision"})
+            assert resp.status == 400  # duplicate
+            await client.post("/api/v1/runs", json={"spec": SPEC, "project": "vision"})
+            listed = await (await client.get("/api/v1/projects")).json()
+            vision = next(p for p in listed["results"] if p["name"] == "vision")
+            assert vision["num_runs"] == 1
+            got = await (await client.get("/api/v1/projects/vision")).json()
+            assert got["description"] == "imgs"
+            resp = await client.delete("/api/v1/projects/vision")
+            assert resp.status == 400  # has runs
+            resp = await client.get("/api/v1/projects/nope")
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)
+
+    def test_searches_saved_and_executed(self, orch):
+        async def body(client):
+            await client.post("/api/v1/runs", json={"spec": SPEC, "name": "keep"})
+            await client.post("/api/v1/runs", json={"spec": SPEC, "name": "other"})
+            resp = await client.post(
+                "/api/v1/searches", json={"name": "mine", "query": "name:keep"}
+            )
+            assert resp.status == 201
+            resp = await client.post(
+                "/api/v1/searches", json={"name": "bad", "query": "bogus-field:1"}
+            )
+            assert resp.status == 400  # validated at save time
+            ran = await (await client.get("/api/v1/searches/mine/runs")).json()
+            assert [r["name"] for r in ran["results"]] == ["keep"]
+            resp = await client.delete("/api/v1/searches/mine")
+            assert resp.status == 200
+            resp = await client.get("/api/v1/searches/mine/runs")
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)
+
+    def test_bookmarks_roundtrip(self, orch):
+        async def body(client):
+            run = await (await client.post("/api/v1/runs", json={"spec": SPEC})).json()
+            resp = await client.post(f"/api/v1/runs/{run['id']}/bookmark")
+            assert resp.status == 201
+            marked = await (await client.get("/api/v1/bookmarks")).json()
+            assert [r["id"] for r in marked["results"]] == [run["id"]]
+            resp = await client.delete(f"/api/v1/runs/{run['id']}/bookmark")
+            assert resp.status == 200
+            marked = await (await client.get("/api/v1/bookmarks")).json()
+            assert marked["results"] == []
+            return True
+
+        assert drive(orch, body)
+
+    def test_query_pushdown_pagination(self, orch):
+        async def body(client):
+            for i in range(5):
+                await client.post(
+                    "/api/v1/runs", json={"spec": SPEC, "name": f"r{i}"}
+                )
+            # Pure-column query: pagination pushes down to SQL.
+            resp = await client.get("/api/v1/runs?q=status:created&limit=2&offset=2")
+            names = [r["name"] for r in (await resp.json())["results"]]
+            assert names == ["r2", "r3"]
+            return True
+
+        assert drive(orch, body)

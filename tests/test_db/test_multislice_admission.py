@@ -1,0 +1,59 @@
+"""Multi-slice gang admission: one device row per slice, all-or-nothing.
+
+Parity: SURVEY §7 trials×slices packing — a num_slices=N gang must claim N
+whole inventory slices, never one oversized slice and never a partial set.
+"""
+
+import pytest
+
+from polyaxon_tpu.db.registry import RunRegistry
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+
+@pytest.fixture()
+def reg(tmp_path):
+    r = RunRegistry(tmp_path / "db.sqlite")
+    yield r
+    r.close()
+
+
+class TestMultiSliceAdmission:
+    def test_two_slice_gang_claims_two_rows(self, reg):
+        run = reg.create_run(SPEC)
+        reg.register_device("s0", "v5e-16", 16)
+        reg.register_device("s1", "v5e-16", 16)
+        # The documented headline: 2x v5e-16 → 32 chips over 2 slices.
+        claimed = reg.acquire_device(run.id, "v5e-16", 32, num_slices=2)
+        assert claimed is not None and not claimed.get("unmanaged")
+        assert sorted(claimed["slices"]) == ["s0", "s1"]
+        held = [d for d in reg.list_devices() if d["run_id"] == run.id]
+        assert len(held) == 2
+        assert reg.release_devices(run.id) == 2
+
+    def test_partial_fit_claims_nothing(self, reg):
+        run = reg.create_run(SPEC)
+        reg.register_device("s0", "v5e-16", 16)
+        assert reg.acquire_device(run.id, "v5e-16", 32, num_slices=2) is None
+        assert all(d["run_id"] is None for d in reg.list_devices())
+
+    def test_multislice_idempotent_per_run(self, reg):
+        run = reg.create_run(SPEC)
+        reg.register_device("s0", "v5e-16", 16)
+        reg.register_device("s1", "v5e-16", 16)
+        first = reg.acquire_device(run.id, "v5e-16", 32, num_slices=2)
+        again = reg.acquire_device(run.id, "v5e-16", 32, num_slices=2)
+        assert again.get("already_held")
+        assert first["slices"]
+
+    def test_single_slice_unchanged(self, reg):
+        run = reg.create_run(SPEC)
+        reg.register_device("s0", "v5e-8", 8)
+        claimed = reg.acquire_device(run.id, "v5e-8", 8)
+        assert claimed["name"] == "s0" and "slices" not in claimed

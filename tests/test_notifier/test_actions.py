@@ -1,0 +1,61 @@
+
+
+class TestWebhookDialects:
+    def test_discord_shape(self):
+        from polyaxon_tpu.notifier.actions import discord_shaper
+
+        out = discord_shaper({"event_type": "experiment.failed", "run_id": 3})
+        assert "experiment.failed" in out["content"] and "run_id=3" in out["content"]
+
+    def test_mattermost_shape(self):
+        from polyaxon_tpu.notifier.actions import mattermost_shaper
+
+        out = mattermost_shaper({"event_type": "group.done", "group_id": 1})
+        assert out["username"] == "polyaxon-tpu"
+        assert "**group.done**" in out["text"]
+
+    def test_pagerduty_shape_and_severity(self):
+        from polyaxon_tpu.notifier.actions import pagerduty_shaper
+
+        shape = pagerduty_shaper("rk-123")
+        bad = shape({"event_type": "experiment.failed", "run_id": 3})
+        assert bad["routing_key"] == "rk-123"
+        assert bad["event_action"] == "trigger"
+        assert bad["payload"]["severity"] == "error"
+        assert bad["payload"]["custom_details"] == {"run_id": 3}
+        ok = shape({"event_type": "experiment.succeeded", "run_id": 3})
+        assert ok["payload"]["severity"] == "info"
+
+    def test_shaper_registry(self):
+        from polyaxon_tpu.notifier.actions import SHAPERS
+
+        assert set(SHAPERS) == {"slack", "discord", "mattermost"}
+
+
+class TestEmailAction:
+    def test_email_composes_and_sends_via_transport(self):
+        from polyaxon_tpu.notifier.actions import EmailAction
+
+        sent = []
+        action = EmailAction(
+            host="smtp.example.com",
+            sender="plat@example.com",
+            recipients=["a@example.com", "b@example.com"],
+            transport=lambda raw, payload: sent.append((raw, payload)),
+        )
+        assert action.execute({"event_type": "experiment.failed", "run_id": 9})
+        raw, payload = sent[0]
+        assert "Subject: polyaxon-tpu experiment.failed" in raw
+        assert "To: a@example.com, b@example.com" in raw
+        assert payload["run_id"] == 9
+
+    def test_email_failure_does_not_raise(self):
+        from polyaxon_tpu.notifier.actions import EmailAction
+
+        def bad_transport(raw, payload):
+            raise ConnectionError("smtp down")
+
+        action = EmailAction(
+            host="x", sender="s@x", recipients=["r@x"], transport=bad_transport
+        )
+        assert action.execute({"event_type": "e"}) is False

@@ -1,0 +1,113 @@
+"""SQL pushdown of DSL conditions: WHERE fragments + SQL/Python parity.
+
+Parity: reference ``QueryBuilder.build`` compiling conditions into
+queryset filters (``query/builder.py:18-31``). The invariant under test:
+for any query, pushdown + residual filtering returns EXACTLY what the
+pure in-process filter returns — including NULL-column semantics.
+"""
+
+import pytest
+
+from polyaxon_tpu.db.registry import RunRegistry
+from polyaxon_tpu.query import QueryError, apply_query, compile_to_sql, parse_query
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+
+@pytest.fixture()
+def reg(tmp_path):
+    r = RunRegistry(tmp_path / "db.sqlite")
+    a = r.create_run(SPEC, name="alpha", project="vision")
+    b = r.create_run(SPEC, name="beta", project="nlp")
+    c = r.create_run(SPEC, name=None, project="vision", tags=["prod"])
+    r.set_status(b.id, "queued")
+    r.add_metric(a.id, {"loss": 0.2})
+    yield r
+    r.close()
+
+
+def both_paths(reg, query):
+    """(pushdown results, in-process results) as id lists."""
+    conds = parse_query(query)
+    clauses, params, residual = compile_to_sql(conds)
+    runs = reg.list_runs(extra_where=(clauses, params) if clauses else None)
+    if residual:
+        runs = apply_query(runs, conditions=residual)
+    pushed = [r.id for r in runs]
+    pure = [r.id for r in apply_query(reg.list_runs(), query)]
+    return pushed, pure
+
+
+class TestCompileToSql:
+    @pytest.mark.parametrize(
+        "query",
+        [
+            "project:vision",
+            "project:~vision",
+            "status:created|queued",
+            "status:~created|queued",
+            "id:>1",
+            "id:1..2",
+            "id:~1..2",
+            "name:alpha",
+            "name:~alpha",  # NULL name must match the negation
+            "project:vision,status:created",
+        ],
+    )
+    def test_sql_matches_python_semantics(self, reg, query):
+        pushed, pure = both_paths(reg, query)
+        assert pushed == pure, query
+
+    def test_json_fields_stay_residual(self, reg):
+        clauses, params, residual = compile_to_sql(parse_query("metric.loss:<0.5"))
+        assert clauses == [] and params == []
+        assert len(residual) == 1
+        pushed, pure = both_paths(reg, "metric.loss:<0.5")
+        assert pushed == pure
+
+    def test_mixed_pushdown_and_residual(self, reg):
+        clauses, _, residual = compile_to_sql(
+            parse_query("project:vision,metric.loss:<0.5")
+        )
+        assert len(clauses) == 1 and len(residual) == 1
+        pushed, pure = both_paths(reg, "project:vision,metric.loss:<0.5")
+        assert pushed == pure
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(QueryError):
+            compile_to_sql(parse_query("bogus:1"))
+
+
+class TestEntities:
+    def test_search_roundtrip(self, reg):
+        reg.create_search("mine", "project:vision", owner="alice")
+        assert reg.get_search("mine")["query"] == "project:vision"
+        assert [s["name"] for s in reg.list_searches()] == ["mine"]
+        assert reg.delete_search("mine")
+        assert reg.get_search("mine") is None
+
+    def test_project_roundtrip_and_counts(self, reg):
+        reg.create_project("vision", description="image models")
+        projects = {p["name"]: p for p in reg.list_projects()}
+        assert projects["vision"]["num_runs"] == 2
+        assert projects["vision"]["description"] == "image models"
+        # nlp is implied by its runs even though never registered
+        assert projects["nlp"]["num_runs"] == 1
+        with pytest.raises(Exception):
+            reg.delete_project("vision")  # still has runs
+
+    def test_bookmarks_per_owner(self, reg):
+        reg.add_bookmark(1, owner="alice")
+        reg.add_bookmark(2, owner="alice")
+        reg.add_bookmark(1, owner="bob")
+        assert [r.id for r in reg.list_bookmarked_runs("alice")] == [2, 1]
+        assert [r.id for r in reg.list_bookmarked_runs("bob")] == [1]
+        assert reg.remove_bookmark(2, owner="alice")
+        assert [r.id for r in reg.list_bookmarked_runs("alice")] == [1]
+        assert not reg.remove_bookmark(2, owner="alice")
