@@ -963,13 +963,17 @@ class RunRegistry:
             "SELECT id, name, description, created_at FROM projects WHERE name = ?",
             (name,),
         ).fetchone()
-        if row is None:
-            return None
-        out = dict(row)
-        out["num_runs"] = self._conn().execute(
+        num_runs = self._conn().execute(
             "SELECT COUNT(*) FROM runs WHERE project = ?", (name,)
         ).fetchone()[0]
-        return out
+        if row is None:
+            # Run-implied project (list_projects shows these too): the
+            # detail endpoint must not 404 on names the listing returned.
+            if num_runs == 0:
+                return None
+            return {"id": None, "name": name, "description": None,
+                    "num_runs": num_runs}
+        return {**dict(row), "num_runs": num_runs}
 
     def delete_project(self, name: str) -> bool:
         """Refuses while runs still reference it (archive them first)."""

@@ -29,6 +29,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from polyaxon_tpu.parallel.axes import AxisRules, with_logical_constraint
 
@@ -50,6 +51,20 @@ class TransformerConfig:
     #: per-expert capacity = capacity_factor * tokens / n_experts
     capacity_factor: float = 1.25
     remat: bool = False
+    #: What the checkpointed block may KEEP across the bwd recompute:
+    #: "none" (recompute everything — max memory savings), "dots" (keep
+    #: matmul outputs), "dots_no_batch" (keep batch-free matmuls),
+    #: "save_attn" (keep the attention output — skips re-running the
+    #: attention subgraph; the measured v5e sweet spot, docs/bench-notes),
+    #: "save_attn_mlp" (also keep the post-activation MLP product).
+    remat_policy: str = "none"
+
+    def __post_init__(self) -> None:
+        allowed = ("none", "dots", "dots_no_batch", "save_attn", "save_attn_mlp")
+        if self.remat_policy not in allowed:
+            raise ValueError(
+                f"Unknown remat_policy {self.remat_policy!r} (one of {allowed})"
+            )
     #: "auto" = pallas flash kernel on single-device TPU, XLA attention
     #: elsewhere; "dense" forces XLA; "flash" forces the pallas kernel.
     #: (A pallas call is a custom call GSPMD can't partition, so the flash
@@ -195,10 +210,14 @@ def _use_flash(
         return False
     if cfg.attention_impl == "flash":
         return True
-    # auto: only when attention runs unsharded on a TPU backend, and only at
-    # long sequence — measured on v5e, XLA's fused attention wins at T=1024
-    # (0.43 vs 0.25 MFU) while the pallas kernel wins 4.7x at T=8192.
-    if seq_len < 2048:
+    # auto: only when attention runs unsharded on a TPU backend, and only
+    # where the O(T) memory matters. Measured on v5e-1, FULL train steps
+    # (remat, 671M params): dense wins wherever it fits — 0.52 vs n/a at
+    # T=1024, 0.39 vs 0.25 at T=2048, 0.32 vs 0.18 at T=4096 — and OOMs at
+    # T=8192 (25.7G > 15.75G HBM) where flash runs at 4.4k tok/s. The
+    # kernel's value in training is CAPABILITY (long context fits), so auto
+    # switches only at the memory wall.
+    if seq_len < 8192:
         return False
     if pipeline_axis is not None or (mesh is not None and mesh.size > 1):
         return False
@@ -319,6 +338,10 @@ def forward(
         attn = with_logical_constraint(
             attn, ("batch", "seq", "attn_heads", None), rules, cmesh
         )
+        # Named for remat policies: saving the attention OUTPUT (O(B·T·D),
+        # cheap) lets the checkpointed block skip re-running the whole
+        # attention kernel during its backward-pass recompute.
+        attn = checkpoint_name(attn, "attn_out")
         x = x + jnp.einsum("bthk,hkd->btd", attn, layer["wo"].astype(h.dtype))
 
         h = _rmsnorm(x, layer["mlp_norm"])
@@ -330,11 +353,30 @@ def forward(
         gate = jnp.einsum("btd,df->btf", h, layer["wg"].astype(h.dtype))
         y = jax.nn.silu(gate) * up
         y = with_logical_constraint(y, ("batch", "seq", "act_mlp"), rules, cmesh)
+        # Saving this one [B,T,F] product (policy save_attn_mlp) spares the
+        # recompute of BOTH up/gate projections — 2 of the 3 MLP matmuls.
+        y = checkpoint_name(y, "mlp_act")
         x = x + jnp.einsum("btf,fd->btd", y, layer["wd"].astype(h.dtype))
         x = with_logical_constraint(x, ("batch", "seq", None), rules, cmesh)
         return x, None
 
-    body = jax.checkpoint(block) if c.remat else block
+    if c.remat:
+        # The policy trades HBM for recompute FLOPs: keeping dot outputs
+        # skips re-running the MXU-heavy contractions in the bwd pass.
+        policies = {
+            "dots": jax.checkpoint_policies.dots_saveable,
+            "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "save_attn": jax.checkpoint_policies.save_only_these_names("attn_out"),
+            "save_attn_mlp": jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "mlp_act"
+            ),
+        }
+        policy = policies.get(c.remat_policy)
+        body = (
+            jax.checkpoint(block, policy=policy) if policy else jax.checkpoint(block)
+        )
+    else:
+        body = block
 
     aux = None
     if pipeline_axis is not None:

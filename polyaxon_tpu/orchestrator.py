@@ -419,7 +419,10 @@ class Orchestrator:
             # Stop all trials, then the group itself.
             for trial in self.registry.list_runs(group_id=run_id):
                 if not trial.is_done:
-                    self.bus.send(SchedulerTasks.EXPERIMENTS_STOP, {"run_id": trial.id})
+                    self.bus.send(
+                        SchedulerTasks.EXPERIMENTS_STOP,
+                        {"run_id": trial.id, **extra},
+                    )
             if self.registry.set_status(run_id, S.STOPPED):
                 self.auditor.record(EventTypes.GROUP_STOPPED, group_id=run_id, **extra)
             return
