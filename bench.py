@@ -163,7 +163,12 @@ def main() -> None:
             }
             del lparams, lopt, lbatch
         except Exception:
-            pass
+            # null in the output = degraded gracefully, but the reason must
+            # be visible (a flash-path regression is not an OOM).
+            import sys
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
 
     # North-star #2 (BASELINE.md): hpsearch trials/hour — a real sweep
     # through the orchestrator (create → waves → iterate), workers as

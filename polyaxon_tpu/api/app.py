@@ -17,6 +17,7 @@ import logging
 from typing import Any, Dict, Optional
 
 from polyaxon_tpu.db.registry import Run, RunRegistry
+from polyaxon_tpu.events import EventTypes
 from polyaxon_tpu.exceptions import PolyaxonTPUError
 from polyaxon_tpu.orchestrator import Orchestrator
 
@@ -70,6 +71,14 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 text=json.dumps({"error": f"query param {name!r} must be an integer"}),
                 content_type="application/json",
             )
+
+    def _audit(request, event_type, **ctx):
+        # Every mutating entity action lands in the activity feed with the
+        # authenticated actor (reference events carry actor attributes).
+        actor = request.get("actor")
+        if actor:
+            ctx["actor"] = actor
+        orch.auditor.record(event_type, **ctx)
 
     def _run_or_404(request) -> Run:
         try:
@@ -268,6 +277,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             return web.json_response({"error": "project needs a name"}, status=400)
         except PolyaxonTPUError as e:
             return web.json_response({"error": str(e)}, status=400)
+        _audit(request, EventTypes.PROJECT_CREATED, project=project["name"])
         return web.json_response(project, status=201)
 
     @routes.get(f"{API_PREFIX}/projects")
@@ -295,6 +305,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 text=json.dumps({"error": "no such project"}),
                 content_type="application/json",
             )
+        _audit(request, EventTypes.PROJECT_DELETED, project=request.match_info["name"])
         return web.json_response({"ok": True})
 
     # -- saved searches (reference api/searches/) -------------------------------
@@ -315,6 +326,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             )
         except (QueryError, PolyaxonTPUError) as e:
             return web.json_response({"error": str(e)}, status=400)
+        _audit(request, EventTypes.SEARCH_CREATED, search=search["name"])
         return web.json_response(search, status=201)
 
     @routes.get(f"{API_PREFIX}/searches")
@@ -328,6 +340,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 text=json.dumps({"error": "no such search"}),
                 content_type="application/json",
             )
+        _audit(request, EventTypes.SEARCH_DELETED, search=request.match_info["name"])
         return web.json_response({"ok": True})
 
     @routes.get(f"{API_PREFIX}/searches/{{name}}/runs")
@@ -361,6 +374,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
     async def add_bookmark(request):
         run = _run_or_404(request)
         reg.add_bookmark(run.id, owner=_bookmark_owner(request))
+        _audit(request, EventTypes.BOOKMARK_ADDED, run_id=run.id)
         return web.json_response({"ok": True}, status=201)
 
     @routes.delete(f"{API_PREFIX}/runs/{{run_id}}/bookmark")
@@ -371,12 +385,22 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 text=json.dumps({"error": "not bookmarked"}),
                 content_type="application/json",
             )
+        _audit(request, EventTypes.BOOKMARK_REMOVED, run_id=run.id)
         return web.json_response({"ok": True})
 
     @routes.get(f"{API_PREFIX}/bookmarks")
     async def list_bookmarks(request):
         runs = reg.list_bookmarked_runs(owner=_bookmark_owner(request))
         return web.json_response({"results": [run_to_dict(r) for r in runs]})
+
+    @routes.get(f"{API_PREFIX}/activities")
+    async def list_activities(request):
+        # The audit feed (reference activitylogs/): who did what, when.
+        rows = reg.get_activities(
+            event_type=request.rel_url.query.get("event_type"),
+            limit=_int_param(request, "limit", 100),
+        )
+        return web.json_response({"results": rows})
 
     # -- devices (accelerator inventory) --------------------------------------
     @routes.get(f"{API_PREFIX}/devices")
@@ -490,6 +514,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         except (KeyError, PolyaxonTPUError) as e:
             return web.json_response({"error": str(e)}, status=400)
         # The token is shown exactly once; only its hash is stored.
+        _audit(request, EventTypes.USER_CREATED, username=user["username"])
         return web.json_response({**user, "token": token}, status=201)
 
     @routes.get(f"{API_PREFIX}/users")
@@ -505,6 +530,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 text=json.dumps({"error": "no such user"}),
                 content_type="application/json",
             )
+        _audit(request, EventTypes.USER_DELETED, username=request.match_info["username"])
         return web.json_response({"ok": True})
 
     @web.middleware

@@ -1,10 +1,11 @@
 """Single-page dashboard served at ``/``.
 
-Parity (minimal): the reference's React dashboard (``client/``, 551 TS
-files — runs tables, status chips, metric charts, log viewers).  This is
-the embedded equivalent: one dependency-free HTML page polling the REST
-API — runs table with status/metrics, per-run status history, live log
-tail, and a canvas metric chart.
+Parity: the reference's React dashboard (``client/``, 551 TS files — runs
+tables, status chips, metric charts, log viewers, per-entity pages).  This
+is the embedded equivalent: one dependency-free HTML page over the REST
+API — tabs for runs (with live detail: metric chart, log tail, status
+history, stop/restart actions, service links), accelerator inventory,
+projects, saved searches, and the audit activity feed.
 """
 
 DASHBOARD_HTML = """<!doctype html>
@@ -18,8 +19,12 @@ DASHBOARD_HTML = """<!doctype html>
   body { background:var(--bg); color:var(--text);
          font:14px/1.5 -apple-system, 'Segoe UI', Roboto, sans-serif;
          margin:0; padding:24px; }
-  h1 { font-size:18px; margin:0 0 16px; }
+  h1 { font-size:18px; margin:0 0 12px; }
   h1 span { color:var(--dim); font-weight:normal; }
+  nav { margin-bottom:16px; }
+  nav a { color:var(--dim); margin-right:16px; cursor:pointer;
+          text-decoration:none; padding-bottom:4px; }
+  nav a.active { color:var(--text); border-bottom:2px solid var(--accent); }
   table { border-collapse:collapse; width:100%; background:var(--panel);
           border-radius:8px; overflow:hidden; }
   th, td { text-align:left; padding:8px 12px; }
@@ -27,9 +32,9 @@ DASHBOARD_HTML = """<!doctype html>
   tr.row:hover { background:#222a33; cursor:pointer; }
   .chip { padding:2px 8px; border-radius:10px; font-size:12px; }
   .chip.succeeded { background:#1f3d2b; color:var(--ok); }
-  .chip.failed { background:#442224; color:var(--bad); }
+  .chip.failed, .chip.upstream_failed { background:#442224; color:var(--bad); }
   .chip.running, .chip.starting, .chip.scheduled { background:#1d3048; color:var(--accent); }
-  .chip.stopped, .chip.skipped { background:#3a3325; color:var(--warn); }
+  .chip.stopped, .chip.skipped, .chip.warning, .chip.queued { background:#3a3325; color:var(--warn); }
   .chip.created { background:#2a323c; color:var(--dim); }
   #detail { margin-top:20px; display:none; }
   .panel { background:var(--panel); border-radius:8px; padding:16px; margin-top:12px; }
@@ -37,43 +42,148 @@ DASHBOARD_HTML = """<!doctype html>
   canvas { width:100%; height:160px; }
   input { background:var(--panel); color:var(--text); border:1px solid #2a323c;
           border-radius:6px; padding:6px 10px; width:340px; margin-bottom:12px; }
+  button { background:#253141; color:var(--text); border:1px solid #2a323c;
+           border-radius:6px; padding:4px 12px; cursor:pointer; margin-right:8px; }
+  button:hover { background:#2d3c50; }
+  a.svc { color:var(--accent); }
+  .dim { color:var(--dim); }
 </style>
 </head>
 <body>
 <h1>polyaxon-tpu <span id="count"></span></h1>
-<input id="query" placeholder='filter: status:running, metric.loss:<0.5' />
-<table>
-  <thead><tr><th>ID</th><th>Kind</th><th>Name</th><th>Project</th>
-  <th>Status</th><th>Last metric</th><th>Restarts</th></tr></thead>
-  <tbody id="runs"></tbody>
-</table>
-<div id="detail">
-  <h1 id="detail-title"></h1>
-  <div class="panel"><canvas id="chart" width="900" height="160"></canvas></div>
-  <div class="panel"><pre id="logs"></pre></div>
+<nav>
+  <a id="tab-runs" class="active" onclick="showTab('runs')">Runs</a>
+  <a id="tab-devices" onclick="showTab('devices')">Devices</a>
+  <a id="tab-projects" onclick="showTab('projects')">Projects</a>
+  <a id="tab-searches" onclick="showTab('searches')">Searches</a>
+  <a id="tab-activity" onclick="showTab('activity')">Activity</a>
+</nav>
+
+<div id="view-runs">
+  <input id="query" placeholder='filter: status:running, metric.loss:<0.5' />
+  <table>
+    <thead><tr><th>ID</th><th>Kind</th><th>Name</th><th>Project</th>
+    <th>Status</th><th>Last metric</th><th>Restarts</th><th>Service</th></tr></thead>
+    <tbody id="runs"></tbody>
+  </table>
+  <div id="detail">
+    <h1 id="detail-title"></h1>
+    <div class="panel">
+      <button onclick="runAction('stop')">stop</button>
+      <button onclick="runAction('restart')">restart</button>
+      <button onclick="runAction('resume')">resume</button>
+      <span id="statuses" class="dim"></span>
+    </div>
+    <div class="panel"><canvas id="chart" width="900" height="160"></canvas></div>
+    <div class="panel"><pre id="logs"></pre></div>
+  </div>
 </div>
+
+<div id="view-devices" style="display:none">
+  <table>
+    <thead><tr><th>ID</th><th>Name</th><th>Accelerator</th><th>Chips</th>
+    <th>Hosts</th><th>Held by run</th></tr></thead>
+    <tbody id="devices"></tbody>
+  </table>
+</div>
+
+<div id="view-projects" style="display:none">
+  <table>
+    <thead><tr><th>Name</th><th>Runs</th><th>Description</th></tr></thead>
+    <tbody id="projects"></tbody>
+  </table>
+</div>
+
+<div id="view-searches" style="display:none">
+  <table>
+    <thead><tr><th>Name</th><th>Query</th><th>Owner</th><th></th></tr></thead>
+    <tbody id="searches"></tbody>
+  </table>
+</div>
+
+<div id="view-activity" style="display:none">
+  <table>
+    <thead><tr><th>When</th><th>Event</th><th>Actor</th><th>Context</th></tr></thead>
+    <tbody id="activity"></tbody>
+  </table>
+</div>
+
 <script>
 let selected = null;
+let tab = 'runs';
+let searchCache = [];
 // Bearer token for authed deployments: ?token=... once, then localStorage.
 const urlToken = new URLSearchParams(location.search).get('token');
 if (urlToken) localStorage.setItem('px_token', urlToken);
 const TOKEN = localStorage.getItem('px_token');
 const HDRS = TOKEN ? {Authorization: 'Bearer ' + TOKEN} : {};
-const apiFetch = url => fetch(url, {headers: HDRS});
+const apiFetch = (url, opts) => fetch(url, {...(opts||{}), headers: HDRS});
 const esc = s => String(s ?? '').replace(/[&<>"']/g,
   c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
 const names = {};
 const fmtMetric = m => Object.entries(m||{}).filter(([k])=>!k.startsWith('sys/'))
   .map(([k,v])=>`${esc(k)}=${typeof v==='number'?v.toPrecision(4):esc(v)}`).join(' ');
+const fmtTs = t => new Date(t*1000).toLocaleTimeString();
+
+function showTab(name) {
+  tab = name;
+  for (const t of ['runs','devices','projects','searches','activity']) {
+    document.getElementById('view-'+t).style.display = t===name?'block':'none';
+    document.getElementById('tab-'+t).className = t===name?'active':'';
+  }
+  refresh();
+}
+
 async function refresh() {
+  if (tab === 'runs') return refreshRuns();
+  const resp = await apiFetch('/api/v1/' + (tab === 'activity' ? 'activities' : tab));
+  if (!resp.ok) return authNote(resp);
+  const data = (await resp.json()).results;
+  if (tab === 'devices')
+    document.getElementById('devices').innerHTML = data.map(d => `
+      <tr><td>${Number(d.id)}</td><td>${esc(d.name)}</td><td>${esc(d.accelerator)}</td>
+      <td>${Number(d.chips)}</td><td>${Number(d.num_hosts)}</td>
+      <td>${d.run_id ? '#'+Number(d.run_id) : '<span class="dim">free</span>'}</td></tr>`).join('');
+  if (tab === 'projects')
+    document.getElementById('projects').innerHTML = data.map(p => `
+      <tr><td>${esc(p.name)}</td><td>${Number(p.num_runs)}</td>
+      <td class="dim">${esc(p.description||'')}</td></tr>`).join('');
+  if (tab === 'searches') {
+    // Index-addressed buttons: names are arbitrary user strings and must
+    // never be interpolated into inline JS (quote-breakout XSS).
+    searchCache = data;
+    document.getElementById('searches').innerHTML = data.map((s, i) => `
+      <tr><td>${esc(s.name)}</td><td class="dim">${esc(s.query)}</td>
+      <td class="dim">${esc(s.owner||'')}</td>
+      <td><button onclick="runSearchIdx(${Number(i)})">run</button></td></tr>`).join('');
+  }
+  if (tab === 'activity')
+    document.getElementById('activity').innerHTML = data.map(a => `
+      <tr><td class="dim">${fmtTs(a.created_at)}</td><td>${esc(a.event_type)}</td>
+      <td>${esc(a.context.actor||'')}</td>
+      <td class="dim">${esc(Object.entries(a.context).filter(([k])=>k!=='actor')
+        .map(([k,v])=>k+'='+v).join(' '))}</td></tr>`).join('');
+}
+
+function authNote(resp) {
+  if (resp.status === 401)
+    document.getElementById('count').textContent = '— unauthorized (append ?token=...)';
+}
+
+function runSearchIdx(i) {
+  // Execute by plugging the saved query into the filter box.
+  const s = searchCache[i];
+  if (!s) return;
+  showTab('runs');
+  document.getElementById('query').value = s.query;
+  refreshRuns();
+}
+
+async function refreshRuns() {
   const q = document.getElementById('query').value.trim();
   const url = '/api/v1/runs' + (q ? '?q=' + encodeURIComponent(q) : '');
   const resp = await apiFetch(url);
-  if (!resp.ok) {
-    if (resp.status === 401)
-      document.getElementById('count').textContent = '— unauthorized (append ?token=...)';
-    return;
-  }
+  if (!resp.ok) return authNote(resp);
   const data = await resp.json();
   document.getElementById('count').textContent = `— ${data.results.length} runs`;
   document.getElementById('runs').innerHTML = data.results.map(r => {
@@ -83,24 +193,38 @@ async function refresh() {
       <td>${Number(r.id)}</td><td>${esc(r.kind)}</td><td>${esc(r.name||'')}</td>
       <td>${esc(r.project)}</td>
       <td><span class="chip ${esc(r.status)}">${esc(r.status)}</span></td>
-      <td>${fmtMetric(r.last_metric)}</td><td>${Number(r.restarts)}</td></tr>`;
+      <td>${fmtMetric(r.last_metric)}</td><td>${Number(r.restarts)}</td>
+      <td>${r.service_url ? `<a class="svc" href="${esc(r.service_url)}"
+        target="_blank" onclick="event.stopPropagation()">open</a>` : ''}</td></tr>`;
   }).join('');
   if (selected) await refreshDetail();
 }
+
 async function select(id) {
   selected = id;
   document.getElementById('detail').style.display = 'block';
   document.getElementById('detail-title').textContent = `#${id} ${names[id]||''}`;
   await refreshDetail();
 }
+
+async function runAction(action) {
+  if (!selected) return;
+  await apiFetch(`/api/v1/runs/${selected}/${action}`, {method:'POST'});
+  await refreshRuns();
+}
+
 async function refreshDetail() {
-  const [metrics, logs] = await Promise.all([
+  const [metrics, logs, statuses] = await Promise.all([
     apiFetch(`/api/v1/runs/${selected}/metrics`).then(r=>r.json()),
-    apiFetch(`/api/v1/runs/${selected}/logs?limit=200`).then(r=>r.json())]);
+    apiFetch(`/api/v1/runs/${selected}/logs?limit=200`).then(r=>r.json()),
+    apiFetch(`/api/v1/runs/${selected}/statuses`).then(r=>r.json())]);
   document.getElementById('logs').textContent =
     logs.results.map(l=>l.line).join('\\n') || '(no logs)';
+  document.getElementById('statuses').textContent =
+    statuses.results.map(s=>s.status).join(' → ');
   drawChart(metrics.results);
 }
+
 function drawChart(rows) {
   const c = document.getElementById('chart'), ctx = c.getContext('2d');
   ctx.clearRect(0,0,c.width,c.height);
@@ -124,7 +248,7 @@ function drawChart(rows) {
     ctx.fillText(name, 44, 14+12*si);
   });
 }
-document.getElementById('query').addEventListener('change', refresh);
+document.getElementById('query').addEventListener('change', refreshRuns);
 refresh(); setInterval(refresh, 2000);
 </script>
 </body>

@@ -968,11 +968,15 @@ class RunRegistry:
         ).fetchone()[0]
         if row is None:
             # Run-implied project (list_projects shows these too): the
-            # detail endpoint must not 404 on names the listing returned.
+            # detail endpoint must not 404 on names the listing returned,
+            # and must return the SAME shape the listing used.
             if num_runs == 0:
                 return None
+            first = self._conn().execute(
+                "SELECT MIN(created_at) FROM runs WHERE project = ?", (name,)
+            ).fetchone()[0]
             return {"id": None, "name": name, "description": None,
-                    "num_runs": num_runs}
+                    "created_at": first, "num_runs": num_runs}
         return {**dict(row), "num_runs": num_runs}
 
     def delete_project(self, name: str) -> bool:

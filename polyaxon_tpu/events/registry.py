@@ -61,6 +61,16 @@ class EventTypes:
     CLUSTER_NODE_UPDATED = "cluster.node_updated"
     PLATFORM_HEALTH = "platform.health"
 
+    # entities (events/registry/{project,user,search,bookmark}.py)
+    PROJECT_CREATED = "project.created"
+    PROJECT_DELETED = "project.deleted"
+    USER_CREATED = "user.created"
+    USER_DELETED = "user.deleted"
+    SEARCH_CREATED = "search.created"
+    SEARCH_DELETED = "search.deleted"
+    BOOKMARK_ADDED = "bookmark.added"
+    BOOKMARK_REMOVED = "bookmark.removed"
+
 
 @dataclass
 class Event:
