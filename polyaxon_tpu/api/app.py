@@ -396,6 +396,9 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
     @routes.get(f"{API_PREFIX}/activities")
     async def list_activities(request):
         # The audit feed (reference activitylogs/): who did what, when.
+        # Admin-gated — it carries usernames and every actor's actions,
+        # the same data GET /users restricts.
+        _require_admin(request)
         rows = reg.get_activities(
             event_type=request.rel_url.query.get("event_type"),
             limit=_int_param(request, "limit", 100),

@@ -135,20 +135,24 @@ function showTab(name) {
 }
 
 async function refresh() {
-  if (tab === 'runs') return refreshRuns();
-  const resp = await apiFetch('/api/v1/' + (tab === 'activity' ? 'activities' : tab));
+  // Capture the tab before awaiting: a mid-flight tab switch must not
+  // render this payload into another tab's table.
+  const t = tab;
+  if (t === 'runs') return refreshRuns();
+  const resp = await apiFetch('/api/v1/' + (t === 'activity' ? 'activities' : t));
   if (!resp.ok) return authNote(resp);
+  if (t !== tab) return;
   const data = (await resp.json()).results;
-  if (tab === 'devices')
+  if (t === 'devices')
     document.getElementById('devices').innerHTML = data.map(d => `
       <tr><td>${Number(d.id)}</td><td>${esc(d.name)}</td><td>${esc(d.accelerator)}</td>
       <td>${Number(d.chips)}</td><td>${Number(d.num_hosts)}</td>
       <td>${d.run_id ? '#'+Number(d.run_id) : '<span class="dim">free</span>'}</td></tr>`).join('');
-  if (tab === 'projects')
+  if (t === 'projects')
     document.getElementById('projects').innerHTML = data.map(p => `
       <tr><td>${esc(p.name)}</td><td>${Number(p.num_runs)}</td>
       <td class="dim">${esc(p.description||'')}</td></tr>`).join('');
-  if (tab === 'searches') {
+  if (t === 'searches') {
     // Index-addressed buttons: names are arbitrary user strings and must
     // never be interpolated into inline JS (quote-breakout XSS).
     searchCache = data;
@@ -157,7 +161,7 @@ async function refresh() {
       <td class="dim">${esc(s.owner||'')}</td>
       <td><button onclick="runSearchIdx(${Number(i)})">run</button></td></tr>`).join('');
   }
-  if (tab === 'activity')
+  if (t === 'activity')
     document.getElementById('activity').innerHTML = data.map(a => `
       <tr><td class="dim">${fmtTs(a.created_at)}</td><td>${esc(a.event_type)}</td>
       <td>${esc(a.context.actor||'')}</td>
@@ -171,12 +175,13 @@ function authNote(resp) {
 }
 
 function runSearchIdx(i) {
-  // Execute by plugging the saved query into the filter box.
+  // Execute by plugging the saved query into the filter box — set it
+  // BEFORE switching tabs so showTab's implicit refresh already uses it
+  // (two racing fetches could otherwise show unfiltered results).
   const s = searchCache[i];
   if (!s) return;
-  showTab('runs');
   document.getElementById('query').value = s.query;
-  refreshRuns();
+  showTab('runs');
 }
 
 async function refreshRuns() {

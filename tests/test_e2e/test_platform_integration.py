@@ -1,0 +1,100 @@
+"""Whole-platform integration: sweep × slice admission × artifact store.
+
+The "simulated pool" scenario behind the v5e-16 north star (BASELINE.md),
+scaled to CI: a registered 2-slice inventory, an hpsearch sweep whose
+concurrency exceeds the pool, and a durable artifact store — trials must
+pack onto the slices (never oversubscribe), queue-and-resume as capacity
+frees, finish the search, and leave every trial's artifacts in the store.
+"""
+
+import pytest
+
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.orchestrator import Orchestrator
+from polyaxon_tpu.stores import run_prefix
+
+
+@pytest.fixture()
+def orch(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "POLYAXON_TPU_STORES_ARTIFACTS_URL", f"file://{tmp_path}/artifacts"
+    )
+    o = Orchestrator(
+        tmp_path / "plat",
+        monitor_interval=0.05,
+        heartbeat_interval=0.2,
+        heartbeat_ttl=30.0,
+    )
+    o.registry.register_device("slice0", "cpu-1", 1)
+    o.registry.register_device("slice1", "cpu-1", 1)
+    yield o
+    o.stop()
+
+
+@pytest.mark.e2e
+class TestPlatformIntegration:
+    def test_sweep_packs_pool_and_ships_artifacts(self, orch):
+        group = orch.submit(
+            {
+                "kind": "group",
+                "run": {
+                    "entrypoint": "polyaxon_tpu.builtins.trainers:metric_probe"
+                },
+                "environment": {
+                    "topology": {
+                        "accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1,
+                    }
+                },
+                "hptuning": {
+                    # Concurrency 4 over a 2-slice pool: admission must clamp.
+                    "concurrency": 4,
+                    "matrix": {"lr": {"values": [0.1, 0.3, 0.5, 0.7]}},
+                },
+            },
+            name="pool-sweep",
+        )
+        done = orch.wait(group.id, timeout=180)
+        assert done.status == S.SUCCEEDED
+        trials = orch.registry.list_runs(group_id=group.id)
+        assert len(trials) == 4
+        assert all(t.status == S.SUCCEEDED for t in trials)
+
+        # The pool was never oversubscribed: every slice-holding interval
+        # is serialized per slice. Reconstruct holding from statuses —
+        # SCHEDULED..terminal per trial; at most 2 could be in the gang
+        # phase at once.
+        def phase_interval(trial):
+            rows = orch.registry.get_statuses(trial.id)
+            start = next(
+                r["created_at"] for r in rows if r["status"] == S.SCHEDULED
+            )
+            end = next(
+                r["created_at"]
+                for r in rows
+                if r["status"] in (S.SUCCEEDED, S.FAILED, S.STOPPED)
+            )
+            return start, end
+
+        intervals = [phase_interval(t) for t in trials]
+        events = []
+        for start, end in intervals:
+            events += [(start, 1), (end, -1)]
+        live = peak = 0
+        for _, delta in sorted(events):
+            live += delta
+            peak = max(peak, live)
+        assert peak <= 2, f"pool oversubscribed: {peak} concurrent gangs"
+
+        # Every trial's artifacts landed in the durable store.
+        orch.pump(max_wait=1.0)  # drain the ARTIFACTS_SYNC tasks
+        for t in trials:
+            keys = orch.artifact_store.list(run_prefix(t.uuid))
+            # reports/ is the live control channel and stays local by
+            # design; the durable tier ships logs (+outputs/checkpoints).
+            assert any(k.startswith(f"{run_prefix(t.uuid)}/logs/") for k in keys), (
+                t.id,
+                keys,
+            )
+
+        # All slices are free again once the sweep is done.
+        assert all(d["run_id"] is None for d in orch.registry.list_devices())
