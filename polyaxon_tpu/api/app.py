@@ -220,6 +220,13 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         reg.ping_heartbeat(run.id)
         return web.json_response({"ok": True})
 
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/iterations")
+    async def get_iterations(request):
+        # Sweep iteration state (reference ExperimentGroupIteration rows):
+        # hyperband brackets / BO observation rounds, per iteration.
+        run = _run_or_404(request)
+        return web.json_response({"results": reg.get_iterations(run.id)})
+
     @routes.get(f"{API_PREFIX}/runs/{{run_id}}/processes")
     async def get_processes(request):
         run = _run_or_404(request)

@@ -324,6 +324,42 @@ class TestEntityAPIs:
 
         assert drive(orch, body)
 
+    def test_iterations_endpoint_for_sweeps(self, orch):
+        async def body(client):
+            group = await (
+                await client.post(
+                    "/api/v1/runs",
+                    json={
+                        "spec": {
+                            "kind": "group",
+                            "run": {
+                                "entrypoint": "polyaxon_tpu.builtins.trainers:metric_probe"
+                            },
+                            "environment": {
+                                "topology": {
+                                    "accelerator": "cpu-1",
+                                    "num_devices": 1,
+                                    "num_hosts": 1,
+                                }
+                            },
+                            "hptuning": {
+                                "concurrency": 2,
+                                "matrix": {"lr": {"values": [0.1, 0.5]}},
+                            },
+                        }
+                    },
+                )
+            ).json()
+            await _wait_done(orch, client, group["id"], timeout=120)
+            resp = await client.get(f"/api/v1/runs/{group['id']}/iterations")
+            assert resp.status == 200
+            results = (await resp.json())["results"]
+            assert results and {"number", "data"} <= set(results[0])
+            assert len(results[0]["data"]["trial_ids"]) == 2
+            return True
+
+        assert drive(orch, body)
+
     def test_query_pushdown_pagination(self, orch):
         async def body(client):
             for i in range(5):

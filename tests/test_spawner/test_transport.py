@@ -230,3 +230,48 @@ class TestLocalExecTransport:
             rc_path=tmp_path / "t.rc",
         )
         assert ref.wait(15.0) == 0
+
+
+class TestReattach:
+    def test_local_reattach_reads_rc_file(self, tmp_path):
+        from polyaxon_tpu.spawner.transport import LocalExecTransport
+
+        rc = tmp_path / "p.rc"
+        ref = LocalExecTransport().reattach("127.0.0.1", 999999999, rc)
+        # Dead pid, no rc file: synthesized failure code.
+        assert ref.poll() == 1
+        # With an rc file the real exit code wins.
+        rc2 = tmp_path / "q.rc"
+        rc2.write_text("0\n")
+        ref2 = LocalExecTransport().reattach("127.0.0.1", 999999999, rc2)
+        assert ref2.poll() == 0
+
+    def test_local_reattach_live_process(self, tmp_path):
+        import subprocess
+
+        from polyaxon_tpu.spawner.transport import LocalExecTransport
+
+        proc = subprocess.Popen(["sleep", "5"], start_new_session=True)
+        try:
+            ref = LocalExecTransport().reattach(
+                "127.0.0.1", proc.pid, tmp_path / "none.rc"
+            )
+            assert ref.poll() is None  # genuinely alive
+            import signal
+
+            ref.signal(signal.SIGKILL)
+            assert ref.wait(5.0) is not None
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_remote_reattach_polls_rc_from_shared_dir(self, tmp_path):
+        from polyaxon_tpu.spawner.transport import SSHTransport
+
+        t = SSHTransport()
+        rc = tmp_path / "proc0.rc"
+        ref = t.reattach("worker-host", 4242, rc)
+        assert ref.poll() is None  # no rc yet: still running
+        rc.write_text("7\n")
+        assert ref.poll() == 7  # exit code rides the shared run dir
+        assert ref.pid == 4242 and ref.host == "worker-host"
