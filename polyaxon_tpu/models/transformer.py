@@ -60,7 +60,10 @@ class TransformerConfig:
     remat_policy: str = "none"
 
     def __post_init__(self) -> None:
-        allowed = ("none", "dots", "dots_no_batch", "save_attn", "save_attn_mlp")
+        allowed = (
+            "none", "dots", "dots_no_batch", "save_attn", "save_attn_mlp",
+            "save_qkv_attn",
+        )
         if self.remat_policy not in allowed:
             raise ValueError(
                 f"Unknown remat_policy {self.remat_policy!r} (one of {allowed})"
@@ -320,11 +323,17 @@ def forward(
         v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(h.dtype))
         q = _rope(q, pos, c.rope_theta)
         k = _rope(k, pos, c.rope_theta)
+        # Named so remat policies can keep the projected/rotated q,k,v —
+        # the bwd pass consumes them directly, and the recompute chain
+        # skips all three projection matmuls + rope.
+        q = checkpoint_name(q, "q_proj")
+        k = checkpoint_name(k, "k_proj")
         # Ulysses switch-point: constraining attn_heads re-shards heads
         # across the sequence axis (XLA inserts the all-to-all).
         q = with_logical_constraint(q, ("batch", None, "attn_heads", None), rules, cmesh)
         k = with_logical_constraint(k, ("batch", None, "attn_heads", None), rules, cmesh)
         v = with_logical_constraint(v, ("batch", None, "attn_heads", None), rules, cmesh)
+        v = checkpoint_name(v, "v_proj")
         if ring_axis is not None:
             from polyaxon_tpu.parallel.ring import ring_attention_sharded
 
@@ -369,6 +378,9 @@ def forward(
             "save_attn": jax.checkpoint_policies.save_only_these_names("attn_out"),
             "save_attn_mlp": jax.checkpoint_policies.save_only_these_names(
                 "attn_out", "mlp_act"
+            ),
+            "save_qkv_attn": jax.checkpoint_policies.save_only_these_names(
+                "q_proj", "k_proj", "v_proj", "attn_out"
             ),
         }
         policy = policies.get(c.remat_policy)
