@@ -323,16 +323,16 @@ def forward(
         v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(h.dtype))
         q = _rope(q, pos, c.rope_theta)
         k = _rope(k, pos, c.rope_theta)
-        # Named so remat policies can keep the projected/rotated q,k,v —
-        # the bwd pass consumes them directly, and the recompute chain
-        # skips all three projection matmuls + rope.
-        q = checkpoint_name(q, "q_proj")
-        k = checkpoint_name(k, "k_proj")
         # Ulysses switch-point: constraining attn_heads re-shards heads
         # across the sequence axis (XLA inserts the all-to-all).
         q = with_logical_constraint(q, ("batch", None, "attn_heads", None), rules, cmesh)
         k = with_logical_constraint(k, ("batch", None, "attn_heads", None), rules, cmesh)
         v = with_logical_constraint(v, ("batch", None, "attn_heads", None), rules, cmesh)
+        # Named AFTER the attn_heads constraint so remat policies save the
+        # post-reshard tensors: under Ulysses the bwd recompute must not
+        # re-run the all-to-alls the save exists to skip.
+        q = checkpoint_name(q, "q_proj")
+        k = checkpoint_name(k, "k_proj")
         v = checkpoint_name(v, "v_proj")
         if ring_axis is not None:
             from polyaxon_tpu.parallel.ring import ring_attention_sharded
