@@ -342,6 +342,61 @@ def _print_runs(runs) -> None:
         )
 
 
+#: `init` scaffolds (the reference's `polyaxon init` starter files).
+_STARTERS = {
+    "experiment": """kind: experiment
+run:
+  entrypoint: polyaxon_tpu.builtins.trainers:lm_train
+declarations:
+  steps: 100
+  batch: 8
+  seq: 512
+environment:
+  seed: 42
+  topology:
+    accelerator: v5e-8
+    strategy: fsdp
+""",
+    "group": """kind: group
+run:
+  entrypoint: polyaxon_tpu.builtins.trainers:lm_train
+declarations:
+  steps: 100
+hptuning:
+  concurrency: 2
+  matrix:
+    lr: {values: [1.0e-4, 3.0e-4, 1.0e-3]}
+environment:
+  topology:
+    accelerator: v5e-8
+    strategy: ddp
+""",
+    "pipeline": """kind: pipeline
+ops:
+  - name: prepare
+    run:
+      entrypoint: polyaxon_tpu.builtins.trainers:noop
+    environment:
+      topology: {accelerator: v5e-8}
+  - name: train
+    run:
+      entrypoint: polyaxon_tpu.builtins.trainers:lm_train
+    environment:
+      topology: {accelerator: v5e-8}
+    dependencies: [prepare]
+""",
+    "tensorboard": """kind: tensorboard
+declarations:
+  target: <run-uuid>   # whose outputs to visualize
+environment:
+  topology:
+    accelerator: cpu-1
+    num_devices: 1
+    num_hosts: 1
+""",
+}
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="polyaxon-tpu", description="TPU-native experiment platform CLI"
@@ -354,6 +409,13 @@ def main(argv=None) -> int:
         "--base-dir", default=DEFAULT_BASE, help="platform state dir (local mode)"
     )
     sub = parser.add_subparsers(dest="command", required=True)
+
+    p_init = sub.add_parser("init", help="write a starter polyaxonfile")
+    p_init.add_argument("-f", "--file", default="polyaxonfile.yml")
+    p_init.add_argument(
+        "--kind", default="experiment",
+        choices=("experiment", "group", "pipeline", "tensorboard"),
+    )
 
     p_run = sub.add_parser("run", help="submit a polyaxonfile")
     p_run.add_argument("-f", "--file", required=True, help="spec file (yaml/json)")
@@ -485,6 +547,18 @@ def main(argv=None) -> int:
             port=args.port,
             auth_token=args.token,
         )
+        return 0
+
+    if args.command == "init":
+        target = Path(args.file)
+        try:
+            # Exclusive create: refuses existing files atomically (no
+            # exists()-then-write race).
+            with target.open("x") as fh:
+                fh.write(_STARTERS[args.kind])
+        except FileExistsError:
+            raise SystemExit(f"{target} already exists")
+        print(f"wrote {target} ({args.kind})", file=sys.stderr)
         return 0
 
     client = _client(args)

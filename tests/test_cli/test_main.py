@@ -51,3 +51,27 @@ class TestCLI:
             ["--base-dir", str(tmp_path / "home"), "run", "-f", str(spec_file), "-w"]
         )
         assert rc == 1
+
+
+class TestInit:
+    def test_starters_are_valid_polyaxonfiles(self, tmp_path):
+        from polyaxon_tpu.cli.main import main
+        from polyaxon_tpu.schemas import PolyaxonFile
+
+        for kind in ("experiment", "group", "pipeline", "tensorboard"):
+            target = tmp_path / f"{kind}.yml"
+            rc = main(["init", "-f", str(target), "--kind", kind])
+            assert rc == 0 and target.exists()
+            spec = PolyaxonFile.load(target.read_text()).specification
+            assert spec.kind == kind
+
+    def test_init_refuses_overwrite(self, tmp_path):
+        from polyaxon_tpu.cli.main import main
+
+        import pytest
+
+        target = tmp_path / "f.yml"
+        target.write_text("existing")
+        with pytest.raises(SystemExit):
+            main(["init", "-f", str(target)])
+        assert target.read_text() == "existing"
