@@ -400,6 +400,50 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         runs = reg.list_bookmarked_runs(owner=_bookmark_owner(request))
         return web.json_response({"results": [run_to_dict(r) for r in runs]})
 
+    # -- runtime options (reference options API / cluster settings) -----------
+    @routes.get(f"{API_PREFIX}/options")
+    async def list_options(request):
+        # The full typed registry with resolved values. Admin-gated: values
+        # include operational secrets-adjacent settings (hosts, key paths).
+        _require_admin(request)
+        from polyaxon_tpu.conf.options import OPTIONS, display_value
+
+        results = [
+            {
+                "key": opt.key,
+                "value": display_value(opt, orch.conf.get(opt.key)),
+                "default": display_value(opt, opt.default),
+                "description": opt.description,
+            }
+            for opt in OPTIONS.values()
+        ]
+        return web.json_response({"results": results})
+
+    @routes.put(f"{API_PREFIX}/options/{{key}}")
+    async def set_option(request):
+        _require_admin(request)
+        from polyaxon_tpu.conf.options import display_value, option_by_key
+        from polyaxon_tpu.conf.service import ConfError
+
+        key = request.match_info["key"]
+        opt = option_by_key(key)
+        if opt is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": f"unknown option {key!r}"}),
+                content_type="application/json",
+            )
+        try:
+            body = await request.json()
+            orch.conf.set(key, body["value"])
+        except (KeyError, TypeError, ValueError, ConfError) as e:
+            # Covers malformed JSON bodies too (JSONDecodeError is a
+            # ValueError) — bad input is a 400, never a 500.
+            return web.json_response({"error": str(e)}, status=400)
+        _audit(request, "platform.option_set", key=key)
+        return web.json_response(
+            {"key": key, "value": display_value(opt, orch.conf.get(key))}
+        )
+
     @routes.get(f"{API_PREFIX}/activities")
     async def list_activities(request):
         # The audit feed (reference activitylogs/): who did what, when.

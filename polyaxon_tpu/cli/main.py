@@ -114,6 +114,12 @@ class RemoteClient:
             "POST", "/api/v1/users", {"username": username, "role": role}
         )
 
+    def list_options(self):
+        return self._request("GET", "/api/v1/options")["results"]
+
+    def set_option(self, key, value):
+        return self._request("PUT", f"/api/v1/options/{key}", {"value": value})
+
     def list_users(self):
         return self._request("GET", "/api/v1/users")["results"]
 
@@ -232,6 +238,24 @@ class LocalClient:
     def create_user(self, username, role):
         user, token = self.orch.registry.create_user(username, role=role)
         return {**user, "token": token}
+
+    def list_options(self):
+        from polyaxon_tpu.conf.options import OPTIONS, display_value
+
+        return [
+            {"key": o.key, "value": display_value(o, self.orch.conf.get(o.key)),
+             "default": display_value(o, o.default), "description": o.description}
+            for o in OPTIONS.values()
+        ]
+
+    def set_option(self, key, value):
+        from polyaxon_tpu.conf.options import display_value, option_by_key
+
+        opt = option_by_key(key)
+        if opt is None:
+            raise SystemExit(f"unknown option {key!r}")
+        self.orch.conf.set(key, value)
+        return {"key": key, "value": display_value(opt, self.orch.conf.get(key))}
 
     def list_users(self):
         return self.orch.registry.list_users()
@@ -503,6 +527,13 @@ def main(argv=None) -> int:
     p_bm.add_argument("-d", "--delete", action="store_true", help="remove instead")
     sub.add_parser("bookmarks", help="list bookmarked runs")
 
+    p_cfg = sub.add_parser("config", help="runtime-mutable platform options")
+    cfg_sub = p_cfg.add_subparsers(dest="config_command", required=True)
+    cfg_sub.add_parser("list", help="all options with resolved values")
+    p_cfg_set = cfg_sub.add_parser("set", help="write an option to the DB store")
+    p_cfg_set.add_argument("key")
+    p_cfg_set.add_argument("value")
+
     p_login = sub.add_parser("login", help="store an API host + token")
     p_login.add_argument("--api-host", required=True, help="API server address")
     p_login.add_argument("--api-token", required=True, help="your user token")
@@ -672,6 +703,17 @@ def main(argv=None) -> int:
             return 0
         if args.command == "bookmarks":
             _print_runs(client.list_bookmarks())
+            return 0
+        if args.command == "config":
+            if args.config_command == "list":
+                fmt = "{:36}  {:18}  {:}"
+                print(fmt.format("KEY", "VALUE", "DESCRIPTION"))
+                for o in client.list_options():
+                    print(fmt.format(o["key"], str(o["value"])[:18],
+                                     o["description"][:60]))
+            elif args.config_command == "set":
+                out = client.set_option(args.key, args.value)
+                print(json.dumps(out))
             return 0
         if args.command == "users":
             if args.users_command == "add":

@@ -29,6 +29,9 @@ class Option:
     description: str = ""
     #: resolution order, first hit wins
     stores: Tuple[str, ...] = (OptionStores.DB, OptionStores.ENV, OptionStores.DEFAULT)
+    #: secrets are write-only over every surface (API/CLI list AND set
+    #: responses mask them)
+    secret: bool = False
 
     @property
     def env_var(self) -> str:
@@ -85,7 +88,7 @@ _ALL = [
     Option("notifier.email_to", str, "", "comma-separated recipients"),
     Option("notifier.email_tls", bool, False, "STARTTLS before sending"),
     Option("notifier.email_user", str, "", "SMTP login ('' = no auth)"),
-    Option("notifier.email_password", str, "", "SMTP password"),
+    Option("notifier.email_password", str, "", "SMTP password", secret=True),
     Option("groups.max_concurrency", int, 64,
            "upper bound on a sweep's concurrency setting"),
     Option("restarts.max_allowed", int, 10,
@@ -99,3 +102,8 @@ OPTIONS: Dict[str, Option] = {o.key: o for o in _ALL}
 
 def option_by_key(key: str) -> Optional[Option]:
     return OPTIONS.get(key)
+
+
+def display_value(opt: Option, value: Any) -> Any:
+    """What a read surface may show for this option's value."""
+    return "***" if opt.secret else value

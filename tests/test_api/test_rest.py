@@ -373,3 +373,28 @@ class TestEntityAPIs:
             return True
 
         assert drive(orch, body)
+
+
+class TestOptionsAPI:
+    def test_list_and_set_options(self, orch):
+        async def body(client):
+            resp = await client.get("/api/v1/options")
+            assert resp.status == 200
+            opts = {o["key"]: o for o in (await resp.json())["results"]}
+            assert opts["scheduler.terminal_grace"]["value"] == 10.0
+            # passwords are never echoed
+            assert opts["notifier.email_password"]["value"] == "***"
+
+            resp = await client.put(
+                "/api/v1/options/scheduler.terminal_grace", json={"value": 22}
+            )
+            assert resp.status == 200
+            assert (await resp.json())["value"] == 22.0  # typed coercion
+            # resolves through the DB store now
+            assert orch.conf.get("scheduler.terminal_grace") == 22.0
+
+            resp = await client.put("/api/v1/options/bogus.key", json={"value": 1})
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)
