@@ -97,10 +97,22 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
 
     @routes.get(f"{API_PREFIX}/status")
     async def status(request):
-        # Health surface (reference checks/ + api/index/status.py).
-        from polyaxon_tpu.checks import run_health_checks
+        # Health surface (reference checks/ + api/index/status.py). The
+        # endpoint stays open for probes; operational task counters ride
+        # the payload only for admins (or when auth is off entirely) —
+        # task names and failure volumes are internal data.
+        from polyaxon_tpu.checks import run_health_checks, task_counter_snapshot
 
         report = run_health_checks(orch)
+        required = bool(auth_token) or reg.has_users()
+        show_counters = not required
+        if required:
+            resolved = _resolve_actor(request)
+            show_counters = resolved is not None and resolved[1] == "admin"
+        if show_counters:
+            counters = task_counter_snapshot(orch)
+            if counters:
+                report["task_counters"] = counters
         code = 200 if report["healthy"] else 503
         return web.json_response(report, status=code)
 
@@ -406,18 +418,9 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         # The full typed registry with resolved values. Admin-gated: values
         # include operational secrets-adjacent settings (hosts, key paths).
         _require_admin(request)
-        from polyaxon_tpu.conf.options import OPTIONS, display_value
+        from polyaxon_tpu.conf.options import options_payload
 
-        results = [
-            {
-                "key": opt.key,
-                "value": display_value(opt, orch.conf.get(opt.key)),
-                "default": display_value(opt, opt.default),
-                "description": opt.description,
-            }
-            for opt in OPTIONS.values()
-        ]
-        return web.json_response({"results": results})
+        return web.json_response({"results": options_payload(orch.conf)})
 
     @routes.put(f"{API_PREFIX}/options/{{key}}")
     async def set_option(request):

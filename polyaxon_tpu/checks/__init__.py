@@ -1,3 +1,3 @@
-from polyaxon_tpu.checks.health import run_health_checks
+from polyaxon_tpu.checks.health import run_health_checks, task_counter_snapshot
 
-__all__ = ["run_health_checks"]
+__all__ = ["run_health_checks", "task_counter_snapshot"]

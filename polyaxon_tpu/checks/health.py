@@ -78,3 +78,15 @@ def run_health_checks(orch, include_devices: bool = False) -> Dict[str, Any]:
         results[name] = {"ok": ok, "detail": detail}
         healthy = healthy and ok
     return {"healthy": healthy, "checks": results, "at": time.time()}
+
+
+def task_counter_snapshot(orch, top: int = 20) -> Dict[str, int]:
+    """Top task counters from an in-memory stats backend ({} otherwise).
+
+    Snapshots the dict before sorting: the bus thread inserts keys
+    concurrently and iterating the live mapping would race.
+    """
+    counters = getattr(getattr(orch, "stats", None), "counters", None)
+    if not counters:
+        return {}
+    return dict(sorted(dict(counters).items(), key=lambda kv: -kv[1])[:top])

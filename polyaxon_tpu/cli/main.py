@@ -240,13 +240,9 @@ class LocalClient:
         return {**user, "token": token}
 
     def list_options(self):
-        from polyaxon_tpu.conf.options import OPTIONS, display_value
+        from polyaxon_tpu.conf.options import options_payload
 
-        return [
-            {"key": o.key, "value": display_value(o, self.orch.conf.get(o.key)),
-             "default": display_value(o, o.default), "description": o.description}
-            for o in OPTIONS.values()
-        ]
+        return options_payload(self.orch.conf)
 
     def set_option(self, key, value):
         from polyaxon_tpu.conf.options import display_value, option_by_key

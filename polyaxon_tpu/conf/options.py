@@ -32,17 +32,26 @@ class Option:
     #: secrets are write-only over every surface (API/CLI list AND set
     #: responses mask them)
     secret: bool = False
+    #: closed value set; coerce() rejects anything else
+    choices: Optional[Tuple[str, ...]] = None
 
     @property
     def env_var(self) -> str:
         return "POLYAXON_TPU_" + self.key.upper().replace(".", "_")
 
     def coerce(self, raw: Any) -> Any:
-        if raw is None or isinstance(raw, self.typing):
+        if raw is None:
             return raw
-        if self.typing is bool:
-            return str(raw).lower() in ("1", "true", "yes", "on")
-        return self.typing(raw)
+        if not isinstance(raw, self.typing):
+            if self.typing is bool:
+                raw = str(raw).lower() in ("1", "true", "yes", "on")
+            else:
+                raw = self.typing(raw)
+        if self.choices is not None and raw not in self.choices:
+            raise ValueError(
+                f"{self.key} must be one of {self.choices}, got {raw!r}"
+            )
+        return raw
 
 
 _ALL = [
@@ -63,7 +72,7 @@ _ALL = [
     Option("spawner.default_accelerator", str, "cpu",
            "topology.accelerator default for specs that omit it"),
     Option("spawner.backend", str, "local",
-           "gang transport: local (subprocesses) or ssh (TPU-VM hosts)"),
+           "gang transport (restart required)", choices=("local", "ssh")),
     Option("spawner.hosts", str, "",
            "comma-separated worker host addresses for the ssh backend "
            "(slice order: worker 0 first — it hosts the coordinator)"),
@@ -79,7 +88,8 @@ _ALL = [
     Option("notifier.webhook_url", str, "",
            "notification webhook endpoint ('' = off)"),
     Option("notifier.webhook_kind", str, "",
-           "payload dialect: slack|discord|mattermost|pagerduty|'' (raw JSON)"),
+           "payload dialect ('' = raw JSON; restart required)",
+           choices=("", "slack", "discord", "mattermost", "pagerduty")),
     Option("notifier.pagerduty_routing_key", str, "",
            "Events-API-v2 integration key (webhook_kind=pagerduty)"),
     Option("notifier.email_host", str, "", "SMTP host ('' = email off)"),
@@ -95,6 +105,11 @@ _ALL = [
            "upper bound on restart_policy.max_restarts"),
     Option("logs.retention_days", float, 30.0, "activity/log cleanup horizon"),
     Option("api.page_size", int, 100, "default list page size"),
+    Option("stats.backend", str, "memory",
+           "operational metrics sink (restart required)",
+           choices=("memory", "statsd", "noop")),
+    Option("stats.statsd_host", str, "127.0.0.1", "statsd UDP host"),
+    Option("stats.statsd_port", int, 8125, "statsd UDP port"),
 ]
 
 OPTIONS: Dict[str, Option] = {o.key: o for o in _ALL}
@@ -107,3 +122,17 @@ def option_by_key(key: str) -> Optional[Option]:
 def display_value(opt: Option, value: Any) -> Any:
     """What a read surface may show for this option's value."""
     return "***" if opt.secret else value
+
+
+def options_payload(conf) -> list:
+    """The option listing every surface serves (API and local CLI share
+    this so the payloads can never drift)."""
+    return [
+        {
+            "key": opt.key,
+            "value": display_value(opt, conf.get(opt.key)),
+            "default": display_value(opt, opt.default),
+            "description": opt.description,
+        }
+        for opt in OPTIONS.values()
+    ]

@@ -80,7 +80,18 @@ class Orchestrator:
             if heartbeat_check_interval is not None
             else conf.get("scheduler.heartbeat_check_interval")
         )
-        self.bus = TaskBus(time_scale=time_scale)
+        from polyaxon_tpu.stats import MemoryStats, NoOpStats, StatsdStats
+
+        stats_kind = conf.get("stats.backend")
+        if stats_kind == "statsd":
+            self.stats = StatsdStats(
+                conf.get("stats.statsd_host"), conf.get("stats.statsd_port")
+            )
+        elif stats_kind == "noop":
+            self.stats = NoOpStats()
+        else:
+            self.stats = MemoryStats()
+        self.bus = TaskBus(time_scale=time_scale, stats=self.stats)
         self.auditor = Auditor(self.registry)
         self.executor = ExecutorHandlers(self.bus)
         self.auditor.subscribe(self.executor)

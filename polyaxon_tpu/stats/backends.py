@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import socket
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from contextlib import contextmanager
 from typing import Dict, List, Tuple
 
@@ -46,12 +46,21 @@ class NoOpStats(StatsBackend):
 
 
 class MemoryStats(StatsBackend):
-    """In-process aggregation (tests + health/status introspection)."""
+    """In-process aggregation (tests + health/status introspection).
+
+    Timing samples are bounded per key (recent window) — this backend is
+    the DEFAULT and instruments every task execution, so unbounded lists
+    would be a slow memory leak in a long-lived service.
+    """
+
+    TIMING_WINDOW = 512
 
     def __init__(self) -> None:
         self.counters: Dict[str, int] = defaultdict(int)
         self.gauges: Dict[str, float] = {}
-        self.timings: Dict[str, List[float]] = defaultdict(list)
+        self.timings: Dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=self.TIMING_WINDOW)
+        )
 
     def incr(self, key: str, value: int = 1) -> None:
         self.counters[key] += value

@@ -39,9 +39,13 @@ class TaskBus:
     milliseconds without changing orchestration code.
     """
 
-    def __init__(self, *, time_scale: float = 1.0, max_retries: int = 100) -> None:
+    def __init__(
+        self, *, time_scale: float = 1.0, max_retries: int = 100, stats=None
+    ) -> None:
         self.time_scale = time_scale
         self.max_retries = max_retries
+        #: Operational metrics sink (StatsBackend); None = no instrumentation.
+        self.stats = stats
         self._tasks: Dict[str, Callable[..., Any]] = {}
         self._queue: List[Tuple[float, int, str, Dict[str, Any], int]] = []
         self._counter = itertools.count()
@@ -99,17 +103,28 @@ class TaskBus:
     # -- execution ------------------------------------------------------------
     def _run_one(self, name: str, kwargs: Dict[str, Any], retries: int) -> None:
         fn = self._tasks[name]
+        t0 = time.perf_counter()
+        outcome = "ok"
         try:
             fn(**kwargs)
         except Retry as r:
+            outcome = "retry"
             if retries + 1 > self.max_retries:
+                outcome = "dead_letter"
                 logger.error("Task %s exhausted %d retries", name, self.max_retries)
                 self.errors.append((name, r, f"max retries ({self.max_retries}) exhausted"))
                 return
             self.send(name, kwargs, countdown=r.countdown, _retries=retries + 1)
         except Exception as e:  # noqa: BLE001 — a task must never kill the bus
+            outcome = "error"
             logger.exception("Task %s failed", name)
             self.errors.append((name, e, traceback.format_exc()))
+        finally:
+            if self.stats is not None:
+                # The celery-era task counters/timers (reference stats/):
+                # throughput + latency per task name, failures by outcome.
+                self.stats.incr(f"tasks.{name}.{outcome}")
+                self.stats.timing(f"tasks.{name}", time.perf_counter() - t0)
 
     def _reschedule_cron(self, name: str, kwargs: Dict[str, Any]) -> None:
         for cron_name, interval, cron_kwargs in self._crons:

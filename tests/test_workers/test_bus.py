@@ -179,3 +179,37 @@ class TestAuditorExecutor:
         handlers(Event(EventTypes.EXPERIMENT_DONE, {"run_id": 5}))
         bus.pump()
         assert [c[0] for c in calls] == [SchedulerTasks.EXPERIMENTS_STOP]
+
+
+class TestBusStats:
+    def test_task_outcomes_and_timings_recorded(self):
+        from polyaxon_tpu.stats import MemoryStats
+        from polyaxon_tpu.workers import Retry, TaskBus
+
+        stats = MemoryStats()
+        bus = TaskBus(stats=stats, max_retries=1)
+
+        @bus.register("t.ok")
+        def ok():
+            pass
+
+        @bus.register("t.boom")
+        def boom():
+            raise RuntimeError("x")
+
+        attempts = []
+
+        @bus.register("t.retry")
+        def retrying():
+            attempts.append(1)
+            raise Retry(countdown=0)
+
+        bus.send("t.ok", {})
+        bus.send("t.boom", {})
+        bus.send("t.retry", {})
+        bus.pump(max_wait=0.5)
+        assert stats.counters["tasks.t.ok.ok"] == 1
+        assert stats.counters["tasks.t.boom.error"] == 1
+        assert stats.counters["tasks.t.retry.retry"] >= 1
+        assert stats.counters["tasks.t.retry.dead_letter"] == 1
+        assert stats.timings["tasks.t.ok"]
