@@ -104,7 +104,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         from polyaxon_tpu.checks import run_health_checks, task_counter_snapshot
 
         report = run_health_checks(orch)
-        required = bool(auth_token) or reg.has_users()
+        required = request.get("auth_required", True)
         show_counters = not required
         if required:
             resolved = _resolve_actor(request)
@@ -599,11 +599,15 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         # request — users can be minted at runtime).
         open_paths = ("/", f"{API_PREFIX}/status")
         required = bool(auth_token) or reg.has_users()
+        request["auth_required"] = required
         if required and request.path not in open_paths:
             resolved = _resolve_actor(request)
             if resolved is None:
                 return web.json_response({"error": "unauthorized"}, status=401)
             request["actor"], request["role"] = resolved
+        elif required:
+            # Open path under auth (probes): identity unknown, no powers.
+            request["actor"], request["role"] = None, None
         else:
             # Open mode (dev/tests): every caller is the anonymous admin.
             request["actor"], request["role"] = "anonymous", "admin"

@@ -250,7 +250,10 @@ class LocalClient:
         opt = option_by_key(key)
         if opt is None:
             raise SystemExit(f"unknown option {key!r}")
-        self.orch.conf.set(key, value)
+        try:
+            self.orch.conf.set(key, value)
+        except (TypeError, ValueError) as e:
+            raise SystemExit(str(e))  # clean message, like the API's 400
         return {"key": key, "value": display_value(opt, self.orch.conf.get(key))}
 
     def list_users(self):

@@ -7,12 +7,15 @@ DB writes take effect cluster-wide at runtime, reads are cached with a TTL.
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 from typing import Any, Dict, Optional, Tuple
 
 from polyaxon_tpu.conf.options import Option, OptionStores, option_by_key
 from polyaxon_tpu.exceptions import PolyaxonTPUError
+
+logger = logging.getLogger(__name__)
 
 
 class ConfError(PolyaxonTPUError):
@@ -40,18 +43,26 @@ class ConfService:
         opt = self._option(key)
         value: Any = None
         for store in opt.stores:
+            raw = None
             if store == OptionStores.DB and self.registry is not None:
                 raw = self.registry.get_option(opt.key)
-                if raw is not None:
-                    value = opt.coerce(raw)
-                    break
             elif store == OptionStores.ENV:
                 raw = os.environ.get(opt.env_var)
-                if raw is not None:
-                    value = opt.coerce(raw)
-                    break
             elif store == OptionStores.DEFAULT:
                 value = opt.default
+                break
+            if raw is not None:
+                try:
+                    value = opt.coerce(raw)
+                except (TypeError, ValueError) as e:
+                    # A stale/invalid stored value (pre-validation DB row,
+                    # typo'd env var) must not brick startup or the options
+                    # listing — reads fall through to the next store; only
+                    # WRITES (set()) reject invalid values loudly.
+                    logger.warning(
+                        "Ignoring invalid %s value for %s: %s", store, key, e
+                    )
+                    continue
                 break
         self._cache[key] = (time.time(), value)
         return value

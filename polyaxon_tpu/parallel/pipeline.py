@@ -85,7 +85,15 @@ def _pp_body(
     )
     # Only the last stage holds real outputs; broadcast over the pipeline
     # axis so downstream (final norm + unembed) sees replicated activations.
-    outputs = lax.psum(jnp.where(stage == S - 1, outputs, 0.0), axis)
+    # The psum rides f32: a bf16 all-reduce over a manual axis inside a
+    # PARTIAL-manual shard_map hard-crashes XLA CPU ("Invalid binary
+    # instruction opcode copy"), and the one-pass cast on the final
+    # activations is noise. (Full-manual pp doesn't hit the bug; the shared
+    # body takes the safe path for both.)
+    out_dtype = outputs.dtype
+    outputs = lax.psum(
+        jnp.where(stage == S - 1, outputs, 0.0).astype(jnp.float32), axis
+    ).astype(out_dtype)
     # Mean over stages (each holds L/S layers) and microbatches; the aux
     # claims replication in out_specs, so it must also be averaged over any
     # batch-sharding axes (each data shard saw different tokens).
@@ -129,9 +137,18 @@ def pipeline_scan_composed(
             f"{num_microbatches} microbatches"
         )
     layer_spec = jax.tree.map(lambda _: P(axis), stacked_layers)
-    fn = jax.shard_map(
-        partial(
-            _pp_body,
+    x_dtype = x.dtype
+
+    def body_f32(x32, positions, layers):
+        # The region boundary rides f32: XLA CPU hard-crashes on a bf16
+        # all-reduce over a manual axis inside a PARTIAL-manual shard_map
+        # ("Invalid binary instruction opcode copy") — and AD generates
+        # exactly that psum for the cotangent of the replicated-in x.
+        # Compute stays in the model dtype inside the body.
+        out, aux = _pp_body(
+            x32.astype(x_dtype),
+            positions,
+            layers,
             block=block,
             axis=axis,
             n_micro=num_microbatches,
@@ -139,14 +156,19 @@ def pipeline_scan_composed(
             # Auto axes are GSPMD-global inside the body: the aux scalar is
             # already a full-batch value, no pmean over data needed.
             batch_axis_names=(),
-        ),
+        )
+        return out.astype(jnp.float32), aux
+
+    fn = jax.shard_map(
+        body_f32,
         mesh=mesh,
         in_specs=(P(), P(), layer_spec),
         out_specs=(P(), P()),
         axis_names={axis},
         check_vma=False,
     )
-    return fn(x, positions, stacked_layers)
+    out, aux = fn(x.astype(jnp.float32), positions, stacked_layers)
+    return out.astype(x_dtype), aux
 
 
 def pipeline_scan(

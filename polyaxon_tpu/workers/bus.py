@@ -53,8 +53,12 @@ class TaskBus:
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
         self._crons: List[Tuple[str, float, Dict[str, Any]]] = []
-        #: Errors raised by tasks (task name, exception, traceback string).
-        self.errors: List[Tuple[str, BaseException, str]] = []
+        #: Recent task failures (name, exception, traceback string) — a
+        #: bounded window, NOT a full history: a cron failing every wave in
+        #: a long-lived service would otherwise leak tracebacks forever.
+        from collections import deque
+
+        self.errors: "deque[Tuple[str, BaseException, str]]" = deque(maxlen=200)
 
     # -- registration ---------------------------------------------------------
     def register(self, name: str, fn: Optional[Callable[..., Any]] = None):

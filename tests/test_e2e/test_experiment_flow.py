@@ -239,3 +239,43 @@ class TestZombieDetection:
             assert done.status == S.SUCCEEDED
         finally:
             orch.stop()
+
+
+@pytest.mark.e2e
+class TestComposedStrategyGang:
+    def test_lm_train_under_pp_tp_three_axis_gang(self, orch):
+        """The 3-axis composition through the FULL stack: spec → plan →
+        worker → hybrid template — not just the in-process numerics."""
+        run = orch.submit(
+            {
+                "kind": "experiment",
+                "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:lm_train"},
+                "declarations": {
+                    "steps": 3,
+                    "batch": 8,
+                    "seq": 16,
+                    "d_model": 32,
+                    "n_layers": 2,
+                    "n_heads": 4,
+                    "head_dim": 8,
+                    "d_ff": 64,
+                    "vocab_size": 64,
+                },
+                "environment": {
+                    "seed": 3,
+                    "topology": {
+                        "accelerator": "cpu",
+                        "num_devices": 8,
+                        "num_hosts": 1,
+                        "mesh": {"axes": {"data": 2, "tensor": 2, "pipeline": 2}},
+                        "strategy": "pp_tp",
+                    },
+                },
+            },
+            name="pp-tp-e2e",
+        )
+        done = orch.wait(run.id, timeout=300)
+        logs = "\n".join(l["line"] for l in orch.registry.get_logs(run.id))
+        assert done.status == S.SUCCEEDED, logs
+        assert "strategy=pp_tp" in logs
+        assert done.last_metric.get("tokens_per_s", 0) > 0

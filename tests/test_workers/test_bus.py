@@ -65,7 +65,7 @@ class TestTaskBus:
         bus.send("t.d")
         bus.pump()
         assert len(attempts) == 3
-        assert bus.errors == []
+        assert list(bus.errors) == []
 
     def test_retry_exhaustion(self):
         bus = TaskBus(time_scale=0, max_retries=2)
