@@ -175,16 +175,20 @@ class RemoteClient:
 class LocalClient:
     """Embedded-orchestrator backend (creates it lazily, pumps eagerly)."""
 
-    def __init__(self, base_dir: str) -> None:
+    def __init__(self, base_dir: str, recover: bool = False) -> None:
         from polyaxon_tpu.api.app import run_to_dict
         from polyaxon_tpu.orchestrator import Orchestrator
 
         self._to_dict = run_to_dict
         self.orch = Orchestrator(Path(base_dir).expanduser())
         # Each CLI invocation is a fresh control plane over the same durable
-        # registry: re-enqueue dispatch tasks the previous process took with
-        # it (e.g. a clone created by `resume` then driven by `logs -f`).
-        self.orch.recover()
+        # registry. Commands that intentionally drive work (run/stop/clones,
+        # logs --follow) re-enqueue dispatch tasks the previous process took
+        # with it; pure reads (ps/get/statuses/...) must NOT — recovery has
+        # write side effects (re-dispatch, process-row cleanup) that would
+        # turn `ps` into an unmonitored gang launcher.
+        if recover:
+            self.orch.recover()
 
     def submit(self, spec, project, name, tags):
         run = self.orch.submit(spec, project=project, name=name, tags=tags)
@@ -328,10 +332,25 @@ class LocalClient:
         self.orch.stop()
 
 
+#: The clone strategies (reference CloningStrategy, SURVEY §5) — one list
+#: shared by the parser, the dispatch, and the recovery gate so a new
+#: strategy can't ship with recovery silently missing.
+CLONE_STRATEGIES = ("restart", "resume", "copy")
+
+#: Local-mode commands that drive the task graph and therefore recover
+#: stranded work on startup. `logs --follow` is included: following a run
+#: started by a previous invocation requires reattaching its gang to make
+#: progress (each CLI invocation is a fresh control plane).
+_DRIVING_COMMANDS = {"run", "stop", *CLONE_STRATEGIES}
+
+
 def _client(args):
     if args.host:
         return RemoteClient(args.host, token=getattr(args, "token", None))
-    return LocalClient(args.base_dir)
+    recover = args.command in _DRIVING_COMMANDS or (
+        args.command == "logs" and getattr(args, "follow", False)
+    )
+    return LocalClient(args.base_dir, recover=recover)
 
 
 def _watch(client, run_id: int, poll: float = 0.5) -> str:
@@ -468,7 +487,7 @@ def main(argv=None) -> int:
     p_stop = sub.add_parser("stop", help="stop a run")
     p_stop.add_argument("run_id")
 
-    for strategy in ("restart", "resume", "copy"):
+    for strategy in CLONE_STRATEGIES:
         p = sub.add_parser(strategy, help=f"{strategy} a run as a clone")
         p.add_argument("run_id")
 
@@ -629,7 +648,7 @@ def main(argv=None) -> int:
             client.stop(args.run_id)
             print("stopped", file=sys.stderr)
             return 0
-        if args.command in ("restart", "resume", "copy"):
+        if args.command in CLONE_STRATEGIES:
             clone = client.clone(args.run_id, args.command)
             print(json.dumps(clone, indent=2, default=str))
             return 0

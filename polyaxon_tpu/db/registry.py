@@ -707,6 +707,13 @@ class RunRegistry:
         slices. All-or-nothing: a partial fit claims nothing.
         """
         num_slices = max(1, int(num_slices))
+        if chips % num_slices:
+            # Flooring would silently under-claim capacity; the compiler
+            # always passes a divisible total, so a remainder is a caller bug.
+            raise RegistryError(
+                f"chips ({chips}) must divide evenly across num_slices "
+                f"({num_slices})"
+            )
         per_slice = max(1, chips // num_slices)
         with self._lock, self._conn() as conn:
             conn.execute("BEGIN IMMEDIATE")

@@ -345,8 +345,36 @@ class Orchestrator:
     def start(self) -> None:
         self.refresh_lease()
         self.recover()
-        self.bus.register(CronTasks.LEASE_REFRESH, self.refresh_lease)
-        self.bus.add_cron(CronTasks.LEASE_REFRESH, self.LEASE_INTERVAL)
+        # The lease refresh runs on its own timer thread, NOT as a bus
+        # cron: a long blocking bus task (e.g. a multi-GB artifact sync)
+        # would starve a cron-based refresh past LEASE_TTL, making a
+        # concurrent CLI invocation misread the live service as dead and
+        # steal its gangs.
+        import threading
+
+        self._lease_stop = threading.Event()
+
+        def _lease_loop() -> None:
+            while not self._lease_stop.wait(self.LEASE_INTERVAL):
+                try:
+                    self.refresh_lease()
+                except Exception:  # registry closed mid-shutdown
+                    import logging
+
+                    logging.getLogger(__name__).exception("lease refresh failed")
+            # Release on the way out, not only in stop(): if stop()'s join
+            # timed out while a refresh was blocked on the DB, that refresh
+            # would otherwise resurrect the lease AFTER stop() deleted it,
+            # stalling the next control plane's recovery for a full TTL.
+            try:
+                self._release_lease()
+            except Exception:
+                pass
+
+        self._lease_thread = threading.Thread(
+            target=_lease_loop, name="lease-refresh", daemon=True
+        )
+        self._lease_thread.start()
         self.bus.add_cron(CronTasks.HEARTBEAT_CHECK, self._heartbeat_check_interval)
         self.bus.add_cron(
             CronTasks.CLEAN_ACTIVITY,
@@ -355,12 +383,21 @@ class Orchestrator:
         )
         self.bus.start()
 
-    def stop(self) -> None:
+    def _release_lease(self) -> None:
+        """Delete the lease iff this control plane owns it (idempotent)."""
         lease = self.registry.get_option(self.LEASE_KEY)
         if lease and lease.get("owner") == self._lease_id:
-            # Clean shutdown releases the lease so the next control plane
-            # recovers immediately instead of waiting out the TTL.
             self.registry.delete_option(self.LEASE_KEY)
+
+    def stop(self) -> None:
+        stopper = getattr(self, "_lease_stop", None)
+        if stopper is not None:
+            stopper.set()
+            self._lease_thread.join(timeout=2.0)
+        # Clean shutdown releases the lease so the next control plane
+        # recovers immediately instead of waiting out the TTL. (If the
+        # join above timed out, the lease thread re-releases on exit.)
+        self._release_lease()
         self.bus.stop()
         for run_id in list(self.ctx.gangs):
             handle = self.ctx.gangs.pop(run_id)

@@ -30,6 +30,10 @@ from polyaxon_tpu.workers import CronTasks, SchedulerTasks, TaskBus
 
 logger = logging.getLogger(__name__)
 
+#: Self-managed retry budget for offloaded artifact uploads (the bus Retry
+#: budget can't cover them — they run off the bus thread).
+ARTIFACT_SYNC_MAX_ATTEMPTS = 20
+
 
 @dataclass
 class SchedulerContext:
@@ -126,9 +130,17 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
         # k8s-delegated placement; here an explicit slice inventory). No
         # inventory for the family → admission is off; otherwise the run
         # holds a whole slice from SCHEDULED until terminal.
-        device = reg.acquire_device(
-            run_id, plan.accelerator, plan.num_devices, num_slices=plan.num_slices
-        )
+        try:
+            device = reg.acquire_device(
+                run_id, plan.accelerator, plan.num_devices, num_slices=plan.num_slices
+            )
+        except PolyaxonTPUError as e:
+            # E.g. a chips/num_slices mismatch: a caller bug, but it must
+            # surface on the run (FAILED) — escaping the task would strand
+            # the run in CREATED forever.
+            reg.set_status(run_id, S.FAILED, message=f"admission failed: {e}")
+            _record_done(ctx, run_id, S.FAILED)
+            return
         if device is None:
             # Queue at admission: the QUEUED re-dispatch cron and the
             # release hook both retry this run later.
@@ -318,30 +330,68 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
         _record_done(ctx, run_id, S.STOPPED, actor=actor)
 
     @bus.register(SchedulerTasks.ARTIFACTS_SYNC)
-    def artifacts_sync(run_id: int) -> None:
+    def artifacts_sync(run_id: int, _attempt: int = 0) -> None:
         """Upload a finished run's durable subdirs to the artifact store.
 
         Parity: reference outputs/log collection into its stores
         (``stores/managers/base.py:11-40``); here checkpoint shipping is
-        first-class too.  Transient store failures ride the bus Retry
-        budget — a flaky gsutil call must not silently drop a checkpoint.
+        first-class too.  The upload itself is offloaded off the bus
+        thread (multi-GB gsutil trees must not head-of-line-block gang
+        monitors/heartbeats/stop requests); transient store failures
+        re-send the task with a bounded attempt counter — a flaky gsutil
+        call must not silently drop a checkpoint.
         """
         from polyaxon_tpu.stores import sync_run_up
-        from polyaxon_tpu.workers import Retry
 
         store = ctx.artifact_store
         if store is None:
             return
         run = reg.get_run(run_id)
         paths = ctx.layout.run_paths(run.uuid)
-        try:
-            n = sync_run_up(store, paths, run.uuid)
-        except Exception:
-            logger.exception("Artifact sync failed for run %s", run_id)
-            raise Retry(countdown=5.0)
-        ctx.auditor.record(
-            EventTypes.EXPERIMENT_ARTIFACTS_SYNCED, run_id=run_id, files=n
-        )
+
+        def _upload() -> None:
+            # Failures must stay operator-visible even though the retry is
+            # self-managed: mirror the bus's retry/dead_letter counters and
+            # error window (an upload dead-letter is a LOST checkpoint).
+            import traceback as _tb
+
+            task_name = SchedulerTasks.ARTIFACTS_SYNC
+            try:
+                n = sync_run_up(store, paths, run.uuid)
+            except Exception as e:
+                if _attempt + 1 > ARTIFACT_SYNC_MAX_ATTEMPTS:
+                    logger.exception(
+                        "Artifact sync for run %s dead-lettered after %d attempts",
+                        run_id,
+                        _attempt + 1,
+                    )
+                    if bus.stats is not None:
+                        bus.stats.incr(f"tasks.{task_name}.dead_letter")
+                    bus.errors.append(
+                        (
+                            task_name,
+                            e,
+                            f"artifact sync for run {run_id} dead-lettered after "
+                            f"{_attempt + 1} attempts\n{_tb.format_exc()}",
+                        )
+                    )
+                    return
+                logger.exception(
+                    "Artifact sync failed for run %s (attempt %d)", run_id, _attempt + 1
+                )
+                if bus.stats is not None:
+                    bus.stats.incr(f"tasks.{task_name}.retry")
+                bus.send(
+                    task_name,
+                    {"run_id": run_id, "_attempt": _attempt + 1},
+                    countdown=5.0,
+                )
+                return
+            ctx.auditor.record(
+                EventTypes.EXPERIMENT_ARTIFACTS_SYNCED, run_id=run_id, files=n
+            )
+
+        bus.offload(_upload, name=f"artifacts-sync-{run_id}")
 
     @bus.register(SchedulerTasks.ADMISSION_CHECK)
     def admission_check() -> None:

@@ -59,6 +59,8 @@ class TaskBus:
         from collections import deque
 
         self.errors: "deque[Tuple[str, BaseException, str]]" = deque(maxlen=200)
+        #: In-flight offloaded threads (service mode); stop() joins them.
+        self._offloaded: List[threading.Thread] = []
 
     # -- registration ---------------------------------------------------------
     def register(self, name: str, fn: Optional[Callable[..., Any]] = None):
@@ -173,6 +175,35 @@ class TaskBus:
         with self._lock:
             return len(self._queue)
 
+    # -- heavy-task offload ----------------------------------------------------
+    def offload(self, fn: Callable[[], Any], *, name: str = "offload") -> None:
+        """Run ``fn`` without head-of-line-blocking the bus.
+
+        On the service thread, ``fn`` moves to a worker thread so long IO
+        (multi-GB artifact uploads) can't starve gang monitors, heartbeat
+        checks, or stop requests queued behind it.  Anywhere else (eager
+        ``pump()`` in tests, direct calls) it runs inline, keeping the
+        task graph synchronous and deterministic.  ``fn`` must do its own
+        failure handling — typically by re-sending its task with a bounded
+        attempt counter — because a Retry raised on a worker thread has no
+        bus frame to catch it.
+        """
+        if self._thread is not None and threading.current_thread() is self._thread:
+            def _guarded() -> None:
+                try:
+                    fn()
+                except Exception as e:  # noqa: BLE001 — mirror _run_one
+                    logger.exception("Offloaded %s failed", name)
+                    self.errors.append((name, e, traceback.format_exc()))
+
+            t = threading.Thread(target=_guarded, name=f"bus-{name}", daemon=True)
+            with self._lock:
+                self._offloaded = [x for x in self._offloaded if x.is_alive()]
+                self._offloaded.append(t)
+            t.start()
+        else:
+            fn()
+
     # -- service mode ---------------------------------------------------------
     def start(self) -> None:
         if self._thread is not None:
@@ -188,6 +219,13 @@ class TaskBus:
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        with self._lock:
+            offloaded, self._offloaded = self._offloaded, []
+        # One shared deadline across every in-flight offload — N stuck
+        # uploads must not turn shutdown into N * timeout.
+        deadline = time.monotonic() + timeout
+        for t in offloaded:
+            t.join(max(0.0, deadline - time.monotonic()))
 
     def _loop(self) -> None:
         while True:

@@ -38,6 +38,5 @@ class PipelineTasks:
 
 class CronTasks:
     HEARTBEAT_CHECK = "crons.heartbeat_check"
-    LEASE_REFRESH = "crons.lease_refresh"
     STATUS_RECONCILE = "crons.status_reconcile"
     CLEAN_ACTIVITY = "crons.clean_activity"

@@ -43,6 +43,40 @@ class TestCLI:
         assert rc == 0
         assert "created" in capsys.readouterr().out
 
+    def test_read_commands_do_not_recover(self, tmp_path, capsys, monkeypatch):
+        """`ps`/`get`/`logs` are pure reads: they must not run recovery
+        (which has write side effects — re-dispatch, process-row cleanup —
+        that would turn a `logs` call into an unmonitored gang launcher).
+        Work-driving commands (`run`, `stop`) still recover."""
+        import yaml as _yaml
+
+        from polyaxon_tpu.orchestrator import Orchestrator
+
+        calls = []
+        real_recover = Orchestrator.recover
+
+        def counting_recover(self):
+            calls.append(1)
+            return real_recover(self)
+
+        monkeypatch.setattr(Orchestrator, "recover", counting_recover)
+        spec_file = tmp_path / "spec.yml"
+        spec_file.write_text(_yaml.safe_dump(SPEC))
+        base = str(tmp_path / "home")
+
+        assert main(["--base-dir", base, "run", "-f", str(spec_file), "--watch"]) == 0
+        assert len(calls) == 1  # run drives work → recovers
+        capsys.readouterr()
+
+        for cmd in (["ps"], ["get", "1"], ["statuses", "1"], ["logs", "1"]):
+            assert main(["--base-dir", base, *cmd]) == 0
+            capsys.readouterr()
+        assert len(calls) == 1  # no read command recovered
+
+        assert main(["--base-dir", base, "stop", "1"]) == 0
+        capsys.readouterr()
+        assert len(calls) == 2  # stop drives work → recovers
+
     def test_run_failing_returns_nonzero(self, tmp_path, capsys):
         spec = dict(SPEC, run={"entrypoint": "polyaxon_tpu.builtins.trainers:failing"})
         spec_file = tmp_path / "spec.yml"

@@ -57,3 +57,15 @@ class TestMultiSliceAdmission:
         reg.register_device("s0", "v5e-8", 8)
         claimed = reg.acquire_device(run.id, "v5e-8", 8)
         assert claimed["name"] == "s0" and "slices" not in claimed
+
+    def test_indivisible_chip_count_rejected(self, reg):
+        """Flooring chips//num_slices would silently under-claim capacity;
+        a non-divisible total is a caller bug and must raise."""
+        from polyaxon_tpu.db.registry import RegistryError
+
+        run = reg.create_run(SPEC)
+        reg.register_device("s0", "v5e-16", 16)
+        reg.register_device("s1", "v5e-16", 16)
+        with pytest.raises(RegistryError):
+            reg.acquire_device(run.id, "v5e-16", 33, num_slices=2)
+        assert all(d["run_id"] is None for d in reg.list_devices())

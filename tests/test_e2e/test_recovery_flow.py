@@ -174,6 +174,29 @@ class TestRecoveryFlow:
         finally:
             o2.stop()
 
+    def test_lease_refresh_survives_blocked_bus(self, tmp_path, monkeypatch):
+        """The lease refresh rides a dedicated timer thread, so a long
+        blocking bus task (e.g. a multi-GB artifact sync) can't starve it
+        past LEASE_TTL and let a concurrent CLI steal live gangs."""
+        import threading
+        import time as _time
+
+        monkeypatch.setattr(Orchestrator, "LEASE_INTERVAL", 0.05)
+        o = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
+        release = threading.Event()
+        o.bus.register("test.block", lambda: release.wait(timeout=10))
+        try:
+            o.start()
+            o.bus.send("test.block", {})
+            _time.sleep(0.5)  # bus thread is blocked for all of this window
+            lease = o.registry.get_option(o.LEASE_KEY)
+            assert _time.time() - float(lease["at"]) < 0.3, (
+                "lease went stale while a bus task blocked"
+            )
+        finally:
+            release.set()
+            o.stop()
+
     def test_recover_noop_on_clean_state(self, tmp_path):
         o = Orchestrator(tmp_path / "plat", monitor_interval=0.1)
         try:

@@ -104,6 +104,78 @@ class TestTaskBus:
             bus.stop()
         assert seen == [1]
 
+    def test_offload_runs_inline_in_eager_mode(self):
+        # Tests pump synchronously; offload must not introduce threads there.
+        import threading
+
+        bus = TaskBus()
+        ran_on = []
+
+        @bus.register("t.off")
+        def task():
+            bus.offload(lambda: ran_on.append(threading.current_thread()))
+
+        bus.send("t.off")
+        bus.pump()
+        assert ran_on == [threading.main_thread()]
+
+    def test_offload_moves_off_bus_thread_in_service_mode(self):
+        """A long offloaded upload must not head-of-line-block the bus:
+        a task sent after the blocker still runs while it's in flight."""
+        import threading
+
+        bus = TaskBus()
+        release = threading.Event()
+        offload_thread = []
+        seen = []
+
+        @bus.register("t.blocker")
+        def blocker():
+            def work():
+                offload_thread.append(threading.current_thread())
+                release.wait(timeout=5)
+
+            bus.offload(work, name="slow-upload")
+
+        bus.register("t.after", lambda: seen.append(1))
+        bus.start()
+        try:
+            bus.send("t.blocker")
+            bus.send("t.after")
+            deadline = time.time() + 2
+            while not seen and time.time() < deadline:
+                time.sleep(0.01)
+            assert seen == [1]  # ran while the offloaded work still blocks
+            assert offload_thread and offload_thread[0] is not threading.main_thread()
+            release.set()
+        finally:
+            release.set()
+            bus.stop()
+        # stop() joined the offloaded thread.
+        assert not offload_thread[0].is_alive()
+
+    def test_offload_failure_recorded_not_raised(self):
+        import threading
+
+        bus = TaskBus()
+
+        @bus.register("t.offboom")
+        def task():
+            def work():
+                raise ValueError("upload exploded")
+
+            bus.offload(work, name="boom")
+
+        bus.start()
+        try:
+            bus.send("t.offboom")
+            deadline = time.time() + 2
+            while not bus.errors and time.time() < deadline:
+                time.sleep(0.01)
+        finally:
+            bus.stop()
+        assert any(isinstance(e[1], ValueError) for e in bus.errors)
+
     def test_cron_reschedules_in_service_mode(self):
         bus = TaskBus()
         seen = []
