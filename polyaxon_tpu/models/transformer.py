@@ -70,8 +70,11 @@ class TransformerConfig:
             )
     #: "auto" = pallas flash kernel on single-device TPU, XLA attention
     #: elsewhere; "dense" forces XLA; "flash" forces the pallas kernel.
-    #: (A pallas call is a custom call GSPMD can't partition, so the flash
-    #: path is only taken when attention runs unsharded.)
+    #: (A pallas call is a custom call GSPMD can't partition, so the
+    #: unsharded flash path is only taken when attention runs on one
+    #: device.  With a ring template the value selects the RING body
+    #: instead: flash-per-block inside shard_map — sharded long context
+    #: runs the O(T_local) kernel per shard; see parallel/flash.py.)
     attention_impl: str = "auto"
 
     def scaled(self, **overrides) -> "TransformerConfig":
@@ -337,8 +340,12 @@ def forward(
         if ring_axis is not None:
             from polyaxon_tpu.parallel.ring import ring_attention_sharded
 
+            # The ring resolves its own kernel: pallas flash per block on
+            # TPU (O(T_local) memory per shard), dense blockwise elsewhere.
             attn = ring_attention_sharded(
-                q, k, v, mesh, ring_axis, batch_axes=rules.get("batch")
+                q, k, v, mesh, ring_axis,
+                batch_axes=rules.get("batch"),
+                impl=c.attention_impl,
             )
         elif use_flash:
             attn = _flash_attention(q, k, v)

@@ -1,0 +1,440 @@
+"""Pallas flash attention for the ring body: O(T_local) memory per shard.
+
+The last TPU-native mile of long-context sequence parallelism (SURVEY §5;
+the reference platform has no analogue — its compute lived in user
+containers).  ``parallel.ring`` rotates K/V blocks around a mesh axis; this
+module supplies the *per-block* kernel so the [T_local, T_local] score
+matrix never materializes either: scores live in VMEM tiles, the kernel
+streams K/V blocks through the MXU with an online-softmax accumulator, and
+each block call returns ``(o, lse)`` so the ring loop can merge blocks with
+the standard log-sum-exp combine.
+
+Differentiation is handled at the *ring* level (``ring_flash_attention``)
+with a custom VJP — the canonical ring-attention backward: a second ring
+pass rotates ``(k, v, dk, dv)`` together so each block's gradient
+accumulates on whichever device currently holds it and arrives home after a
+full cycle, while ``dq`` accumulates locally.  Per-block gradients are two
+pallas kernels (dq-pass and dk/dv-pass) using the saved ``lse`` and the
+``delta = rowsum(do * o)`` trick, so backward memory is O(T_local) too.
+
+Off-TPU the kernels run in pallas interpret mode — numerically exact and
+mesh-compatible, which is how the 8-device virtual-CPU suite verifies ring
++flash numerics and how ``dryrun_multichip`` validates the sharded path.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG_BIG = -1e30  # mask value; finite so masked rows stay NaN-free
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover - no backend at all
+        return False
+
+
+def _pick_block(t: int, want: int) -> int:
+    """Largest divisor of ``t`` that is <= want (prefers powers of two)."""
+    b = min(want, t)
+    while t % b:
+        b -= 1
+    return max(b, 1)
+
+
+# ---------------------------------------------------------------------------
+# Forward block kernel: q[BH,Tq,d] x k,v[BH,Tk,d] -> o[BH,Tq,d] f32, lse f32
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
+    *, sm_scale, causal, bq, bk, nk,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    # Causal: blocks entirely above the diagonal contribute nothing.
+    run = (ki * bk <= qi * bq + bq - 1) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * sm_scale
+        if causal:
+            rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            keep = rows >= cols
+            s = jnp.where(keep, s, _NEG_BIG)
+        m_prev = m_scr[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)  # m_prev=-inf -> 0
+        p = jnp.exp(s - m_cur)
+        if causal:
+            p = jnp.where(keep, p, 0.0)  # rows masked-so-far: m_cur=_NEG_BIG
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = lax.dot_general(
+            p.astype(v_ref.dtype),
+            v_ref[0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc[...] = acc[...] * alpha + pv
+        m_scr[...] = jnp.broadcast_to(m_cur, m_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _write():
+        l = l_scr[:, :1]
+        safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc[...] / safe).astype(o_ref.dtype)
+        m = m_scr[:, 0]
+        lse_ref[0] = jnp.where(
+            l[:, 0] > 0, m + jnp.log(jnp.maximum(l[:, 0], 1e-38)), -jnp.inf
+        )
+
+
+def flash_block_fwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    sm_scale: float,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """One attention block: returns ``(o, lse)`` with o float32-normalized.
+
+    q: [BH, Tq, d]; k, v: [BH, Tk, d].  ``causal`` masks assuming q and k
+    share a global offset (the ring's diagonal block).
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    BH, Tq, d = q.shape
+    Tk = k.shape[1]
+    bq = _pick_block(Tq, block_q)
+    bk = _pick_block(Tk, block_k)
+    nq, nk = Tq // bq, Tk // bk
+    from jax.experimental.pallas import tpu as pltpu
+
+    scratch = [
+        pltpu.VMEM((bq, d), jnp.float32),
+        pltpu.VMEM((bq, 128), jnp.float32),
+        pltpu.VMEM((bq, 128), jnp.float32),
+    ]
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nk=nk
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, d), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Tq), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward block kernels (flash-2 style, using saved lse and delta)
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, sm_scale, causal, bq, bk, nk,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    run = (ki * bk <= qi * bq + bq - 1) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * sm_scale
+        lse = lse_ref[0][:, None]
+        p = jnp.exp(s - lse)
+        if causal:
+            rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            p = jnp.where(rows >= cols, p, 0.0)
+        dp = lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, None]) * sm_scale
+        dq_acc[...] += lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _write():
+        dq_ref[0] = dq_acc[...]
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, sm_scale, causal, bq, bk, nq,
+):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = (ki * bk <= qi * bq + bq - 1) if causal else (qi >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * sm_scale
+        lse = lse_ref[0][:, None]
+        p = jnp.exp(s - lse)
+        if causal:
+            rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            p = jnp.where(rows >= cols, p, 0.0)
+        do = do_ref[0]
+        dv_acc[...] += lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, None]) * sm_scale
+        dk_acc[...] += lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _write():
+        dk_ref[0] = dk_acc[...]
+        dv_ref[0] = dv_acc[...]
+
+
+def flash_block_bwd(
+    q, k, v, do, lse, delta, *, causal, sm_scale,
+    block_q: int = 256, block_k: int = 256, interpret: bool | None = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Gradients for one block pair: returns ``(dq, dk, dv)`` float32."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    BH, Tq, d = q.shape
+    Tk = k.shape[1]
+    bq = _pick_block(Tq, block_q)
+    bk = _pick_block(Tk, block_k)
+    nq, nk = Tq // bq, Tk // bk
+    from jax.experimental.pallas import tpu as pltpu
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nk=nk
+        ),
+        grid=(BH, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tq, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)[0]
+
+    # dk/dv pass: grid iterates q blocks innermost for each k block.
+    qT_spec = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
+    kT_spec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
+    rowT_spec = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nq=nq
+        ),
+        grid=(BH, nk, nq),
+        in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
+        out_specs=[kT_spec, kT_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tk, d), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Tk, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Ring-level flash attention with custom VJP (per-shard code, runs inside
+# shard_map; cfg = (axis_name, sm_scale, block_q, block_k, interpret))
+# ---------------------------------------------------------------------------
+
+
+def _merge(o, lse, o_b, lse_b):
+    """Log-sum-exp combine of two normalized partial attentions."""
+    lse_new = jnp.logaddexp(lse, lse_b)
+    w_old = jnp.where(jnp.isneginf(lse_new), 0.0, jnp.exp(lse - lse_new))
+    w_new = jnp.where(jnp.isneginf(lse_new), 0.0, jnp.exp(lse_b - lse_new))
+    o = o * w_old[..., None] + o_b * w_new[..., None]
+    return o, lse_new
+
+
+def _hop_case(i, idx):
+    """0 = diagonal (causal), 1 = full block, 2 = skip (future keys)."""
+    return jnp.where(i == 0, 0, jnp.where(i <= idx, 1, 2))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def ring_flash_attention(cfg, q, k, v):
+    """Causal ring attention with pallas flash blocks. q/k/v: [B,Tl,H,d]."""
+    return _ring_flash_fwd(cfg, q, k, v)[0]
+
+
+def _bhd(x):
+    B, T, H, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+
+
+def _unbhd(x, B, H):
+    BH, T, d = x.shape
+    return x.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+
+
+def _ring_flash_fwd(cfg, q, k, v):
+    axis_name, sm_scale, block_q, block_k, interpret = cfg
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Tl, H, d = q.shape
+    qf, kf, vf = _bhd(q), _bhd(k), _bhd(v)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    o0 = jnp.zeros((B * H, Tl, d), jnp.float32)
+    lse0 = jnp.full((B * H, Tl), -jnp.inf, jnp.float32)
+
+    def block(causal):
+        def run(args):
+            o, lse, kc, vc = args
+            o_b, lse_b = flash_block_fwd(
+                qf, kc, vc, causal=causal, sm_scale=sm_scale,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+            )
+            return _merge(o, lse, o_b, lse_b)
+        return run
+
+    def body(i, carry):
+        o, lse, kc, vc = carry
+        o, lse = lax.switch(
+            _hop_case(i, idx),
+            [block(True), block(False), lambda a: (a[0], a[1])],
+            (o, lse, kc, vc),
+        )
+        kc, vc = lax.ppermute((kc, vc), axis_name, perm)
+        return o, lse, kc, vc
+
+    o, lse, _, _ = lax.fori_loop(0, n, body, (o0, lse0, kf, vf))
+    out = _unbhd(o, B, H).astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(cfg, res, do):
+    axis_name, sm_scale, block_q, block_k, interpret = cfg
+    q, k, v, out, lse = res
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Tl, H, d = q.shape
+    qf, kf, vf = _bhd(q), _bhd(k), _bhd(v)
+    dof = _bhd(do.astype(q.dtype))
+    of = _bhd(out)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    dq0 = jnp.zeros((B * H, Tl, d), jnp.float32)
+    dkv0 = jnp.zeros((B * H, Tl, d), jnp.float32)
+
+    def block(causal):
+        def run(args):
+            kc, vc = args
+            return flash_block_bwd(
+                qf, kc, vc, dof, lse, delta, causal=causal,
+                sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+                interpret=interpret,
+            )
+        return run
+
+    def skip(args):
+        return dq0, dkv0, dkv0
+
+    def body(i, carry):
+        dq, kc, vc, dkc, dvc = carry
+        dq_i, dk_i, dv_i = lax.switch(
+            _hop_case(i, idx), [block(True), block(False), skip], (kc, vc)
+        )
+        dq = dq + dq_i
+        # dk/dv accumulators travel WITH their k/v block: after the full
+        # cycle of n hops each block (and its gradient) is home again.
+        kc, vc, dkc, dvc = lax.ppermute(
+            (kc, vc, dkc + dk_i, dvc + dv_i), axis_name, perm
+        )
+        return dq, kc, vc, dkc, dvc
+
+    dq, _, _, dk, dv = lax.fori_loop(0, n, body, (dq0, kf, vf, dkv0, dkv0))
+    return (
+        _unbhd(dq, B, H).astype(q.dtype),
+        _unbhd(dk, B, H).astype(k.dtype),
+        _unbhd(dv, B, H).astype(v.dtype),
+    )
+
+
+ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)

@@ -192,7 +192,7 @@ def pipeline_scan(
     ``aux_fn(block_aux)`` over layers and microbatches (0.0 without aux_fn),
     which is how MoE's load-balancing loss crosses the shard_map boundary.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     n_stages = mesh.shape[axis]
@@ -235,6 +235,6 @@ def pipeline_scan(
         mesh=mesh,
         in_specs=(x_spec, pos_spec, layer_spec),
         out_specs=(x_spec, P()),
-        check_rep=False,
+        check_vma=False,
     )
     return fn(x, positions, stacked_layers)

@@ -68,17 +68,41 @@ def ring_attention_sharded(
     mesh,
     seq_axis: str,
     batch_axes: Union[str, Tuple[str, ...], None] = None,
+    impl: str = "auto",
+    block_q: int = 256,
+    block_k: int = 256,
 ) -> jax.Array:
-    """Global-view entry: q/k/v [B, T, H, d] with T sharded on ``seq_axis``."""
-    from jax.experimental.shard_map import shard_map
+    """Global-view entry: q/k/v [B, T, H, d] with T sharded on ``seq_axis``.
+
+    ``impl`` selects the per-shard body: ``"flash"`` runs the pallas flash
+    kernel per ring block (O(T_local) memory — scores never leave VMEM;
+    interpret mode off-TPU), ``"dense"`` the jnp blockwise body, ``"auto"``
+    flash on TPU and dense elsewhere.
+    """
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from polyaxon_tpu.parallel.flash import _on_tpu
+
+    if impl == "auto":
+        impl = "flash" if _on_tpu() else "dense"
+    if impl == "flash":
+        from polyaxon_tpu.parallel.flash import ring_flash_attention
+
+        d = q.shape[-1]
+        cfg = (seq_axis, d**-0.5, block_q, block_k, not _on_tpu())
+        body = partial(ring_flash_attention, cfg)
+    elif impl == "dense":
+        body = partial(_ring_attention, axis_name=seq_axis)
+    else:
+        raise ValueError(f"Unknown ring attention impl {impl!r}")
 
     spec = P(batch_axes, seq_axis, None, None)
     fn = shard_map(
-        partial(_ring_attention, axis_name=seq_axis),
+        body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )
     return fn(q, k, v)
