@@ -316,11 +316,39 @@ def forward(
     cmesh = None if (pipeline_axis and not composed) else mesh
     use_flash = _use_flash(c, mesh, ring_axis, pipeline_axis, T)
 
-    x = params["embed"].astype(c.dtype)[tokens]  # [B,T,D]
+    table = params["embed"].astype(c.dtype)
+    if cmesh is not None and cmesh.size > 1 and (
+        rules.get("vocab") or rules.get("embed")
+    ):
+        # Sharded table: express the lookup as a one-hot matmul (iota
+        # embed).  A gather's transpose is a scatter-add, and SPMD's
+        # scatter partitioner cannot place batch-sharded updates into an
+        # embed/vocab-sharded table without an involuntary full
+        # rematerialization (replicate dx, then repartition — an
+        # all-gather of [B,T,D] over the whole mesh, DCN included, every
+        # step).  The one-hot contraction instead yields partial grads
+        # that reduce-scatter into the param placement like every other
+        # matmul.  Single-device keeps the free gather.
+        onehot = jax.nn.one_hot(tokens, c.vocab_size, dtype=c.dtype)
+        x = jnp.einsum("btv,vd->btd", onehot, table)
+    else:
+        x = table[tokens]  # [B,T,D]
     x = with_logical_constraint(x, ("batch", "seq", None), rules, cmesh)
 
+    def norm_w(w):
+        # Replicate norm weights at point of use: under fsdp their embed
+        # dim is sharded over a data-like axis, and if that sharding rides
+        # into the scan's saved residual, the backward multiplies a
+        # batch-sharded cotangent with an embed-sharded [1,1,D] tensor —
+        # SPMD resolves that with an involuntary full rematerialization
+        # (replicate-then-repartition of the whole activation, every
+        # layer).  An explicit replicate of D floats is noise and keeps
+        # the residual conflict-free; the weight GRAD still reduces into
+        # the sharded param placement.
+        return with_logical_constraint(w, (None,), rules, cmesh)
+
     def block(x, pos, layer):
-        h = _rmsnorm(x, layer["attn_norm"])
+        h = _rmsnorm(x, norm_w(layer["attn_norm"]))
         q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(h.dtype))
         k = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(h.dtype))
         v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(h.dtype))
@@ -360,7 +388,7 @@ def forward(
         attn = checkpoint_name(attn, "attn_out")
         x = x + jnp.einsum("bthk,hkd->btd", attn, layer["wo"].astype(h.dtype))
 
-        h = _rmsnorm(x, layer["mlp_norm"])
+        h = _rmsnorm(x, norm_w(layer["mlp_norm"]))
         if c.n_experts:
             y, gates, idx = _moe_mlp(h, layer, c, rules, cmesh)
             x = x + y
@@ -444,7 +472,7 @@ def forward(
         if c.n_experts:
             aux = scan_aux
 
-    x = _rmsnorm(x, params["final_norm"])
+    x = _rmsnorm(x, norm_w(params["final_norm"]))
     logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(x.dtype))
     logits = with_logical_constraint(logits, ("batch", "seq", None), rules, cmesh)
     if c.n_experts and aux is not None:
