@@ -505,6 +505,27 @@ def main(argv=None) -> int:
     p_dev_rm = dev_sub.add_parser("remove", help="drop a slice")
     p_dev_rm.add_argument("name")
 
+    p_pool = sub.add_parser(
+        "pools", help="provision/teardown TPU-VM slices (local mode, gcloud)"
+    )
+    pool_sub = p_pool.add_subparsers(dest="pools_command", required=True)
+    p_pool_up = pool_sub.add_parser(
+        "provision", help="create N slices, register them for admission + ssh"
+    )
+    p_pool_up.add_argument("prefix", help="slice name prefix ({prefix}-{i})")
+    p_pool_up.add_argument("--count", type=int, default=1)
+    p_pool_up.add_argument(
+        "--type", required=True, dest="accelerator_type",
+        help="accelerator type, e.g. v5litepod-16",
+    )
+    p_pool_up.add_argument("--version", help="tpu-vm image (default from conf)")
+    p_pool_up.add_argument("--preemptible", action="store_true")
+    pool_sub.add_parser("list", help="management-plane view joined with admission")
+    p_pool_down = pool_sub.add_parser(
+        "teardown", help="delete slices and unregister them"
+    )
+    p_pool_down.add_argument("names", nargs="+")
+
     p_data = sub.add_parser("data", help="store-resident datasets (local mode)")
     data_sub = p_data.add_subparsers(dest="data_command", required=True)
     data_sub.add_parser("ls", help="list registered datasets")
@@ -756,6 +777,57 @@ def main(argv=None) -> int:
             elif args.users_command == "remove":
                 client.remove_user(args.username)
                 print("removed", file=sys.stderr)
+            return 0
+        if args.command == "pools":
+            if not isinstance(client, LocalClient):
+                raise SystemExit(
+                    "pools commands run in local mode (gcloud + registry access)"
+                )
+            from polyaxon_tpu.spawner.provision import TPUPool, TPUVMProvisioner
+
+            conf = client.orch.conf
+            zone = conf.get("provision.zone")
+            if not zone:
+                raise SystemExit(
+                    "set provision.zone first: polyaxon-tpu config set provision.zone <zone>"
+                )
+            pool = TPUPool(
+                TPUVMProvisioner(
+                    zone=zone,
+                    gcloud_bin=conf.get("provision.gcloud_bin") or "gcloud",
+                    project=conf.get("provision.project") or None,
+                ),
+                client.orch.registry,
+                conf,
+                orchestrator=client.orch,
+            )
+            if args.pools_command == "provision":
+                infos = pool.provision(
+                    args.prefix,
+                    args.count,
+                    accelerator_type=args.accelerator_type,
+                    version=args.version or conf.get("provision.version"),
+                    preemptible=args.preemptible,
+                )
+                for info in infos:
+                    print(
+                        f"{info.name}: {info.state} {info.accelerator_type} "
+                        f"chips={info.chips} hosts={','.join(info.hosts)}"
+                    )
+            elif args.pools_command == "list":
+                fmt = "{:16}  {:14}  {:14}  {:>6}  {:>6}  {:10}  {:}"
+                print(fmt.format(
+                    "NAME", "STATE", "ACCEL", "CHIPS", "HOSTS", "HELD BY", "IPS"
+                ))
+                for row in pool.status():
+                    print(fmt.format(
+                        row["name"], row["state"], row["accelerator"],
+                        row["chips"], row["num_hosts"], str(row["run_id"] or "-"),
+                        ",".join(row["hosts"]),
+                    ))
+            elif args.pools_command == "teardown":
+                n = pool.teardown(args.names)
+                print(f"deleted {n} slice(s)", file=sys.stderr)
             return 0
         if args.command == "devices":
             if args.devices_command == "list":

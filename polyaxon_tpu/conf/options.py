@@ -82,6 +82,15 @@ _ALL = [
            "python interpreter on worker hosts"),
     Option("spawner.coordinator_port_base", int, 8476,
            "base of the 512-wide jax.distributed coordinator port range"),
+    Option("provision.zone", str, "",
+           "GCE zone for tpu-vm provisioning (e.g. us-central2-b); "
+           "'' disables the pools commands"),
+    Option("provision.project", str, "",
+           "GCP project for tpu-vm provisioning ('' = gcloud default)"),
+    Option("provision.gcloud_bin", str, "gcloud",
+           "gcloud binary (tests point this at a fake)"),
+    Option("provision.version", str, "tpu-ubuntu2204-base",
+           "tpu-vm software version for created slices"),
     Option("stores.artifacts_url", str, "",
            "durable artifact store (file:///path or gs://bucket/prefix); "
            "'' disables off-box sync"),

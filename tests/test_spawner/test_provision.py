@@ -1,0 +1,371 @@
+"""TPU-VM provisioning seam: argv builders, provisioner, pool lifecycle.
+
+The TPU analogue of the reference's pod-creation tests — its spawner
+materialized compute through a mocked k8s API
+(``/root/reference/tests/test_spawner/``); here the management plane is
+``gcloud compute tpus tpu-vm`` and the tests run against pure command
+builders and a fake runner/binary, never GCP.
+"""
+
+import json
+import os
+import stat
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from polyaxon_tpu.spawner.provision import (
+    ProvisionError,
+    TPUPool,
+    TPUVMProvisioner,
+    build_tpu_create_argv,
+    build_tpu_delete_argv,
+    build_tpu_describe_argv,
+    build_tpu_list_argv,
+    build_tpu_ssh_argv,
+    parse_accelerator_type,
+)
+
+
+class TestArgvBuilders:
+    def test_create(self):
+        argv = build_tpu_create_argv(
+            "pool-0",
+            zone="us-central2-b",
+            accelerator_type="v5litepod-16",
+            version="tpu-ubuntu2204-base",
+            project="proj",
+            preemptible=True,
+        )
+        assert argv == [
+            "gcloud", "compute", "tpus", "tpu-vm", "--project=proj",
+            "create", "pool-0", "--zone=us-central2-b",
+            "--accelerator-type=v5litepod-16",
+            "--version=tpu-ubuntu2204-base", "--format=json", "--preemptible",
+        ]
+
+    def test_describe_list_delete(self):
+        assert build_tpu_describe_argv("a", zone="z") == [
+            "gcloud", "compute", "tpus", "tpu-vm", "describe", "a",
+            "--zone=z", "--format=json",
+        ]
+        assert build_tpu_list_argv(zone="z")[-2:] == ["--zone=z", "--format=json"]
+        assert build_tpu_delete_argv("a", zone="z")[-1] == "--quiet"
+
+    def test_ssh_bootstrap(self):
+        argv = build_tpu_ssh_argv("a", "echo hi", zone="z", worker=2)
+        assert "--worker=2" in argv and "--command=echo hi" in argv
+
+    def test_custom_gcloud_bin(self):
+        argv = build_tpu_list_argv(zone="z", gcloud_bin="/tmp/fake-gcloud")
+        assert argv[0] == "/tmp/fake-gcloud"
+
+
+class TestAcceleratorParsing:
+    @pytest.mark.parametrize(
+        "accel,chips,hosts",
+        [
+            ("v2-8", 4, 1),
+            ("v3-32", 16, 4),
+            ("v4-8", 4, 1),
+            ("v5p-16", 8, 2),
+            ("v5litepod-4", 4, 1),
+            ("v5litepod-16", 16, 4),
+            ("v6e-8", 8, 2),
+        ],
+    )
+    def test_known_types(self, accel, chips, hosts):
+        got = parse_accelerator_type(accel)
+        assert got == {"chips": chips, "num_hosts": hosts}
+
+    def test_unknown_generation_raises(self):
+        with pytest.raises(ProvisionError):
+            parse_accelerator_type("v99-8")
+
+    def test_malformed_raises(self):
+        with pytest.raises(ProvisionError):
+            parse_accelerator_type("tpu")
+
+
+def _node(name, accel="v5litepod-16", state="READY", ips=("10.0.0.1", "10.0.0.2")):
+    return {
+        "name": f"projects/p/locations/z/nodes/{name}",
+        "acceleratorType": accel,
+        "state": state,
+        "networkEndpoints": [{"ipAddress": ip} for ip in ips],
+    }
+
+
+class FakeRunner:
+    """Canned gcloud: records argv, plays scripted results."""
+
+    def __init__(self):
+        self.calls = []
+        self.nodes = {}
+        self.fail_create_at = None
+
+    def __call__(self, argv):
+        self.calls.append(list(argv))
+        verb = argv[4] if not argv[4].startswith("--") else argv[5]
+        args = [a for a in argv[5:] if not a.startswith("--")]
+        if verb == "create":
+            name = args[0]
+            if self.fail_create_at is not None and len(self.nodes) >= self.fail_create_at:
+                return subprocess.CompletedProcess(
+                    argv, 1, "", "ERROR: quota exceeded for TPUS_PER_PROJECT"
+                )
+            self.nodes[name] = _node(name)
+            return subprocess.CompletedProcess(argv, 0, json.dumps(self.nodes[name]), "")
+        if verb == "describe":
+            name = args[0]
+            if name not in self.nodes:
+                return subprocess.CompletedProcess(
+                    argv, 1, "", f"ERROR: NOT_FOUND: node {name}"
+                )
+            return subprocess.CompletedProcess(argv, 0, json.dumps(self.nodes[name]), "")
+        if verb == "list":
+            return subprocess.CompletedProcess(
+                argv, 0, json.dumps(list(self.nodes.values())), ""
+            )
+        if verb == "delete":
+            name = args[0]
+            if name not in self.nodes:
+                return subprocess.CompletedProcess(
+                    argv, 1, "", f"ERROR: NOT_FOUND: node {name}"
+                )
+            del self.nodes[name]
+            return subprocess.CompletedProcess(argv, 0, "", "")
+        raise AssertionError(f"unexpected verb {verb!r} in {argv}")
+
+
+class TestProvisioner:
+    def test_create_parses_endpoints_and_chips(self):
+        runner = FakeRunner()
+        prov = TPUVMProvisioner(zone="z", runner=runner)
+        info = prov.create("s0", accelerator_type="v5litepod-16", version="v")
+        assert info.hosts == ["10.0.0.1", "10.0.0.2"]
+        assert info.chips == 16
+        assert info.num_hosts == 2  # endpoints override the planning estimate
+        assert info.state == "READY"
+
+    def test_describe_not_found_discriminated(self):
+        prov = TPUVMProvisioner(zone="z", runner=FakeRunner())
+        with pytest.raises(ProvisionError) as e:
+            prov.describe("ghost")
+        assert e.value.not_found
+
+    def test_auth_error_not_marked_not_found(self):
+        def runner(argv):
+            return subprocess.CompletedProcess(argv, 1, "", "PERMISSION_DENIED")
+
+        prov = TPUVMProvisioner(zone="z", runner=runner)
+        with pytest.raises(ProvisionError) as e:
+            prov.list()
+        assert not e.value.not_found
+
+    def test_delete_missing_ok(self):
+        prov = TPUVMProvisioner(zone="z", runner=FakeRunner())
+        assert prov.delete("ghost", missing_ok=True) is False
+
+
+class FakeConf:
+    def __init__(self):
+        self.values = {"spawner.hosts": "", "spawner.backend": "local"}
+
+    def get(self, key):
+        return self.values.get(key, "")
+
+    def set(self, key, value):
+        self.values[key] = value
+
+
+class TestPoolLifecycle:
+    def test_provision_registers_devices_and_hosts(self, tmp_registry):
+        runner = FakeRunner()
+        conf = FakeConf()
+        pool = TPUPool(
+            TPUVMProvisioner(zone="z", runner=runner), tmp_registry, conf
+        )
+        infos = pool.provision(
+            "sweep", 2, accelerator_type="v5litepod-16", version="img"
+        )
+        assert [i.name for i in infos] == ["sweep-0", "sweep-1"]
+        devices = {d["name"]: d for d in tmp_registry.list_devices()}
+        assert devices["sweep-0"]["chips"] == 16
+        assert devices["sweep-0"]["num_hosts"] == 2
+        # hosts dedupe: the fake hands every node the same IPs, so the
+        # pool records each address once, in slice order
+        assert conf.values["spawner.hosts"] == "10.0.0.1,10.0.0.2"
+        assert conf.values["spawner.backend"] == "ssh"
+
+    def test_mid_pool_failure_rolls_back_created_slices(self, tmp_registry):
+        runner = FakeRunner()
+        runner.fail_create_at = 1  # second create hits quota
+        conf = FakeConf()
+        pool = TPUPool(
+            TPUVMProvisioner(zone="z", runner=runner), tmp_registry, conf
+        )
+        with pytest.raises(ProvisionError, match="quota"):
+            pool.provision("sweep", 2, accelerator_type="v5litepod-16", version="i")
+        assert runner.nodes == {}  # slice 0 was deleted again
+        assert tmp_registry.list_devices() == []
+        assert conf.values["spawner.hosts"] == ""
+
+    def test_provision_routes_registration_through_orchestrator(self, tmp_registry):
+        """With an orchestrator attached, registration must go through its
+        register_device (admission re-kick + audit), not the raw registry."""
+
+        class StubOrch:
+            def __init__(self):
+                self.registered = []
+
+            def register_device(self, name, accelerator, chips, num_hosts):
+                self.registered.append((name, accelerator, chips, num_hosts))
+
+        orch = StubOrch()
+        pool = TPUPool(
+            TPUVMProvisioner(zone="z", runner=FakeRunner()),
+            tmp_registry,
+            FakeConf(),
+            orchestrator=orch,
+        )
+        pool.provision("sweep", 1, accelerator_type="v5litepod-16", version="i")
+        assert orch.registered == [("sweep-0", "v5litepod-16", 16, 2)]
+
+    def test_teardown_persists_hosts_on_midloop_failure(self, tmp_registry):
+        """A gcloud failure halfway through teardown must not leave the
+        deleted slice's IPs in spawner.hosts."""
+        runner = FakeRunner()
+        conf = FakeConf()
+        pool = TPUPool(TPUVMProvisioner(zone="z", runner=runner), tmp_registry, conf)
+        pool.provision("sweep", 1, accelerator_type="v5litepod-16", version="i")
+
+        real_run = runner.__call__
+
+        def failing(argv):
+            if "describe" in argv and "boom" in argv:
+                return subprocess.CompletedProcess(argv, 1, "", "PERMISSION_DENIED")
+            return real_run(argv)
+
+        pool.provisioner._run = failing
+        with pytest.raises(ProvisionError):
+            pool.teardown(["sweep-0", "boom"])
+        assert conf.values["spawner.hosts"] == ""  # sweep-0's IPs pruned
+        assert conf.values["spawner.backend"] == "local"
+
+    def test_teardown_removes_everything(self, tmp_registry):
+        runner = FakeRunner()
+        conf = FakeConf()
+        pool = TPUPool(
+            TPUVMProvisioner(zone="z", runner=runner), tmp_registry, conf
+        )
+        pool.provision("sweep", 1, accelerator_type="v5litepod-16", version="i")
+        assert pool.teardown(["sweep-0"]) == 1
+        assert runner.nodes == {}
+        assert tmp_registry.list_devices() == []
+        assert conf.values["spawner.hosts"] == ""
+
+    def test_teardown_of_unprovisioned_name_still_unregisters(self, tmp_registry):
+        runner = FakeRunner()
+        conf = FakeConf()
+        tmp_registry.register_device("stale", accelerator="v5e", chips=4, num_hosts=1)
+        pool = TPUPool(
+            TPUVMProvisioner(zone="z", runner=runner), tmp_registry, conf
+        )
+        assert pool.teardown(["stale"]) == 0
+        assert tmp_registry.list_devices() == []
+
+    def test_status_joins_management_and_admission_views(self, tmp_registry):
+        runner = FakeRunner()
+        conf = FakeConf()
+        pool = TPUPool(
+            TPUVMProvisioner(zone="z", runner=runner), tmp_registry, conf
+        )
+        pool.provision("sweep", 1, accelerator_type="v5litepod-16", version="i")
+        tmp_registry.register_device("ghost", accelerator="v4-8", chips=4, num_hosts=1)
+        rows = {r["name"]: r for r in pool.status()}
+        assert rows["sweep-0"]["registered"] and rows["sweep-0"]["state"] == "READY"
+        assert rows["ghost"]["state"] == "UNPROVISIONED"
+
+
+FAKE_GCLOUD = r"""#!/usr/bin/env python3
+import json, os, sys
+state = os.environ["FAKE_GCLOUD_STATE"]
+args = [a for a in sys.argv[1:] if not a.startswith("--")]
+verb = args[3] if len(args) > 3 else ""
+def node(name):
+    return {
+        "name": name,
+        "acceleratorType": "v5litepod-8",
+        "state": "READY",
+        "networkEndpoints": [{"ipAddress": "127.0.0.1"}, {"ipAddress": "127.0.0.2"}],
+    }
+path = lambda n: os.path.join(state, n + ".json")
+if verb == "create":
+    json.dump(node(args[4]), open(path(args[4]), "w"))
+    print(json.dumps(node(args[4])))
+elif verb == "describe":
+    if not os.path.exists(path(args[4])):
+        sys.stderr.write("NOT_FOUND\n"); sys.exit(1)
+    print(open(path(args[4])).read())
+elif verb == "list":
+    nodes = [json.load(open(os.path.join(state, f))) for f in sorted(os.listdir(state))]
+    print(json.dumps(nodes))
+elif verb == "delete":
+    if not os.path.exists(path(args[4])):
+        sys.stderr.write("NOT_FOUND\n"); sys.exit(1)
+    os.unlink(path(args[4]))
+else:
+    sys.stderr.write("bad verb %r\n" % verb); sys.exit(2)
+"""
+
+
+class TestPoolsCLI:
+    """e2e over a fake gcloud BINARY: provision -> admission rows + ssh
+    hosts + list, then teardown, all through the real CLI surface."""
+
+    @pytest.fixture()
+    def fake_gcloud(self, tmp_path, monkeypatch):
+        state = tmp_path / "gcloud-state"
+        state.mkdir()
+        binary = tmp_path / "fake-gcloud"
+        binary.write_text(FAKE_GCLOUD)
+        binary.chmod(binary.stat().st_mode | stat.S_IEXEC)
+        monkeypatch.setenv("FAKE_GCLOUD_STATE", str(state))
+        return binary
+
+    def test_provision_run_teardown(self, tmp_path, fake_gcloud, capsys):
+        from polyaxon_tpu.cli.main import main
+
+        base = str(tmp_path / "base")
+        for key, value in (
+            ("provision.zone", "us-central2-b"),
+            ("provision.gcloud_bin", str(fake_gcloud)),
+        ):
+            assert main(["--base-dir", base, "config", "set", key, value]) == 0
+        assert main(
+            ["--base-dir", base, "pools", "provision", "pool",
+             "--count", "2", "--type", "v5litepod-8"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "pool-0: READY" in out and "pool-1: READY" in out
+
+        assert main(["--base-dir", base, "pools", "list"]) == 0
+        out = capsys.readouterr().out
+        assert "pool-0" in out and "127.0.0.1" in out
+
+        assert main(["--base-dir", base, "devices", "list"]) == 0
+        out = capsys.readouterr().out
+        assert "pool-0" in out and "pool-1" in out
+
+        assert main(["--base-dir", base, "config", "list"]) == 0
+        conf_out = capsys.readouterr().out
+        assert "127.0.0.1" in conf_out  # spawner.hosts picked up the pool
+
+        assert main(
+            ["--base-dir", base, "pools", "teardown", "pool-0", "pool-1"]
+        ) == 0
+        assert main(["--base-dir", base, "pools", "list"]) == 0
+        out = capsys.readouterr().out
+        assert "pool-0" not in out
