@@ -93,6 +93,64 @@ def tensorboard(ctx: Context) -> None:
         raise RuntimeError(f"tensorboard exited {rc}")
 
 
+def jupyter(ctx: Context) -> None:
+    """Serve JupyterLab until stopped — the default ``kind: notebook``
+    entrypoint (reference ran real Jupyter: ``polypod/notebook.py:35``).
+
+    Params: ``notebook_dir`` (default: this run's outputs — writable, so
+    notebooks persist as artifacts), ``target`` (work in another run's
+    outputs instead), ``host`` (bind address, default 0.0.0.0), ``token``
+    (access token; default: a fresh random one), ``jupyter_bin`` (explicit
+    server executable — tests point this at a stub so the service plumbing
+    is verifiable without jupyter installed).
+
+    The token is generated worker-side and published by appending
+    ``?token=...`` to the dispatch-recorded service URL through the report
+    channel — the control plane never has to know it in advance.
+    """
+    import os
+    import secrets
+
+    port = _service_port(ctx)
+    host = str(ctx.get_param("host", "0.0.0.0"))
+    if ctx.get_param("notebook_dir"):
+        root = Path(str(ctx.get_param("notebook_dir")))
+    else:
+        root = _target_outputs(ctx)
+    root.mkdir(parents=True, exist_ok=True)
+    token = str(ctx.get_param("token") or secrets.token_hex(16))
+
+    jupyter_bin = ctx.get_param("jupyter_bin")
+    if jupyter_bin:
+        argv = [str(jupyter_bin)]
+    else:
+        import importlib.util
+
+        if importlib.util.find_spec("jupyterlab") is None and (
+            importlib.util.find_spec("jupyter_server") is None
+        ):
+            raise RuntimeError(
+                "jupyter is not installed on this worker — install jupyterlab "
+                "or pass a jupyter_bin param"
+            )
+        argv = [sys.executable, "-m", "jupyterlab"]
+    argv += [
+        f"--ServerApp.ip={host}",
+        f"--ServerApp.port={port}",
+        f"--ServerApp.token={token}",
+        f"--ServerApp.root_dir={root}",
+        "--ServerApp.port_retries=0",
+        "--ServerApp.allow_remote_access=True",
+        "--no-browser",
+    ]
+    if ctx.is_leader:
+        ctx.report_service(query=f"token={token}")
+    ctx.log_text(f"jupyter serving {root} on {host}:{port}")
+    rc = subprocess.call(argv, env=dict(os.environ))
+    if rc != 0:
+        raise RuntimeError(f"jupyter exited {rc}")
+
+
 def output_server(ctx: Context) -> None:
     """Serve a run's outputs dir over plain HTTP until stopped.
 

@@ -74,6 +74,17 @@ class GangWatcher:
             self.registry.add_log(run_id, event.get("line", ""), process_id=process_id)
         elif etype == "heartbeat":
             self.registry.ping_heartbeat(run_id, at=event.get("ts"))
+        elif etype == "service":
+            # A service refining its own URL (jupyter appends its token
+            # as a query string; an absolute url replaces outright).
+            url = event.get("url")
+            if not url and event.get("query"):
+                base = self.registry.get_run(run_id).service_url
+                if base:
+                    sep = "&" if "?" in base else "?"
+                    url = f"{base}{sep}{event['query']}"
+            if url:
+                self.registry.update_run(run_id, service_url=url)
         elif etype == "status":
             status = event.get("status")
             if not status:

@@ -164,16 +164,20 @@ class ServiceSpecification(BaseSpecification):
         """Run section with declarations interpolated (same contract as
         experiments — services routinely template their serving port).
 
-        A tensorboard spec with no run section gets the built-in server
-        over a target run's outputs — the reference's tensorboard plugin
-        needs only config, not a command (``polypod/tensorboard.py:32``).
+        A service spec with no run section gets its built-in entrypoint:
+        tensorboard over a target run's outputs
+        (reference ``polypod/tensorboard.py:32``) or JupyterLab for
+        notebooks (reference ``polypod/notebook.py:35``).
         """
         if self.run is None:
-            if self.kind == Kinds.TENSORBOARD:
-                return RunConfig(
-                    entrypoint="polyaxon_tpu.builtins.services:tensorboard"
-                )
-            raise ValueError(f"Service spec {self.kind!r} has no run section")
+            builtins_by_kind = {
+                Kinds.TENSORBOARD: "polyaxon_tpu.builtins.services:tensorboard",
+                Kinds.NOTEBOOK: "polyaxon_tpu.builtins.services:jupyter",
+            }
+            entrypoint = builtins_by_kind.get(self.kind)
+            if entrypoint is None:
+                raise ValueError(f"Service spec {self.kind!r} has no run section")
+            return RunConfig(entrypoint=entrypoint)
         data = self.run.model_dump()
         return RunConfig.model_validate(interpolate(data, self.declarations))
 

@@ -67,5 +67,12 @@ class Context:
         if self.reporter is not None:
             self.reporter.log(line)
 
+    def report_service(
+        self, *, url: Optional[str] = None, query: Optional[str] = None
+    ) -> None:
+        """Advertise/refine this run's service URL (see Reporter.service)."""
+        if self.reporter is not None:
+            self.reporter.service(url=url, query=query)
+
     def get_param(self, name: str, default: Any = None) -> Any:
         return self.params.get(name, default)

@@ -56,6 +56,16 @@ class Reporter:
         """Telemetry samples (cpu/rss/HBM) — streamed like metrics."""
         self._emit("resources", values=values)
 
+    def service(
+        self, *, url: Optional[str] = None, query: Optional[str] = None
+    ) -> None:
+        """Advertise (or refine) this run's service URL.
+
+        ``url`` replaces the dispatch-recorded URL outright; ``query``
+        appends a query string to it — how jupyter publishes its access
+        token without the control plane ever knowing it ahead of time."""
+        self._emit("service", url=url, query=query)
+
     def error(self, exc: BaseException) -> None:
         self._emit(
             "status",

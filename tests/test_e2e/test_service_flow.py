@@ -125,6 +125,75 @@ class TestServiceFlow:
         orch.stop_run(svc.id)
         assert orch.wait(svc.id, timeout=30).status == S.STOPPED
 
+    def test_notebook_kind_runs_jupyter_with_tokened_url(self, orch, tmp_path):
+        """kind=notebook with NO run section runs the jupyter builtin; the
+        worker-generated token is published onto the service_url through
+        the report channel.  A stub server binary stands in for jupyter
+        (the plumbing under test is the platform's, not jupyter's)."""
+        import stat
+
+        stub = tmp_path / "fake-jupyter"
+        stub.write_text(
+            "#!/usr/bin/env python3\n"
+            "import sys\n"
+            "from http.server import BaseHTTPRequestHandler, HTTPServer\n"
+            "opts = dict(a.split('=', 1) for a in sys.argv[1:] if '=' in a)\n"
+            "token = opts['--ServerApp.token']\n"
+            "class H(BaseHTTPRequestHandler):\n"
+            "    def do_GET(self):\n"
+            "        body = ('jupyter-stub root=%s token-ok=%s' % (\n"
+            "            opts['--ServerApp.root_dir'],\n"
+            "            ('token=' + token) in self.path)).encode()\n"
+            "        self.send_response(200)\n"
+            "        self.end_headers()\n"
+            "        self.wfile.write(body)\n"
+            "    def log_message(self, *a):\n"
+            "        pass\n"
+            "HTTPServer((opts['--ServerApp.ip'],\n"
+            "            int(opts['--ServerApp.port'])), H).serve_forever()\n"
+        )
+        stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+        run = orch.submit(
+            {
+                "kind": "notebook",
+                "declarations": {"jupyter_bin": str(stub), "host": "127.0.0.1"},
+                "environment": {
+                    "topology": {
+                        "accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1,
+                    }
+                },
+            },
+            name="nb",
+        )
+        body = url = None
+        for _ in range(300):
+            orch.pump(max_wait=0.1)
+            url = orch.get_run(run.id).service_url
+            if url and "token=" in url:
+                try:
+                    req = url.replace("http://", "http://", 1)
+                    with urllib.request.urlopen(req, timeout=0.5) as resp:
+                        body = resp.read().decode(errors="replace")
+                        break
+                except OSError:
+                    continue
+        assert url and "?token=" in url, (url, orch.registry.get_logs(run.id))
+        assert body and "token-ok=True" in body, body
+        # default notebook dir is the run's own outputs (writable)
+        assert orch.get_run(run.id).uuid in body
+        orch.stop_run(run.id)
+        assert orch.wait(run.id, timeout=30).status == S.STOPPED
+        assert orch.get_run(run.id).service_url is None  # dead URL cleared
+
+    def test_notebook_spec_declares_jupyter_default_entrypoint(self):
+        from polyaxon_tpu.schemas.specifications import ServiceSpecification
+
+        spec = ServiceSpecification.model_validate({"kind": "notebook"})
+        assert (
+            spec.resolved_run().entrypoint
+            == "polyaxon_tpu.builtins.services:jupyter"
+        )
+
     def test_tensorboard_kind_serves_http(self, orch):
         """kind=tensorboard with NO run section serves real tensorboard
         over the target outputs until stopped."""
