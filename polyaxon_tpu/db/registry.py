@@ -149,7 +149,15 @@ CREATE TABLE IF NOT EXISTS projects (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
     name TEXT UNIQUE NOT NULL,
     description TEXT,
+    owner TEXT,
     created_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS project_collaborators (
+    project_name TEXT NOT NULL,
+    username TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    PRIMARY KEY (project_name, username)
 );
 
 CREATE TABLE IF NOT EXISTS searches (
@@ -187,6 +195,16 @@ CREATE TABLE IF NOT EXISTS devices (
     updated_at REAL NOT NULL
 );
 CREATE INDEX IF NOT EXISTS ix_devices_family ON devices (accelerator);
+
+CREATE TABLE IF NOT EXISTS device_claims (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    device_id INTEGER NOT NULL REFERENCES devices (id) ON DELETE CASCADE,
+    run_id INTEGER NOT NULL,
+    chips INTEGER NOT NULL,
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_claims_device ON device_claims (device_id);
+CREATE INDEX IF NOT EXISTS ix_claims_run ON device_claims (run_id);
 """
 
 
@@ -289,6 +307,10 @@ class RunRegistry:
             run_cols = {r[1] for r in conn.execute("PRAGMA table_info(runs)")}
             if "service_url" not in run_cols:
                 conn.execute("ALTER TABLE runs ADD COLUMN service_url TEXT")
+            proj_cols = {r[1] for r in conn.execute("PRAGMA table_info(projects)")}
+            if "owner" not in proj_cols:
+                # Pre-ownership projects stay ownerless (= open access).
+                conn.execute("ALTER TABLE projects ADD COLUMN owner TEXT")
 
     # -- connection management ------------------------------------------------
     def _conn(self) -> sqlite3.Connection:
@@ -681,10 +703,30 @@ class RunRegistry:
         return dict(row)
 
     def list_devices(self) -> List[Dict[str, Any]]:
+        """Inventory with usage accounting: ``used_chips`` counts packed
+        claims (or the whole slice for an exclusive hold) and ``holders``
+        names every run on the row — exclusive or packed."""
         rows = self._conn().execute(
-            "SELECT * FROM devices ORDER BY accelerator, chips, name"
+            """SELECT d.*, COALESCE(SUM(c.chips), 0) AS packed_chips,
+                      GROUP_CONCAT(c.run_id) AS packed_run_ids
+               FROM devices d LEFT JOIN device_claims c ON c.device_id = d.id
+               GROUP BY d.id
+               ORDER BY d.accelerator, d.chips, d.name"""
         ).fetchall()
-        return [dict(r) for r in rows]
+        out = []
+        for r in rows:
+            d = dict(r)
+            packed = d.pop("packed_chips", 0) or 0
+            packed_ids = d.pop("packed_run_ids", None)
+            holders = (
+                [int(x) for x in packed_ids.split(",")] if packed_ids else []
+            )
+            if d.get("run_id") is not None:
+                holders = [d["run_id"]] + holders
+            d["used_chips"] = d["chips"] if d.get("run_id") is not None else packed
+            d["holders"] = holders
+            out.append(d)
+        return out
 
     def remove_device(self, name: str) -> bool:
         with self._lock, self._conn() as conn:
@@ -692,19 +734,31 @@ class RunRegistry:
         return cur.rowcount > 0
 
     def acquire_device(
-        self, run_id: int, accelerator: str, chips: int, num_slices: int = 1
+        self,
+        run_id: int,
+        accelerator: str,
+        chips: int,
+        num_slices: int = 1,
+        num_hosts: int = 1,
     ) -> Optional[Dict[str, Any]]:
-        """Claim free slice(s) of the accelerator's family totalling
-        ``chips`` chips: ``num_slices`` smallest-fit rows of ``chips /
-        num_slices`` each (a multi-slice gang spans whole slices — one
-        device row per slice).
+        """Claim capacity for a gang: whole slice(s), or a PACKED share.
+
+        Single-host single-slice gangs pack: they claim ``chips`` chips of
+        a slice through the ``device_claims`` accounting table (best fit:
+        the row with the least free space that still fits), so K small
+        trials share one big slice — the reference's bread-and-butter
+        hpsearch bin-packing (``scheduler/experiment_scheduler.py:
+        101-140``, k8s-delegated there).  Gangs spanning hosts or slices
+        still claim whole EXCLUSIVE rows — an ICI world is one
+        ``jax.distributed`` job; splitting a multi-host slice between runs
+        would interleave two coordinators on one ring.
 
         Returns the (first) claimed slice row; ``None`` when the family has
-        inventory but not enough fitting slices free (caller queues the
-        run); or ``{"unmanaged": True}`` when the family has NO registered
-        inventory at all (admission control off — every run admitted).
-        Idempotent per run: a re-dispatched start re-uses the already-held
-        slices. All-or-nothing: a partial fit claims nothing.
+        inventory but nothing fits free (caller queues the run); or
+        ``{"unmanaged": True}`` when the family has NO registered inventory
+        at all (admission control off — every run admitted).  Idempotent
+        per run: a re-dispatched start re-uses the already-held claim.
+        All-or-nothing: a partial fit claims nothing.
         """
         num_slices = max(1, int(num_slices))
         if chips % num_slices:
@@ -715,6 +769,7 @@ class RunRegistry:
                 f"({num_slices})"
             )
         per_slice = max(1, chips // num_slices)
+        packable = num_slices == 1 and int(num_hosts) <= 1
         with self._lock, self._conn() as conn:
             conn.execute("BEGIN IMMEDIATE")
             held = conn.execute(
@@ -724,19 +779,60 @@ class RunRegistry:
                 # Flagged so a duplicate dispatch knows it did NOT newly
                 # claim anything (and must not release on its failure path).
                 return {**dict(held), "already_held": True}
+            packed_held = conn.execute(
+                """SELECT d.*, c.chips AS claim_chips FROM device_claims c
+                   JOIN devices d ON d.id = c.device_id WHERE c.run_id = ?""",
+                (run_id,),
+            ).fetchone()
+            if packed_held is not None:
+                return {**dict(packed_held), "already_held": True, "packed": True}
             managed, free_clause, free_params = self._family_fit(
                 conn, accelerator, per_slice
             )
             if managed == 0:
                 return {"unmanaged": True}
+            now = time.time()
+            if packable:
+                family_clause, family_params = self._family_clause(
+                    accelerator, prefix="d."
+                )
+                row = conn.execute(
+                    f"""SELECT d.*, d.chips - COALESCE(SUM(c.chips), 0)
+                              AS free_chips
+                        FROM devices d
+                        LEFT JOIN device_claims c ON c.device_id = d.id
+                        WHERE d.run_id IS NULL AND {family_clause}
+                        GROUP BY d.id
+                        HAVING free_chips >= ?
+                        ORDER BY free_chips ASC, d.chips ASC, d.id ASC
+                        LIMIT 1""",
+                    (*family_params, per_slice),
+                ).fetchone()
+                if row is None:
+                    return None
+                conn.execute(
+                    """INSERT INTO device_claims (device_id, run_id, chips,
+                                                  created_at)
+                       VALUES (?, ?, ?, ?)""",
+                    (row["id"], run_id, per_slice, now),
+                )
+                claimed = dict(row)
+                claimed.pop("free_chips", None)
+                return {
+                    **claimed,
+                    "run_id": run_id,
+                    "packed": True,
+                    "claim_chips": per_slice,
+                }
             rows = conn.execute(
-                f"""SELECT * FROM devices WHERE {free_clause}
+                f"""SELECT * FROM devices d WHERE {free_clause}
+                    AND NOT EXISTS (SELECT 1 FROM device_claims c
+                                    WHERE c.device_id = d.id)
                     ORDER BY chips ASC, id ASC LIMIT ?""",
                 (*free_params, num_slices),
             ).fetchall()
             if len(rows) < num_slices:
                 return None
-            now = time.time()
             for row in rows:
                 conn.execute(
                     "UPDATE devices SET run_id = ?, updated_at = ? WHERE id = ?",
@@ -748,18 +844,20 @@ class RunRegistry:
             return claimed
 
     def release_devices(self, run_id: int) -> int:
-        """Free every slice held by ``run_id``; returns how many were held."""
+        """Free everything held by ``run_id`` — exclusive slice rows AND
+        packed claims; returns how many were held."""
         with self._lock, self._conn() as conn:
             cur = conn.execute(
                 "UPDATE devices SET run_id = NULL, updated_at = ? WHERE run_id = ?",
                 (time.time(), run_id),
             )
-        return cur.rowcount
+            packed = conn.execute(
+                "DELETE FROM device_claims WHERE run_id = ?", (run_id,)
+            )
+        return cur.rowcount + packed.rowcount
 
     @staticmethod
-    def _family_fit(
-        conn: sqlite3.Connection, accelerator: str, chips: int
-    ) -> Tuple[int, str, Tuple[Any, ...]]:
+    def _family_clause(accelerator: str, prefix: str = "") -> Tuple[str, Tuple[Any, ...]]:
         """Family matching shared by acquire and the free count (they MUST
         agree or hp_start dispatches trials that then fail admission).
 
@@ -768,9 +866,16 @@ class RunRegistry:
         cross-generation chips aren't fungible.
         """
         family = accelerator_family(accelerator)
-        family_clause = "(accelerator = ? OR accelerator LIKE ? ESCAPE '\\')"
+        col = f"{prefix}accelerator"
+        clause = f"({col} = ? OR {col} LIKE ? ESCAPE '\\')"
         like = family.replace("\\", "\\\\").replace("%", "\\%").replace("_", "\\_")
-        family_params = (family, like + "-%")
+        return clause, (family, like + "-%")
+
+    @classmethod
+    def _family_fit(
+        cls, conn: sqlite3.Connection, accelerator: str, chips: int
+    ) -> Tuple[int, str, Tuple[Any, ...]]:
+        family_clause, family_params = cls._family_clause(accelerator)
         managed = conn.execute(
             f"SELECT COUNT(*) AS n FROM devices WHERE {family_clause}",
             family_params,
@@ -778,16 +883,39 @@ class RunRegistry:
         free_clause = f"run_id IS NULL AND {family_clause} AND chips >= ?"
         return managed, free_clause, (*family_params, chips)
 
-    def free_slice_count(self, accelerator: str, chips: int) -> Optional[int]:
-        """Free fitting slices for a family; None = family unmanaged
-        (no inventory registered → admission control off)."""
+    def free_slice_count(
+        self, accelerator: str, chips: int, num_hosts: int = 1
+    ) -> Optional[int]:
+        """Free fitting CLAIM OPPORTUNITIES for a family; None = family
+        unmanaged (no inventory registered → admission control off).
+
+        For packable requests (single host) this counts packing slots —
+        Σ floor(free_chips / chips) over non-exclusive rows — so a sweep's
+        dispatch window sees that a v5e-16 fits four 4-chip trials.  Multi-
+        host requests count whole free unpacked slices, matching
+        ``acquire_device``'s exclusive path.
+        """
         conn = self._conn()
         managed, free_clause, free_params = self._family_fit(conn, accelerator, chips)
         if managed == 0:
             return None
-        return conn.execute(
-            f"SELECT COUNT(*) AS n FROM devices WHERE {free_clause}", free_params
-        ).fetchone()["n"]
+        if int(num_hosts) > 1:
+            return conn.execute(
+                f"""SELECT COUNT(*) AS n FROM devices d WHERE {free_clause}
+                    AND NOT EXISTS (SELECT 1 FROM device_claims c
+                                    WHERE c.device_id = d.id)""",
+                free_params,
+            ).fetchone()["n"]
+        family_clause, family_params = self._family_clause(accelerator, prefix="d.")
+        rows = conn.execute(
+            f"""SELECT d.chips - COALESCE(SUM(c.chips), 0) AS free_chips
+                FROM devices d
+                LEFT JOIN device_claims c ON c.device_id = d.id
+                WHERE d.run_id IS NULL AND {family_clause}
+                GROUP BY d.id""",
+            family_params,
+        ).fetchall()
+        return sum(r["free_chips"] // chips for r in rows if r["free_chips"] >= chips)
 
     # -- iterations (hpsearch) ------------------------------------------------
     def create_iteration(self, group_id: int, data: Dict[str, Any]) -> int:
@@ -933,31 +1061,90 @@ class RunRegistry:
 
     # -- projects (entity metadata over runs.project) --------------------------
     def create_project(
-        self, name: str, description: Optional[str] = None
+        self,
+        name: str,
+        description: Optional[str] = None,
+        owner: Optional[str] = None,
     ) -> Dict[str, Any]:
-        """Parity: reference project CRUD (``api/projects/``); runs keep a
-        plain ``project`` string column, this table carries the metadata."""
+        """Parity: reference project CRUD (``api/projects/``) + ownership
+        (``ownership/``): an ``owner`` scopes access to owner+collaborators
+        (+admins); ownerless projects stay open — the pre-ACL behavior and
+        the single-operator local mode."""
         try:
             with self._lock, self._conn() as conn:
                 cur = conn.execute(
-                    "INSERT INTO projects (name, description, created_at)"
-                    " VALUES (?, ?, ?)",
-                    (name, description, time.time()),
+                    "INSERT INTO projects (name, description, owner, created_at)"
+                    " VALUES (?, ?, ?, ?)",
+                    (name, description, owner, time.time()),
                 )
         except sqlite3.IntegrityError as e:
             raise RegistryError(f"Project {name!r} already exists") from e
-        return {"id": cur.lastrowid, "name": name, "description": description}
+        return {
+            "id": cur.lastrowid,
+            "name": name,
+            "description": description,
+            "owner": owner,
+        }
+
+    def add_collaborator(self, project: str, username: str) -> None:
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT OR IGNORE INTO project_collaborators
+                   (project_name, username, created_at) VALUES (?, ?, ?)""",
+                (project, username, time.time()),
+            )
+
+    def remove_collaborator(self, project: str, username: str) -> bool:
+        with self._lock, self._conn() as conn:
+            cur = conn.execute(
+                "DELETE FROM project_collaborators WHERE project_name = ?"
+                " AND username = ?",
+                (project, username),
+            )
+        return cur.rowcount > 0
+
+    def project_collaborators(self, project: str) -> List[str]:
+        rows = self._conn().execute(
+            "SELECT username FROM project_collaborators WHERE project_name = ?"
+            " ORDER BY username",
+            (project,),
+        ).fetchall()
+        return [r["username"] for r in rows]
+
+    def project_access(self, project: str, username: Optional[str]) -> bool:
+        """May ``username`` touch ``project``?  Ownerless (or unregistered)
+        projects are open; owned ones admit the owner and collaborators.
+        Role checks (admin override) live at the API layer."""
+        row = self._conn().execute(
+            "SELECT owner FROM projects WHERE name = ?", (project,)
+        ).fetchone()
+        if row is None or row["owner"] in (None, ""):
+            return True
+        if username is None:
+            return False
+        if row["owner"] == username:
+            return True
+        return (
+            self._conn().execute(
+                "SELECT 1 FROM project_collaborators WHERE project_name = ?"
+                " AND username = ?",
+                (project, username),
+            ).fetchone()
+            is not None
+        )
 
     def list_projects(self) -> List[Dict[str, Any]]:
         """Registered projects ∪ projects implied by runs, with run counts."""
         rows = self._conn().execute(
             """SELECT p.id AS id, p.name AS name, p.description AS description,
-                      p.created_at AS created_at, COUNT(r.id) AS num_runs
+                      p.owner AS owner, p.created_at AS created_at,
+                      COUNT(r.id) AS num_runs
                FROM projects p LEFT JOIN runs r ON r.project = p.name
                GROUP BY p.id
                UNION ALL
                SELECT NULL AS id, r.project AS name, NULL AS description,
-                      MIN(r.created_at) AS created_at, COUNT(*) AS num_runs
+                      NULL AS owner, MIN(r.created_at) AS created_at,
+                      COUNT(*) AS num_runs
                FROM runs r
                WHERE r.project NOT IN (SELECT name FROM projects)
                GROUP BY r.project
@@ -967,7 +1154,8 @@ class RunRegistry:
 
     def get_project(self, name: str) -> Optional[Dict[str, Any]]:
         row = self._conn().execute(
-            "SELECT id, name, description, created_at FROM projects WHERE name = ?",
+            "SELECT id, name, description, owner, created_at FROM projects"
+            " WHERE name = ?",
             (name,),
         ).fetchone()
         num_runs = self._conn().execute(
@@ -983,8 +1171,13 @@ class RunRegistry:
                 "SELECT MIN(created_at) FROM runs WHERE project = ?", (name,)
             ).fetchone()[0]
             return {"id": None, "name": name, "description": None,
+                    "owner": None, "collaborators": [],
                     "created_at": first, "num_runs": num_runs}
-        return {**dict(row), "num_runs": num_runs}
+        return {
+            **dict(row),
+            "num_runs": num_runs,
+            "collaborators": self.project_collaborators(name),
+        }
 
     def delete_project(self, name: str) -> bool:
         """Refuses while runs still reference it (archive them first)."""

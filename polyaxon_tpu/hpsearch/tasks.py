@@ -140,7 +140,10 @@ def register_hp_tasks(ctx: HPContext) -> None:
         # trials than the inventory fits would just park them at admission.
         topo = group.spec.environment.topology
         per_slice = int(topo.num_devices)
-        free = reg.free_slice_count(topo.accelerator, per_slice)
+        free = reg.free_slice_count(
+            topo.accelerator, per_slice,
+            num_hosts=int(topo.num_hosts) * int(topo.num_slices),
+        )
         if free is not None:
             # A multi-slice trial consumes num_slices whole slices.
             window = min(window, free // max(1, int(topo.num_slices)))

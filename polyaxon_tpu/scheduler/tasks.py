@@ -132,7 +132,11 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
         # holds a whole slice from SCHEDULED until terminal.
         try:
             device = reg.acquire_device(
-                run_id, plan.accelerator, plan.num_devices, num_slices=plan.num_slices
+                run_id,
+                plan.accelerator,
+                plan.num_devices,
+                num_slices=plan.num_slices,
+                num_hosts=plan.num_hosts,
             )
         except PolyaxonTPUError as e:
             # E.g. a chips/num_slices mismatch: a caller bug, but it must

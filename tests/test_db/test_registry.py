@@ -177,11 +177,65 @@ class TestDevices:
         # Second run falls through to the bigger slice.
         got2 = reg.acquire_device(run_id=2, accelerator="v5e-8", chips=8)
         assert got2["name"] == "big"
-        # Third run: family managed, nothing free.
-        assert reg.acquire_device(run_id=3, accelerator="v5e-8", chips=8) is None
+        # Third single-host run PACKS into big's remaining 8 chips.
+        got3 = reg.acquire_device(run_id=3, accelerator="v5e-8", chips=8)
+        assert got3["name"] == "big" and got3["packed"]
+        # Fourth: family managed, nothing free anywhere.
+        assert reg.acquire_device(run_id=4, accelerator="v5e-8", chips=8) is None
         assert reg.free_slice_count("v5e-8", 8) == 0
         assert reg.release_devices(1) == 1
         assert reg.free_slice_count("v5e-8", 8) == 1
+
+    def test_multi_host_gang_needs_whole_unpacked_slice(self, reg):
+        """Gangs spanning hosts claim exclusively: a packed trial on the
+        slice blocks them (an ICI world is one jax.distributed job), and
+        their own hold blocks further packing."""
+        reg.register_device("pod", "v5e-16", 16, num_hosts=4)
+        packed = reg.acquire_device(run_id=1, accelerator="v5e", chips=4)
+        assert packed["packed"]
+        # The 4-host gang cannot share the slice with the packed trial.
+        assert (
+            reg.acquire_device(run_id=2, accelerator="v5e", chips=16, num_hosts=4)
+            is None
+        )
+        assert reg.free_slice_count("v5e", 16, num_hosts=4) == 0
+        reg.release_devices(1)
+        whole = reg.acquire_device(run_id=2, accelerator="v5e", chips=16, num_hosts=4)
+        assert whole["name"] == "pod" and not whole.get("packed")
+        # And no packing onto an exclusively-held slice.
+        assert reg.acquire_device(run_id=3, accelerator="v5e", chips=4) is None
+
+    def test_packing_fills_one_slice_with_small_trials(self, reg):
+        """Four 4-chip single-host trials pack one v5e-16; the fifth
+        queues.  free_slice_count reports packing SLOTS."""
+        reg.register_device("pod", "v5e-16", 16, num_hosts=4)
+        assert reg.free_slice_count("v5e", 4) == 4
+        for run_id in range(1, 5):
+            got = reg.acquire_device(run_id=run_id, accelerator="v5e", chips=4)
+            assert got["name"] == "pod" and got["packed"], run_id
+        assert reg.acquire_device(run_id=5, accelerator="v5e", chips=4) is None
+        assert reg.free_slice_count("v5e", 4) == 0
+        devices = reg.list_devices()
+        assert devices[0]["used_chips"] == 16
+        assert devices[0]["holders"] == [1, 2, 3, 4]
+        # Releasing one trial frees exactly one slot.
+        assert reg.release_devices(2) == 1
+        assert reg.free_slice_count("v5e", 4) == 1
+        got = reg.acquire_device(run_id=5, accelerator="v5e", chips=4)
+        assert got["packed"]
+
+    def test_packing_best_fit_prefers_tightest_slice(self, reg):
+        reg.register_device("a", "v5e-16", 16)
+        reg.register_device("b", "v5e-8", 8)
+        # 8 free on b (tight) vs 16 on a: the 8-chip trial lands on b.
+        got = reg.acquire_device(run_id=1, accelerator="v5e", chips=8)
+        assert got["name"] == "b"
+        # 4-chip trial: b is full, a has 16 — packs a.
+        got2 = reg.acquire_device(run_id=2, accelerator="v5e", chips=4)
+        assert got2["name"] == "a"
+        # next 4-chip: a's 12 remaining is now the tightest fit.
+        got3 = reg.acquire_device(run_id=3, accelerator="v5e", chips=4)
+        assert got3["name"] == "a"
 
     def test_unmanaged_family(self, reg):
         reg.register_device("tpu", "v5e-8", 8)
