@@ -11,11 +11,20 @@ the global ``jax.Array`` with zero cross-host traffic at load time.
 
 On-disk format (one dir per dataset):
 
-    data/<name>/meta.json            {"num_examples", "shards", "arrays"}
-    data/<name>/shard-00000.npz      {"images": [n,H,W,C], "labels": [n]}
+    data/<name>/meta.json               {"num_examples", "shards", "arrays",
+                                         "format", "shard_sizes"}
+    data/<name>/shard-00000.images.npy  [n,H,W,C]
+    data/<name>/shard-00000.labels.npy  [n]
     ...
 
-Any array names work; "train" arrays must share a leading dim per shard.
+Per-array raw ``.npy`` shards so the read path can ``np.load(...,
+mmap_mode="r")``: the reader materializes only the ROWS each batch
+gathers, so datasets far larger than host RAM stream at ImageNet/LM-token
+scale (the reference streamed from mounted volumes; an in-RAM concat was
+this module's own acknowledged limit through round 3).  Pre-round-4
+``shard-*.npz`` datasets still read via the legacy in-RAM path.
+
+Any array names work; arrays must share a leading dim per shard.
 """
 
 from __future__ import annotations
@@ -44,7 +53,7 @@ def register_dataset(
     root = Path(data_dir) / name
     root.mkdir(parents=True, exist_ok=True)
     arrays = sorted(shards[0].keys())
-    num = 0
+    shard_sizes: List[int] = []
     for i, shard in enumerate(shards):
         if sorted(shard.keys()) != arrays:
             raise PolyaxonTPUError(
@@ -53,9 +62,17 @@ def register_dataset(
         sizes = {len(v) for v in shard.values()}
         if len(sizes) != 1:
             raise PolyaxonTPUError(f"Shard {i} arrays disagree on length: {sizes}")
-        np.savez(root / f"shard-{i:05d}.npz", **shard)
-        num += sizes.pop()
-    meta = {"num_examples": num, "shards": len(shards), "arrays": arrays}
+        # Raw .npy per array: mmap-able on read (npz is a zip — it isn't).
+        for a, v in shard.items():
+            np.save(root / f"shard-{i:05d}.{a}.npy", np.asarray(v))
+        shard_sizes.append(sizes.pop())
+    meta = {
+        "num_examples": sum(shard_sizes),
+        "shards": len(shards),
+        "arrays": arrays,
+        "format": "npy",
+        "shard_sizes": shard_sizes,
+    }
     (root / "meta.json").write_text(json.dumps(meta))
     return meta
 
@@ -113,15 +130,36 @@ class DatasetReader:
         self.num_processes = num_processes
         self.process_id = process_id
         self.dtype_overrides = dtype_overrides or {}
-        # Shard files are small (tens of MB); load once, serve many epochs.
-        # A larger-than-RAM dataset would swap this for per-shard mmap.
-        arrays: Dict[str, List[np.ndarray]] = {a: [] for a in self.meta["arrays"]}
-        for i in range(self.meta["shards"]):
-            with np.load(self.root / f"shard-{i:05d}.npz") as z:
-                for a in self.meta["arrays"]:
-                    arrays[a].append(z[a])
-        self.arrays = {a: np.concatenate(v) for a, v in arrays.items()}
         self.num_examples = self.meta["num_examples"]
+        if self.meta.get("format") == "npy":
+            # Streaming path: every shard is an mmap; a batch gather
+            # touches only its rows' pages, so RSS stays O(batch) no
+            # matter how large the dataset is.
+            self.arrays = None
+            self._shards: Dict[str, List[np.ndarray]] = {
+                a: [
+                    np.load(
+                        self.root / f"shard-{i:05d}.{a}.npy", mmap_mode="r"
+                    )
+                    for i in range(self.meta["shards"])
+                ]
+                for a in self.meta["arrays"]
+            }
+            sizes = self.meta.get("shard_sizes") or [
+                len(s) for s in next(iter(self._shards.values()))
+            ]
+            self._starts = np.concatenate([[0], np.cumsum(sizes)])
+        else:
+            # Legacy npz datasets (pre-round-4): zip members can't mmap;
+            # load once, serve many epochs.
+            arrays: Dict[str, List[np.ndarray]] = {
+                a: [] for a in self.meta["arrays"]
+            }
+            for i in range(self.meta["shards"]):
+                with np.load(self.root / f"shard-{i:05d}.npz") as z:
+                    for a in self.meta["arrays"]:
+                        arrays[a].append(z[a])
+            self.arrays = {a: np.concatenate(v) for a, v in arrays.items()}
 
     @property
     def batches_per_epoch(self) -> int:
@@ -142,8 +180,25 @@ class DatasetReader:
             lo = self.process_id * per_host
             local_idx = batch_idx[lo : lo + per_host]
             yield {
-                a: self._cast(a, v[local_idx]) for a, v in self.arrays.items()
+                a: self._cast(a, self._gather(a, local_idx))
+                for a in self.meta["arrays"]
             }
+
+    def _gather(self, name: str, idx: np.ndarray) -> np.ndarray:
+        """Rows ``idx`` (global order = shard order) of array ``name``.
+
+        Streaming format: indices are grouped per shard and fancy-indexed
+        out of the mmap — only the gathered rows materialize."""
+        if self.arrays is not None:
+            return self.arrays[name][idx]
+        shard_of = np.searchsorted(self._starts, idx, side="right") - 1
+        shards = self._shards[name]
+        first = shards[0]
+        out = np.empty((len(idx), *first.shape[1:]), dtype=first.dtype)
+        for s in np.unique(shard_of):
+            mask = shard_of == s
+            out[mask] = shards[s][idx[mask] - self._starts[s]]
+        return out
 
     def batches(self, start_step: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         """Endless stream, resumable: ``start_step`` fast-forwards the

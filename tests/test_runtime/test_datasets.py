@@ -27,7 +27,13 @@ class TestRegistration:
             {"x": np.arange(6, dtype=np.float32), "y": np.arange(6) % 3},
         ]
         meta = register_dataset(tmp_path, "toy", shards)
-        assert meta == {"num_examples": 16, "shards": 2, "arrays": ["x", "y"]}
+        assert meta == {
+            "num_examples": 16,
+            "shards": 2,
+            "arrays": ["x", "y"],
+            "format": "npy",
+            "shard_sizes": [10, 6],
+        }
         assert dataset_meta(tmp_path, "toy")["num_examples"] == 16
         assert [d["name"] for d in list_datasets(tmp_path)] == ["toy"]
 
@@ -98,6 +104,87 @@ class TestHostShardedReads:
         r = DatasetReader(tmp_path, "d", global_batch=16)
         with pytest.raises(PolyaxonTPUError):
             next(r.batches(0))
+
+
+class TestStreamingReads:
+    """The npy format must stream (mmap per shard, gather per batch) and
+    agree exactly with the legacy in-RAM path on the same data + seed."""
+
+    def _write_legacy_npz(self, root, name, shards):
+        """A pre-round-4 dataset: npz shards, no format field in meta."""
+        import json
+
+        d = root / name
+        d.mkdir(parents=True)
+        num = 0
+        for i, shard in enumerate(shards):
+            np.savez(d / f"shard-{i:05d}.npz", **shard)
+            num += len(next(iter(shard.values())))
+        (d / "meta.json").write_text(
+            json.dumps(
+                {
+                    "num_examples": num,
+                    "shards": len(shards),
+                    "arrays": sorted(shards[0]),
+                }
+            )
+        )
+
+    def test_reader_memory_maps_npy_shards(self, tmp_path):
+        register_dataset(
+            tmp_path, "d", [{"x": np.arange(32, dtype=np.int64)}]
+        )
+        r = DatasetReader(tmp_path, "d", global_batch=8)
+        assert r.arrays is None  # nothing concatenated into RAM
+        assert all(
+            isinstance(s, np.memmap) for s in r._shards["x"]
+        ), "shards must be mmapped, not loaded"
+
+    def test_npy_and_legacy_npz_agree_batch_for_batch(self, tmp_path):
+        rng = np.random.default_rng(3)
+        shards = [
+            {
+                "img": rng.integers(0, 255, (n, 4, 4), dtype=np.uint8),
+                "lab": rng.integers(0, 9, n).astype(np.int32),
+            }
+            for n in (21, 13, 30)
+        ]
+        register_dataset(tmp_path, "new", shards)
+        self._write_legacy_npz(tmp_path, "old", shards)
+        kw = dict(global_batch=16, seed=7, num_processes=2, process_id=1)
+        new = DatasetReader(tmp_path, "new", **kw)
+        old = DatasetReader(tmp_path, "old", **kw)
+        assert old.arrays is not None  # legacy really took the RAM path
+        for _, (a, b) in zip(range(9), zip(new.batches(), old.batches())):
+            np.testing.assert_array_equal(a["img"], b["img"])
+            np.testing.assert_array_equal(a["lab"], b["lab"])
+
+    def test_cross_shard_gather_preserves_permutation_order(self, tmp_path):
+        # Identity array: the batch must equal its index rows exactly even
+        # when a batch straddles all three shards.
+        register_dataset(
+            tmp_path,
+            "ident",
+            [{"x": np.arange(0, 7), "q": np.arange(0, 7) * 10},
+             {"x": np.arange(7, 19), "q": np.arange(7, 19) * 10},
+             {"x": np.arange(19, 24), "q": np.arange(19, 24) * 10}],
+        )
+        r = DatasetReader(tmp_path, "ident", global_batch=24, seed=1)
+        (batch,) = list(r.epoch(0))
+        rng = np.random.default_rng((1, 0))
+        np.testing.assert_array_equal(batch["x"], rng.permutation(24))
+        np.testing.assert_array_equal(batch["q"], batch["x"] * 10)
+
+    def test_resume_contract_holds_on_streaming_path(self, tmp_path):
+        register_dataset(
+            tmp_path, "d", [{"x": np.arange(40, dtype=np.int64)}]
+        )
+        full = DatasetReader(tmp_path, "d", global_batch=8, seed=2)
+        resumed = DatasetReader(tmp_path, "d", global_batch=8, seed=2)
+        want = [b["x"] for _, b in zip(range(12), full.batches())]
+        got = [b["x"] for _, b in zip(range(5), resumed.batches(start_step=7))]
+        for w, g in zip(want[7:], got):
+            np.testing.assert_array_equal(w, g)
 
 
 class TestCifar10:
