@@ -1,11 +1,19 @@
 """Single-page dashboard served at ``/``.
 
 Parity: the reference's React dashboard (``client/``, 551 TS files — runs
-tables, status chips, metric charts, log viewers, per-entity pages).  This
-is the embedded equivalent: one dependency-free HTML page over the REST
-API — tabs for runs (with live detail: metric chart, log tail, status
-history, stop/restart actions, service links), accelerator inventory,
-projects, saved searches, and the audit activity feed.
+tables, status chips, metric charts, log viewers, per-entity pages, the
+experiment-groups sweep pages and run comparison).  This is the embedded
+equivalent: one dependency-free HTML page over the REST API — tabs for
+runs (with live detail: metric chart, log tail, status history,
+stop/restart actions, service links, and a SWEEP panel for groups: trials
+table + metric-vs-param scatter off ``/runs?group_id=``), a bookmark-based
+run-compare tab (overlaid metric series + last-metric table), accelerator
+inventory with packed-chip accounting, projects, saved searches, and the
+audit activity feed.
+
+Auth bootstrap is a token FORM (stored in localStorage) rather than a
+``?token=`` query parameter — URLs land in browser history and access
+logs, so the secret must never ride one (round-3 finding).
 """
 
 DASHBOARD_HTML = """<!doctype html>
@@ -21,6 +29,7 @@ DASHBOARD_HTML = """<!doctype html>
          margin:0; padding:24px; }
   h1 { font-size:18px; margin:0 0 12px; }
   h1 span { color:var(--dim); font-weight:normal; }
+  h2 { font-size:14px; margin:0 0 8px; color:var(--dim); }
   nav { margin-bottom:16px; }
   nav a { color:var(--dim); margin-right:16px; cursor:pointer;
           text-decoration:none; padding-bottom:4px; }
@@ -40,19 +49,29 @@ DASHBOARD_HTML = """<!doctype html>
   .panel { background:var(--panel); border-radius:8px; padding:16px; margin-top:12px; }
   pre { margin:0; white-space:pre-wrap; color:var(--dim); max-height:280px; overflow:auto; }
   canvas { width:100%; height:160px; }
-  input { background:var(--panel); color:var(--text); border:1px solid #2a323c;
-          border-radius:6px; padding:6px 10px; width:340px; margin-bottom:12px; }
+  input, select { background:var(--panel); color:var(--text); border:1px solid #2a323c;
+          border-radius:6px; padding:6px 10px; margin-bottom:12px; }
+  #query { width:340px; }
   button { background:#253141; color:var(--text); border:1px solid #2a323c;
            border-radius:6px; padding:4px 12px; cursor:pointer; margin-right:8px; }
   button:hover { background:#2d3c50; }
   a.svc { color:var(--accent); }
   .dim { color:var(--dim); }
+  #login { display:none; margin-bottom:12px; }
 </style>
 </head>
 <body>
 <h1>polyaxon-tpu <span id="count"></span></h1>
+<div id="login">
+  <form onsubmit="saveToken(event)">
+    <input id="token-input" type="password" placeholder="API token" autocomplete="off"/>
+    <button type="submit">sign in</button>
+    <span class="dim">unauthorized — paste a token (stored locally, never in the URL)</span>
+  </form>
+</div>
 <nav>
   <a id="tab-runs" class="active" onclick="showTab('runs')">Runs</a>
+  <a id="tab-compare" onclick="showTab('compare')">Compare</a>
   <a id="tab-devices" onclick="showTab('devices')">Devices</a>
   <a id="tab-projects" onclick="showTab('projects')">Projects</a>
   <a id="tab-searches" onclick="showTab('searches')">Searches</a>
@@ -63,7 +82,7 @@ DASHBOARD_HTML = """<!doctype html>
   <input id="query" placeholder='filter: status:running, metric.loss:<0.5' />
   <table>
     <thead><tr><th>ID</th><th>Kind</th><th>Name</th><th>Project</th>
-    <th>Status</th><th>Last metric</th><th>Restarts</th><th>Service</th></tr></thead>
+    <th>Status</th><th>Last metric</th><th>Restarts</th><th>Service</th><th></th></tr></thead>
     <tbody id="runs"></tbody>
   </table>
   <div id="detail">
@@ -72,17 +91,41 @@ DASHBOARD_HTML = """<!doctype html>
       <button onclick="runAction('stop')">stop</button>
       <button onclick="runAction('restart')">restart</button>
       <button onclick="runAction('resume')">resume</button>
+      <button onclick="toggleBookmark()" id="bookmark-btn">bookmark</button>
       <span id="statuses" class="dim"></span>
+    </div>
+    <div class="panel" id="sweep-panel" style="display:none">
+      <h2>Sweep trials <span id="sweep-count"></span></h2>
+      <div>
+        <select id="sweep-x" onchange="drawSweep()"></select>
+        <select id="sweep-y" onchange="drawSweep()"></select>
+      </div>
+      <canvas id="sweep-chart" width="900" height="200"></canvas>
+      <table><thead><tr><th>ID</th><th>Status</th><th>Params</th>
+        <th>Last metric</th></tr></thead>
+        <tbody id="trials"></tbody></table>
     </div>
     <div class="panel"><canvas id="chart" width="900" height="160"></canvas></div>
     <div class="panel"><pre id="logs"></pre></div>
   </div>
 </div>
 
+<div id="view-compare" style="display:none">
+  <div class="panel">
+    <h2>Bookmarked runs — last metrics</h2>
+    <table><thead id="cmp-head"></thead><tbody id="cmp-rows"></tbody></table>
+  </div>
+  <div class="panel">
+    <h2>Metric over steps <select id="cmp-metric" onchange="drawCompare()"></select></h2>
+    <canvas id="cmp-chart" width="900" height="220"></canvas>
+    <div id="cmp-legend" class="dim"></div>
+  </div>
+</div>
+
 <div id="view-devices" style="display:none">
   <table>
-    <thead><tr><th>ID</th><th>Name</th><th>Accelerator</th><th>Chips</th>
-    <th>Hosts</th><th>Held by run</th></tr></thead>
+    <thead><tr><th>ID</th><th>Name</th><th>Accelerator</th><th>Chips used</th>
+    <th>Hosts</th><th>Held by</th></tr></thead>
     <tbody id="devices"></tbody>
   </table>
 </div>
@@ -110,11 +153,13 @@ DASHBOARD_HTML = """<!doctype html>
 
 <script>
 let selected = null;
+let selectedKind = null;
 let tab = 'runs';
 let searchCache = [];
-// Bearer token for authed deployments: ?token=... once, then localStorage.
-const urlToken = new URLSearchParams(location.search).get('token');
-if (urlToken) localStorage.setItem('px_token', urlToken);
+let trialCache = [];
+let compareCache = [];   // [{run, series: {metric: [[step, v], ...]}}]
+// Bearer token lives in localStorage only — never in the URL (history +
+// access-log leak). The login form below populates it on 401.
 const TOKEN = localStorage.getItem('px_token');
 const HDRS = TOKEN ? {Authorization: 'Bearer ' + TOKEN} : {};
 const apiFetch = (url, opts) => fetch(url, {...(opts||{}), headers: HDRS});
@@ -124,10 +169,17 @@ const names = {};
 const fmtMetric = m => Object.entries(m||{}).filter(([k])=>!k.startsWith('sys/'))
   .map(([k,v])=>`${esc(k)}=${typeof v==='number'?v.toPrecision(4):esc(v)}`).join(' ');
 const fmtTs = t => new Date(t*1000).toLocaleTimeString();
+const COLORS = ['#4da3ff','#3fb950','#d29922','#f85149','#bc8cff','#56d4dd'];
+
+function saveToken(ev) {
+  ev.preventDefault();
+  const v = document.getElementById('token-input').value.trim();
+  if (v) { localStorage.setItem('px_token', v); location.reload(); }
+}
 
 function showTab(name) {
   tab = name;
-  for (const t of ['runs','devices','projects','searches','activity']) {
+  for (const t of ['runs','compare','devices','projects','searches','activity']) {
     document.getElementById('view-'+t).style.display = t===name?'block':'none';
     document.getElementById('tab-'+t).className = t===name?'active':'';
   }
@@ -139,6 +191,7 @@ async function refresh() {
   // render this payload into another tab's table.
   const t = tab;
   if (t === 'runs') return refreshRuns();
+  if (t === 'compare') return refreshCompare();
   const resp = await apiFetch('/api/v1/' + (t === 'activity' ? 'activities' : t));
   if (!resp.ok) return authNote(resp);
   if (t !== tab) return;
@@ -146,8 +199,9 @@ async function refresh() {
   if (t === 'devices')
     document.getElementById('devices').innerHTML = data.map(d => `
       <tr><td>${Number(d.id)}</td><td>${esc(d.name)}</td><td>${esc(d.accelerator)}</td>
-      <td>${Number(d.chips)}</td><td>${Number(d.num_hosts)}</td>
-      <td>${d.run_id ? '#'+Number(d.run_id) : '<span class="dim">free</span>'}</td></tr>`).join('');
+      <td>${Number(d.used_chips||0)}/${Number(d.chips)}</td><td>${Number(d.num_hosts)}</td>
+      <td>${(d.holders||[]).length ? (d.holders||[]).map(h=>'#'+Number(h)).join(' ')
+          : '<span class="dim">free</span>'}</td></tr>`).join('');
   if (t === 'projects')
     document.getElementById('projects').innerHTML = data.map(p => `
       <tr><td>${esc(p.name)}</td><td>${Number(p.num_runs)}</td>
@@ -170,8 +224,10 @@ async function refresh() {
 }
 
 function authNote(resp) {
-  if (resp.status === 401)
-    document.getElementById('count').textContent = '— unauthorized (append ?token=...)';
+  if (resp.status === 401) {
+    document.getElementById('count').textContent = '— unauthorized';
+    document.getElementById('login').style.display = 'block';
+  }
 }
 
 function runSearchIdx(i) {
@@ -194,21 +250,25 @@ async function refreshRuns() {
   document.getElementById('runs').innerHTML = data.results.map(r => {
     names[r.id] = r.name || ('run ' + r.id);
     return `
-    <tr class="row" onclick="select(${Number(r.id)})">
+    <tr class="row" onclick="select(${Number(r.id)}, '${esc(r.kind)}')">
       <td>${Number(r.id)}</td><td>${esc(r.kind)}</td><td>${esc(r.name||'')}</td>
       <td>${esc(r.project)}</td>
       <td><span class="chip ${esc(r.status)}">${esc(r.status)}</span></td>
       <td>${fmtMetric(r.last_metric)}</td><td>${Number(r.restarts)}</td>
       <td>${r.service_url ? `<a class="svc" href="${esc(r.service_url)}"
-        target="_blank" onclick="event.stopPropagation()">open</a>` : ''}</td></tr>`;
+        target="_blank" onclick="event.stopPropagation()">open</a>` : ''}</td>
+      <td><button onclick="event.stopPropagation(); bookmark(${Number(r.id)})">☆</button></td></tr>`;
   }).join('');
   if (selected) await refreshDetail();
 }
 
-async function select(id) {
+async function select(id, kind) {
   selected = id;
+  selectedKind = kind;
   document.getElementById('detail').style.display = 'block';
   document.getElementById('detail-title').textContent = `#${id} ${names[id]||''}`;
+  document.getElementById('sweep-panel').style.display =
+    kind === 'group' ? 'block' : 'none';
   await refreshDetail();
 }
 
@@ -218,16 +278,146 @@ async function runAction(action) {
   await refreshRuns();
 }
 
+async function bookmark(id) {
+  await apiFetch(`/api/v1/runs/${id}/bookmark`, {method:'POST'});
+}
+
+async function toggleBookmark() {
+  if (selected) await bookmark(selected);
+}
+
 async function refreshDetail() {
-  const [metrics, logs, statuses] = await Promise.all([
+  const wants = [
     apiFetch(`/api/v1/runs/${selected}/metrics`).then(r=>r.json()),
     apiFetch(`/api/v1/runs/${selected}/logs?limit=200`).then(r=>r.json()),
-    apiFetch(`/api/v1/runs/${selected}/statuses`).then(r=>r.json())]);
+    apiFetch(`/api/v1/runs/${selected}/statuses`).then(r=>r.json())];
+  if (selectedKind === 'group')
+    wants.push(apiFetch(`/api/v1/runs?group_id=${selected}&limit=500`).then(r=>r.json()));
+  const [metrics, logs, statuses, trials] = await Promise.all(wants);
   document.getElementById('logs').textContent =
     logs.results.map(l=>l.line).join('\\n') || '(no logs)';
   document.getElementById('statuses').textContent =
     statuses.results.map(s=>s.status).join(' → ');
   drawChart(metrics.results);
+  if (trials) renderSweep(trials.results);
+}
+
+function trialParams(r) {
+  return (r.spec && r.spec.declarations) || {};
+}
+
+function renderSweep(trials) {
+  trialCache = trials;
+  document.getElementById('sweep-count').textContent = `(${trials.length})`;
+  document.getElementById('trials').innerHTML = trials.map(t => `
+    <tr><td>${Number(t.id)}</td>
+    <td><span class="chip ${esc(t.status)}">${esc(t.status)}</span></td>
+    <td class="dim">${esc(Object.entries(trialParams(t))
+      .map(([k,v])=>k+'='+v).join(' '))}</td>
+    <td>${fmtMetric(t.last_metric)}</td></tr>`).join('');
+  // Param/metric axis choices from the union across trials.
+  const params = new Set(), metrics = new Set();
+  trials.forEach(t => {
+    Object.entries(trialParams(t)).forEach(([k,v]) => {
+      if (typeof v === 'number') params.add(k);
+    });
+    Object.entries(t.last_metric||{}).forEach(([k,v]) => {
+      if (typeof v === 'number' && !k.startsWith('sys/')) metrics.add(k);
+    });
+  });
+  fillSelect('sweep-x', [...params]);
+  fillSelect('sweep-y', [...metrics]);
+  drawSweep();
+}
+
+function fillSelect(id, options) {
+  const el = document.getElementById(id);
+  const keep = el.value;
+  el.innerHTML = options.map(o => `<option>${esc(o)}</option>`).join('');
+  if (options.includes(keep)) el.value = keep;
+}
+
+function drawSweep() {
+  const xk = document.getElementById('sweep-x').value;
+  const yk = document.getElementById('sweep-y').value;
+  const c = document.getElementById('sweep-chart'), ctx = c.getContext('2d');
+  ctx.clearRect(0,0,c.width,c.height);
+  if (!xk || !yk) return;
+  const pts = trialCache
+    .map(t => [trialParams(t)[xk], (t.last_metric||{})[yk], t.status])
+    .filter(([x,y]) => typeof x === 'number' && typeof y === 'number');
+  if (!pts.length) return;
+  const xs = pts.map(p=>p[0]), ys = pts.map(p=>p[1]);
+  const xmin = Math.min(...xs), xspan = (Math.max(...xs)-xmin)||1;
+  const ymin = Math.min(...ys), yspan = (Math.max(...ys)-ymin)||1;
+  ctx.fillStyle = '#8a949e';
+  ctx.fillText(`${xk} →`, c.width-80, c.height-6);
+  ctx.fillText(`↑ ${yk}`, 6, 14);
+  pts.forEach(([x,y,status]) => {
+    const px = 40 + (x-xmin)/xspan*(c.width-70);
+    const py = c.height-24 - (y-ymin)/yspan*(c.height-44);
+    ctx.fillStyle = status === 'succeeded' ? '#3fb950'
+      : status === 'failed' ? '#f85149' : '#4da3ff';
+    ctx.beginPath(); ctx.arc(px, py, 4, 0, 7); ctx.fill();
+  });
+  ctx.fillStyle = '#8a949e';
+  ctx.fillText(String(ymin.toPrecision(3)), 4, c.height-24);
+  ctx.fillText(String((ymin+yspan).toPrecision(3)), 4, 26);
+}
+
+async function refreshCompare() {
+  const resp = await apiFetch('/api/v1/bookmarks');
+  if (!resp.ok) return authNote(resp);
+  const runs = (await resp.json()).results.slice(0, 6);
+  compareCache = await Promise.all(runs.map(async r => {
+    const m = await apiFetch(`/api/v1/runs/${r.id}/metrics`).then(x=>x.json());
+    const series = {};
+    m.results.forEach((row, i) => Object.entries(row.values).forEach(([k,v]) => {
+      if (typeof v==='number' && !k.startsWith('sys/'))
+        (series[k] = series[k]||[]).push([row.step ?? i, v]);
+    }));
+    return {run: r, series};
+  }));
+  if (tab !== 'compare') return;
+  // Last-metric table: one column per metric key in the union.
+  const keys = [...new Set(compareCache.flatMap(
+    c => Object.keys(c.run.last_metric||{}).filter(k=>!k.startsWith('sys/'))))];
+  document.getElementById('cmp-head').innerHTML =
+    `<tr><th>Run</th><th>Status</th>${keys.map(k=>`<th>${esc(k)}</th>`).join('')}</tr>`;
+  document.getElementById('cmp-rows').innerHTML = compareCache.map(c => `
+    <tr><td>#${Number(c.run.id)} ${esc(c.run.name||'')}</td>
+    <td><span class="chip ${esc(c.run.status)}">${esc(c.run.status)}</span></td>
+    ${keys.map(k => {
+      const v = (c.run.last_metric||{})[k];
+      return `<td>${typeof v==='number'?esc(v.toPrecision(4)):''}</td>`;
+    }).join('')}</tr>`).join('')
+    || '<tr><td class="dim">bookmark runs (☆ in the Runs tab) to compare them</td></tr>';
+  fillSelect('cmp-metric',
+    [...new Set(compareCache.flatMap(c => Object.keys(c.series)))]);
+  drawCompare();
+}
+
+function drawCompare() {
+  const key = document.getElementById('cmp-metric').value;
+  const c = document.getElementById('cmp-chart'), ctx = c.getContext('2d');
+  ctx.clearRect(0,0,c.width,c.height);
+  const active = compareCache.filter(x => (x.series[key]||[]).length > 1);
+  if (!active.length) return;
+  const all = active.flatMap(x => x.series[key]);
+  const xmin = Math.min(...all.map(p=>p[0])), xspan = (Math.max(...all.map(p=>p[0]))-xmin)||1;
+  const ymin = Math.min(...all.map(p=>p[1])), yspan = (Math.max(...all.map(p=>p[1]))-ymin)||1;
+  active.forEach((x, si) => {
+    ctx.strokeStyle = COLORS[si%COLORS.length]; ctx.beginPath();
+    x.series[key].forEach(([s,v], i) => {
+      const px = 40 + (s-xmin)/xspan*(c.width-60);
+      const py = c.height-20 - (v-ymin)/yspan*(c.height-40);
+      i ? ctx.lineTo(px,py) : ctx.moveTo(px,py);
+    });
+    ctx.stroke();
+  });
+  document.getElementById('cmp-legend').innerHTML = active.map((x, si) =>
+    `<span style="color:${COLORS[si%COLORS.length]}">■</span> #${Number(x.run.id)} ${esc(x.run.name||'')}`
+  ).join(' &nbsp; ');
 }
 
 function drawChart(rows) {
@@ -238,18 +428,17 @@ function drawChart(rows) {
     if (typeof v==='number' && !k.startsWith('sys/'))
       (series[k] = series[k]||[]).push(v);
   }));
-  const colors = ['#4da3ff','#3fb950','#d29922','#f85149','#bc8cff'];
   Object.entries(series).slice(0,5).forEach(([name, vals], si) => {
     if (vals.length < 2) return;
     const min = Math.min(...vals), max = Math.max(...vals), span = (max-min)||1;
-    ctx.strokeStyle = colors[si%colors.length]; ctx.beginPath();
+    ctx.strokeStyle = COLORS[si%COLORS.length]; ctx.beginPath();
     vals.forEach((v,i) => {
       const x = 40 + i*(c.width-60)/(vals.length-1);
       const y = c.height-20 - (v-min)/span*(c.height-40);
       i ? ctx.lineTo(x,y) : ctx.moveTo(x,y);
     });
     ctx.stroke();
-    ctx.fillStyle = colors[si%colors.length];
+    ctx.fillStyle = COLORS[si%COLORS.length];
     ctx.fillText(name, 44, 14+12*si);
   });
 }

@@ -228,6 +228,14 @@ class TestAuthAndDashboard:
             assert resp.status == 200
             html = await resp.text()
             assert "polyaxon-tpu" in html and "/api/v1/runs" in html
+            # Sweep + compare views (round-4): trials scatter off
+            # /runs?group_id= and bookmark-based run comparison.
+            assert "sweep-panel" in html and "group_id=" in html
+            assert "cmp-chart" in html and "/api/v1/bookmarks" in html
+            # Auth bootstrap is a form into localStorage; the token must
+            # never ride a URL (history/access-log leak, round-3 finding).
+            assert "?token=" not in html
+            assert "token-input" in html
             return True
 
         assert drive(orch, body)
