@@ -80,14 +80,50 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             ctx["actor"] = actor
         orch.auditor.record(event_type, **ctx)
 
+    def _project_denied(request, project: str) -> bool:
+        """Project-scoped access (reference ``ownership/`` + ``scopes/``):
+        owned projects admit owner + collaborators; admins (including the
+        open-mode anonymous admin) see everything; ownerless projects stay
+        open."""
+        if request.get("role") == "admin":
+            return False
+        return not reg.project_access(project, request.get("actor"))
+
+    def _require_project(request, project: str) -> None:
+        if _project_denied(request, project):
+            raise web.HTTPForbidden(
+                text=json.dumps(
+                    {"error": f"no access to project {project!r}"}
+                ),
+                content_type="application/json",
+            )
+
+    def _require_project_owner(request, project: str) -> None:
+        """Owner-or-admin gate for project administration (delete, share)."""
+        if request.get("role") == "admin":
+            return
+        proj = reg.get_project(project)
+        owner = (proj or {}).get("owner")
+        if owner and owner != request.get("actor"):
+            raise web.HTTPForbidden(
+                text=json.dumps(
+                    {"error": f"only the owner of {project!r} (or an admin) may do this"}
+                ),
+                content_type="application/json",
+            )
+
     def _run_or_404(request) -> Run:
         try:
-            return reg.get_run(int(request.match_info["run_id"]))
+            run = reg.get_run(int(request.match_info["run_id"]))
         except PolyaxonTPUError:
             raise web.HTTPNotFound(
                 text=json.dumps({"error": f"run {request.match_info['run_id']} not found"}),
                 content_type="application/json",
             )
+        # Every run endpoint rides this lookup, so the project ACL holds
+        # across detail/actions/logs/metrics/artifacts/WS uniformly.
+        _require_project(request, run.project)
+        return run
 
     @routes.get("/")
     async def dashboard(request):
@@ -120,6 +156,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
     @routes.post(f"{API_PREFIX}/runs")
     async def create_run(request):
         body = await request.json()
+        _require_project(request, body.get("project", "default"))
         try:
             run = orch.submit(
                 body.get("spec") or body.get("content"),
@@ -153,6 +190,11 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             clauses, params, residual = compile_to_sql(conds)
         except QueryError as e:
             return web.json_response({"error": str(e)}, status=400)
+        # In-process filters (residual DSL conditions, project ACLs under
+        # auth) must see the FULL result set before pagination — slicing
+        # first would return empty/short pages while accessible runs sit
+        # beyond them.
+        post_filter = bool(residual) or request.get("auth_required", False)
         runs = reg.list_runs(
             kind=q.get("kind"),
             project=q.get("project"),
@@ -160,13 +202,23 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             pipeline_id=_int_param(request, "pipeline_id"),
             statuses=statuses,
             extra_where=(clauses, params) if clauses else None,
-            limit=None if residual else limit,
-            offset=0 if residual else offset,
+            limit=None if post_filter else limit,
+            offset=0 if post_filter else offset,
         )
         if residual:
             runs = apply_query(runs, conditions=residual)
-            runs = runs[offset : offset + limit]
-        return web.json_response({"results": [run_to_dict(r) for r in runs]})
+        # Owned projects are invisible to outsiders, not just read-only
+        # (reference private projects). One ACL decision per project name.
+        decided: Dict[str, bool] = {}
+        visible = []
+        for r in runs:
+            if r.project not in decided:
+                decided[r.project] = not _project_denied(request, r.project)
+            if decided[r.project]:
+                visible.append(r)
+        if post_filter:
+            visible = visible[offset : offset + limit]
+        return web.json_response({"results": [run_to_dict(r) for r in visible]})
 
     @routes.get(f"{API_PREFIX}/runs/{{run_id}}")
     async def get_run(request):
@@ -288,9 +340,41 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
     @routes.post(f"{API_PREFIX}/projects")
     async def create_project(request):
         body = await request.json()
+        # Under auth the creator owns the project (reference ``ownership/``);
+        # an explicit body owner — including null for a deliberately open
+        # project — overrides; open mode (anonymous admin) stays ownerless.
+        actor = request.get("actor")
+        is_admin = request.get("role") == "admin"
+        if "owner" in body:
+            owner = body["owner"]
+        else:
+            owner = actor if actor not in (None, "anonymous") else None
+        if not is_admin:
+            # Non-admins may only own projects themselves (no assigning
+            # ownership to third parties)...
+            if owner not in (None, actor):
+                raise web.HTTPForbidden(
+                    text=json.dumps(
+                        {"error": "only admins may assign another owner"}
+                    ),
+                    content_type="application/json",
+                )
+            # ...and may not CLAIM a run-implied project others already use
+            # (registering 'ml' with an owner would 403 every existing
+            # user of it — an ownership takeover).
+            if owner is not None and reg.get_project(body.get("name", "")):
+                raise web.HTTPForbidden(
+                    text=json.dumps(
+                        {
+                            "error": "project already has runs; an admin must "
+                            "register its ownership"
+                        }
+                    ),
+                    content_type="application/json",
+                )
         try:
             project = reg.create_project(
-                body["name"], description=body.get("description")
+                body["name"], description=body.get("description"), owner=owner
             )
         except KeyError:
             return web.json_response({"error": "project needs a name"}, status=400)
@@ -301,10 +385,16 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
 
     @routes.get(f"{API_PREFIX}/projects")
     async def list_projects(request):
-        return web.json_response({"results": reg.list_projects()})
+        results = [
+            p
+            for p in reg.list_projects()
+            if not _project_denied(request, p["name"])
+        ]
+        return web.json_response({"results": results})
 
     @routes.get(f"{API_PREFIX}/projects/{{name}}")
     async def get_project(request):
+        _require_project(request, request.match_info["name"])
         project = reg.get_project(request.match_info["name"])
         if project is None:
             raise web.HTTPNotFound(
@@ -315,6 +405,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
 
     @routes.delete(f"{API_PREFIX}/projects/{{name}}")
     async def delete_project(request):
+        _require_project_owner(request, request.match_info["name"])
         try:
             removed = reg.delete_project(request.match_info["name"])
         except PolyaxonTPUError as e:
@@ -325,6 +416,44 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 content_type="application/json",
             )
         _audit(request, EventTypes.PROJECT_DELETED, project=request.match_info["name"])
+        return web.json_response({"ok": True})
+
+    @routes.post(f"{API_PREFIX}/projects/{{name}}/collaborators")
+    async def add_collaborator(request):
+        name = request.match_info["name"]
+        _require_project_owner(request, name)
+        body = await request.json()
+        username = body.get("username")
+        if not username:
+            return web.json_response(
+                {"error": "collaborator needs a username"}, status=400
+            )
+        if reg.get_project(name) is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "no such project"}),
+                content_type="application/json",
+            )
+        reg.add_collaborator(name, username)
+        _audit(
+            request, EventTypes.PROJECT_SHARED, project=name, username=username
+        )
+        return web.json_response(reg.get_project(name), status=201)
+
+    @routes.delete(f"{API_PREFIX}/projects/{{name}}/collaborators/{{username}}")
+    async def remove_collaborator(request):
+        name = request.match_info["name"]
+        _require_project_owner(request, name)
+        if not reg.remove_collaborator(name, request.match_info["username"]):
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "not a collaborator"}),
+                content_type="application/json",
+            )
+        _audit(
+            request,
+            EventTypes.PROJECT_UNSHARED,
+            project=name,
+            username=request.match_info["username"],
+        )
         return web.json_response({"ok": True})
 
     # -- saved searches (reference api/searches/) -------------------------------

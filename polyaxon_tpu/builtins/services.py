@@ -126,14 +126,17 @@ def jupyter(ctx: Context) -> None:
     else:
         import importlib.util
 
-        if importlib.util.find_spec("jupyterlab") is None and (
-            importlib.util.find_spec("jupyter_server") is None
-        ):
+        if importlib.util.find_spec("jupyterlab") is not None:
+            argv = [sys.executable, "-m", "jupyterlab"]
+        elif importlib.util.find_spec("jupyter_server") is not None:
+            # Same --ServerApp flags; serves the classic file/API surface
+            # when only the server core is installed.
+            argv = [sys.executable, "-m", "jupyter_server"]
+        else:
             raise RuntimeError(
                 "jupyter is not installed on this worker — install jupyterlab "
                 "or pass a jupyter_bin param"
             )
-        argv = [sys.executable, "-m", "jupyterlab"]
     argv += [
         f"--ServerApp.ip={host}",
         f"--ServerApp.port={port}",

@@ -138,16 +138,27 @@ class RemoteClient:
     def execute_search(self, name):
         return self._request("GET", f"/api/v1/searches/{name}/runs")["results"]
 
-    def create_project(self, name, description):
-        return self._request(
-            "POST", "/api/v1/projects", {"name": name, "description": description}
-        )
+    def create_project(self, name, description, owner=None):
+        body = {"name": name, "description": description}
+        if owner is not None:
+            body["owner"] = owner
+        return self._request("POST", "/api/v1/projects", body)
 
     def list_projects(self):
         return self._request("GET", "/api/v1/projects")["results"]
 
     def delete_project(self, name):
         return self._request("DELETE", f"/api/v1/projects/{name}")
+
+    def share_project(self, name, username):
+        return self._request(
+            "POST", f"/api/v1/projects/{name}/collaborators", {"username": username}
+        )
+
+    def unshare_project(self, name, username):
+        return self._request(
+            "DELETE", f"/api/v1/projects/{name}/collaborators/{username}"
+        )
 
     def add_bookmark(self, run_id):
         return self._request("POST", f"/api/v1/runs/{run_id}/bookmark")
@@ -293,8 +304,19 @@ class LocalClient:
         runs = apply_query(self.orch.registry.list_runs(), search["query"])
         return [self._to_dict(r) for r in runs]
 
-    def create_project(self, name, description):
-        return self.orch.registry.create_project(name, description=description)
+    def create_project(self, name, description, owner=None):
+        return self.orch.registry.create_project(
+            name, description=description, owner=owner
+        )
+
+    def share_project(self, name, username):
+        self.orch.registry.add_collaborator(name, username)
+        return self.orch.registry.get_project(name)
+
+    def unshare_project(self, name, username):
+        if not self.orch.registry.remove_collaborator(name, username):
+            raise SystemExit(f"{username!r} is not a collaborator on {name!r}")
+        return {"ok": True}
 
     def list_projects(self):
         return self.orch.registry.list_projects()
@@ -548,9 +570,18 @@ def main(argv=None) -> int:
     p_proj_add = proj_sub.add_parser("add", help="register a project")
     p_proj_add.add_argument("name")
     p_proj_add.add_argument("--description")
+    p_proj_add.add_argument(
+        "--owner", help="scope access to this user (+collaborators/admins)"
+    )
     proj_sub.add_parser("list", help="projects with run counts")
     p_proj_rm = proj_sub.add_parser("remove", help="delete an empty project")
     p_proj_rm.add_argument("name")
+    p_proj_share = proj_sub.add_parser("share", help="add a collaborator")
+    p_proj_share.add_argument("name")
+    p_proj_share.add_argument("username")
+    p_proj_unshare = proj_sub.add_parser("unshare", help="drop a collaborator")
+    p_proj_unshare.add_argument("name")
+    p_proj_unshare.add_argument("username")
 
     p_search = sub.add_parser("searches", help="saved run searches")
     search_sub = p_search.add_subparsers(dest="searches_command", required=True)
@@ -712,15 +743,25 @@ def main(argv=None) -> int:
             return 0
         if args.command == "projects":
             if args.projects_command == "add":
-                print(json.dumps(client.create_project(args.name, args.description)))
+                print(json.dumps(client.create_project(
+                    args.name, args.description, owner=args.owner
+                )))
             elif args.projects_command == "list":
-                fmt = "{:16}  {:>6}  {:}"
-                print(fmt.format("NAME", "RUNS", "DESCRIPTION"))
+                fmt = "{:16}  {:>6}  {:10}  {:}"
+                print(fmt.format("NAME", "RUNS", "OWNER", "DESCRIPTION"))
                 for pr in client.list_projects():
-                    print(fmt.format(pr["name"], pr["num_runs"], pr.get("description") or ""))
+                    print(fmt.format(
+                        pr["name"], pr["num_runs"], pr.get("owner") or "-",
+                        pr.get("description") or "",
+                    ))
             elif args.projects_command == "remove":
                 client.delete_project(args.name)
                 print("removed", file=sys.stderr)
+            elif args.projects_command == "share":
+                print(json.dumps(client.share_project(args.name, args.username)))
+            elif args.projects_command == "unshare":
+                client.unshare_project(args.name, args.username)
+                print("removed collaborator", file=sys.stderr)
             return 0
         if args.command == "searches":
             if args.searches_command == "add":
@@ -831,13 +872,18 @@ def main(argv=None) -> int:
             return 0
         if args.command == "devices":
             if args.devices_command == "list":
-                fmt = "{:>4}  {:16}  {:10}  {:>6}  {:>6}  {:}"
+                fmt = "{:>4}  {:16}  {:10}  {:>9}  {:>6}  {:}"
                 print(fmt.format("ID", "NAME", "ACCEL", "CHIPS", "HOSTS", "HELD BY"))
                 for d in client.list_devices():
+                    used = d.get("used_chips", d["chips"] if d.get("run_id") else 0)
+                    holders = d.get("holders") or (
+                        [d["run_id"]] if d.get("run_id") else []
+                    )
                     print(
                         fmt.format(
-                            d["id"], d["name"], d["accelerator"], d["chips"],
-                            d["num_hosts"], d["run_id"] or "-",
+                            d["id"], d["name"], d["accelerator"],
+                            f"{used}/{d['chips']}", d["num_hosts"],
+                            ",".join(str(h) for h in holders) or "-",
                         )
                     )
             elif args.devices_command == "add":

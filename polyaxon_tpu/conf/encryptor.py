@@ -1,0 +1,89 @@
+"""Secret-option encryption at rest.
+
+Parity: reference ``encryptor/`` (its ``polyaxon/encryptor`` app wrapped
+values with a Fernet token under a settings key).  Here: options declared
+``secret=True`` are Fernet-encrypted before they land in the sqlite
+options table, so a copied registry file (or a backup of it) does not leak
+credentials.  Secrets were already write-only over every API/CLI surface;
+this closes the at-rest gap.
+
+Key resolution order:
+
+1. ``POLYAXON_TPU_SECRET_KEY`` env var (a Fernet key — urlsafe base64);
+2. ``<base_dir>/.secret_key``, generated on first use with mode 0600.
+
+Stored values carry an ``enc:v1:`` prefix; values without it (written
+before this module existed) read back as-is, so enabling encryption never
+bricks an existing deployment — the next write upgrades them.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional, Union
+
+from polyaxon_tpu.exceptions import PolyaxonTPUError
+
+_PREFIX = "enc:v1:"
+_KEY_ENV = "POLYAXON_TPU_SECRET_KEY"
+_KEY_FILE = ".secret_key"
+
+
+class EncryptionError(PolyaxonTPUError):
+    pass
+
+
+class Encryptor:
+    def __init__(self, key: bytes) -> None:
+        from cryptography.fernet import Fernet
+
+        try:
+            self._fernet = Fernet(key)
+        except (ValueError, TypeError) as e:
+            raise EncryptionError(f"Invalid secret key: {e}") from e
+
+    @classmethod
+    def from_base_dir(cls, base_dir: Union[str, Path]) -> "Encryptor":
+        """Env key wins; otherwise a per-deployment keyfile (created 0600)."""
+        env = os.environ.get(_KEY_ENV)
+        if env:
+            return cls(env.encode())
+        from cryptography.fernet import Fernet
+
+        path = Path(base_dir) / _KEY_FILE
+        if path.exists():
+            return cls(path.read_bytes().strip())
+        path.parent.mkdir(parents=True, exist_ok=True)
+        key = Fernet.generate_key()
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+        try:
+            os.write(fd, key)
+        finally:
+            os.close(fd)
+        return cls(key)
+
+    def encrypt(self, value: str) -> str:
+        return _PREFIX + self._fernet.encrypt(str(value).encode()).decode()
+
+    def decrypt(self, stored: Optional[str]) -> Optional[str]:
+        """Decrypt an ``enc:v1:`` value; legacy plaintext passes through."""
+        if stored is None or not isinstance(stored, str):
+            return stored
+        if not stored.startswith(_PREFIX):
+            return stored
+        from cryptography.fernet import InvalidToken
+
+        try:
+            return self._fernet.decrypt(stored[len(_PREFIX):].encode()).decode()
+        except InvalidToken as e:
+            # Loud by design: a wrong key silently yielding None would look
+            # like "option unset" and e.g. disable SMTP auth.
+            raise EncryptionError(
+                "Cannot decrypt stored secret (wrong POLYAXON_TPU_SECRET_KEY "
+                "or .secret_key?)"
+            ) from e
+
+    @staticmethod
+    def is_encrypted(stored: Optional[str]) -> bool:
+        return isinstance(stored, str) and stored.startswith(_PREFIX)

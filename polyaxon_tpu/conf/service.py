@@ -23,11 +23,17 @@ class ConfError(PolyaxonTPUError):
 
 
 class ConfService:
-    def __init__(self, registry=None, cache_ttl: float = 60.0) -> None:
+    def __init__(
+        self, registry=None, cache_ttl: float = 60.0, encryptor=None
+    ) -> None:
         #: RunRegistry (for the DB store) — optional so schema-only tools
         #: can resolve env/default options without a database.
         self.registry = registry
         self.cache_ttl = cache_ttl
+        #: conf.encryptor.Encryptor — secret=True options encrypt at rest
+        #: (reference ``encryptor/``); None = store/read plaintext (tests,
+        #: schema-only tools).
+        self.encryptor = encryptor
         self._cache: Dict[str, Tuple[float, Any]] = {}
 
     def _option(self, key: str) -> Option:
@@ -46,6 +52,8 @@ class ConfService:
             raw = None
             if store == OptionStores.DB and self.registry is not None:
                 raw = self.registry.get_option(opt.key)
+                if opt.secret and self.encryptor is not None:
+                    raw = self.encryptor.decrypt(raw)
             elif store == OptionStores.ENV:
                 raw = os.environ.get(opt.env_var)
             elif store == OptionStores.DEFAULT:
@@ -73,7 +81,10 @@ class ConfService:
         opt = self._option(key)
         if self.registry is None:
             raise ConfError("No registry attached; cannot persist options")
-        self.registry.set_option(opt.key, opt.coerce(value))
+        value = opt.coerce(value)
+        if opt.secret and self.encryptor is not None and value:
+            value = self.encryptor.encrypt(str(value))
+        self.registry.set_option(opt.key, value)
         self._cache.pop(key, None)
 
     def unset(self, key: str) -> None:

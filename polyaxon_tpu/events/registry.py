@@ -64,6 +64,8 @@ class EventTypes:
     # entities (events/registry/{project,user,search,bookmark}.py)
     PROJECT_CREATED = "project.created"
     PROJECT_DELETED = "project.deleted"
+    PROJECT_SHARED = "project.shared"
+    PROJECT_UNSHARED = "project.unshared"
     USER_CREATED = "user.created"
     USER_DELETED = "user.deleted"
     SEARCH_CREATED = "search.created"

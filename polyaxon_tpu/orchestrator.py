@@ -58,7 +58,10 @@ class Orchestrator:
 
         # Explicit arguments win; otherwise options resolve through the
         # conf stores (DB option table -> env -> default).
-        self.conf = ConfService(self.registry)
+        self.conf = ConfService(
+            self.registry,
+            encryptor=self._build_encryptor(self.base_dir),
+        )
         conf = self.conf
         monitor_interval = (
             monitor_interval
@@ -388,6 +391,25 @@ class Orchestrator:
         lease = self.registry.get_option(self.LEASE_KEY)
         if lease and lease.get("owner") == self._lease_id:
             self.registry.delete_option(self.LEASE_KEY)
+
+    @staticmethod
+    def _build_encryptor(base_dir: Path):
+        """Secret-at-rest encryptor, or None when `cryptography` is absent
+        (optional dependency): secrets then store plaintext — the pre-
+        round-4 behavior — rather than bricking every startup."""
+        try:
+            from polyaxon_tpu.conf.encryptor import Encryptor
+
+            return Encryptor.from_base_dir(base_dir)
+        except ImportError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "cryptography not installed — secret options will be stored "
+                "unencrypted (pip install cryptography to enable at-rest "
+                "encryption)"
+            )
+            return None
 
     def stop(self) -> None:
         stopper = getattr(self, "_lease_stop", None)

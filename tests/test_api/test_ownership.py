@@ -1,0 +1,320 @@
+"""Project-scoped access control + secret-option encryption at rest.
+
+Parity: reference ``ownership/`` + ``scopes/`` (projects owned by a user,
+shared with collaborators, invisible to everyone else) and ``encryptor/``
+(secret settings Fernet-wrapped before they touch the database).
+"""
+
+import asyncio
+
+import pytest
+
+from polyaxon_tpu.api.app import create_app
+from polyaxon_tpu.orchestrator import Orchestrator
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+ROOT = "root-secret"
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(tmp_path / "plat", monitor_interval=0.05)
+    yield o
+    o.stop()
+
+
+def drive(orch, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        app = create_app(orch, auth_token=ROOT)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def hdr(token):
+    return {"Authorization": f"Bearer {token}"}
+
+
+class TestProjectACLs:
+    def test_registry_access_rules(self, tmp_registry):
+        tmp_registry.create_project("open")
+        tmp_registry.create_project("mine", owner="alice")
+        tmp_registry.add_collaborator("mine", "bob")
+        assert tmp_registry.project_access("open", "anyone")
+        assert tmp_registry.project_access("unregistered", None)
+        assert tmp_registry.project_access("mine", "alice")
+        assert tmp_registry.project_access("mine", "bob")
+        assert not tmp_registry.project_access("mine", "carol")
+        assert not tmp_registry.project_access("mine", None)
+        tmp_registry.remove_collaborator("mine", "bob")
+        assert not tmp_registry.project_access("mine", "bob")
+
+    def test_owned_project_scopes_runs_end_to_end(self, orch):
+        _, alice_tok = orch.registry.create_user("alice")
+        _, bob_tok = orch.registry.create_user("bob")
+        _, carol_tok = orch.registry.create_user("carol")
+
+        async def body(client):
+            # Alice creates a project (she owns it) and runs in it.
+            resp = await client.post(
+                "/api/v1/projects", json={"name": "secret-proj"},
+                headers=hdr(alice_tok),
+            )
+            assert resp.status == 201
+            assert (await resp.json())["owner"] == "alice"
+            resp = await client.post(
+                "/api/v1/runs",
+                json={"spec": SPEC, "project": "secret-proj", "name": "r1"},
+                headers=hdr(alice_tok),
+            )
+            assert resp.status == 201
+            run_id = (await resp.json())["id"]
+
+            # Carol (no relation): submit denied, detail denied, project
+            # invisible in listings, run invisible in /runs.
+            resp = await client.post(
+                "/api/v1/runs",
+                json={"spec": SPEC, "project": "secret-proj"},
+                headers=hdr(carol_tok),
+            )
+            assert resp.status == 403
+            resp = await client.get(
+                f"/api/v1/runs/{run_id}", headers=hdr(carol_tok)
+            )
+            assert resp.status == 403
+            resp = await client.get(
+                f"/api/v1/runs/{run_id}/logs", headers=hdr(carol_tok)
+            )
+            assert resp.status == 403
+            resp = await client.post(
+                f"/api/v1/runs/{run_id}/stop", headers=hdr(carol_tok)
+            )
+            assert resp.status == 403
+            resp = await client.get("/api/v1/runs", headers=hdr(carol_tok))
+            assert (await resp.json())["results"] == []
+            resp = await client.get("/api/v1/projects", headers=hdr(carol_tok))
+            assert "secret-proj" not in [
+                p["name"] for p in (await resp.json())["results"]
+            ]
+            resp = await client.get(
+                "/api/v1/projects/secret-proj", headers=hdr(carol_tok)
+            )
+            assert resp.status == 403
+
+            # Alice shares with Bob; Bob can now see and act.
+            resp = await client.post(
+                "/api/v1/projects/secret-proj/collaborators",
+                json={"username": "bob"},
+                headers=hdr(alice_tok),
+            )
+            assert resp.status == 201
+            assert (await resp.json())["collaborators"] == ["bob"]
+            resp = await client.get(
+                f"/api/v1/runs/{run_id}", headers=hdr(bob_tok)
+            )
+            assert resp.status == 200
+            resp = await client.get("/api/v1/runs", headers=hdr(bob_tok))
+            assert [r["id"] for r in (await resp.json())["results"]] == [run_id]
+
+            # Carol cannot share herself in; Bob (collaborator, not owner)
+            # cannot manage sharing either; the admin token can.
+            for tok in (carol_tok, bob_tok):
+                resp = await client.post(
+                    "/api/v1/projects/secret-proj/collaborators",
+                    json={"username": "carol"},
+                    headers=hdr(tok),
+                )
+                assert resp.status == 403
+            resp = await client.delete(
+                "/api/v1/projects/secret-proj/collaborators/bob",
+                headers=hdr(ROOT),
+            )
+            assert resp.status == 200
+            resp = await client.get(
+                f"/api/v1/runs/{run_id}", headers=hdr(bob_tok)
+            )
+            assert resp.status == 403
+
+            # Admin always sees everything.
+            resp = await client.get(f"/api/v1/runs/{run_id}", headers=hdr(ROOT))
+            assert resp.status == 200
+            return True
+
+        assert drive(orch, body)
+
+    def test_ownerless_projects_stay_open_under_auth(self, orch):
+        _, alice_tok = orch.registry.create_user("alice")
+        _, bob_tok = orch.registry.create_user("bob")
+
+        async def body(client):
+            # An explicit null owner makes a deliberately open project
+            # (creators own by default otherwise — even root).
+            resp = await client.post(
+                "/api/v1/projects",
+                json={"name": "shared", "owner": None},
+                headers=hdr(ROOT),
+            )
+            assert (await resp.json())["owner"] is None
+            resp = await client.post(
+                "/api/v1/runs",
+                json={"spec": SPEC, "project": "shared"},
+                headers=hdr(alice_tok),
+            )
+            run_id = (await resp.json())["id"]
+            resp = await client.get(
+                f"/api/v1/runs/{run_id}", headers=hdr(bob_tok)
+            )
+            assert resp.status == 200
+            return True
+
+        assert drive(orch, body)
+
+    def test_cannot_take_over_run_implied_project(self, orch):
+        """Registering ownership over a project other users' runs already
+        imply would 403 them out of their own runs — admins only."""
+        _, alice_tok = orch.registry.create_user("alice")
+        _, bob_tok = orch.registry.create_user("bob")
+
+        async def body(client):
+            resp = await client.post(
+                "/api/v1/runs",
+                json={"spec": SPEC, "project": "ml"},
+                headers=hdr(bob_tok),
+            )
+            run_id = (await resp.json())["id"]
+            # Alice cannot claim 'ml'...
+            resp = await client.post(
+                "/api/v1/projects", json={"name": "ml"}, headers=hdr(alice_tok)
+            )
+            assert resp.status == 403
+            # ...nor mint a project owned by someone else.
+            resp = await client.post(
+                "/api/v1/projects",
+                json={"name": "other", "owner": "carol"},
+                headers=hdr(alice_tok),
+            )
+            assert resp.status == 403
+            # Bob keeps access to his run throughout.
+            resp = await client.get(
+                f"/api/v1/runs/{run_id}", headers=hdr(bob_tok)
+            )
+            assert resp.status == 200
+            # An ownerless registration of the implied name is fine.
+            resp = await client.post(
+                "/api/v1/projects",
+                json={"name": "ml", "owner": None},
+                headers=hdr(alice_tok),
+            )
+            assert resp.status == 201
+            return True
+
+        assert drive(orch, body)
+
+    def test_acl_filter_applies_before_pagination(self, orch):
+        """A page full of invisible runs must not mask accessible ones
+        beyond it (filter-then-slice, not slice-then-filter)."""
+        _, alice_tok = orch.registry.create_user("alice")
+        _, bob_tok = orch.registry.create_user("bob")
+
+        async def body(client):
+            await client.post(
+                "/api/v1/projects", json={"name": "private"},
+                headers=hdr(alice_tok),
+            )
+            # Bob's run first (older), then newer private runs by alice.
+            resp = await client.post(
+                "/api/v1/runs", json={"spec": SPEC, "project": "open"},
+                headers=hdr(bob_tok),
+            )
+            bob_run = (await resp.json())["id"]
+            for _ in range(3):
+                await client.post(
+                    "/api/v1/runs", json={"spec": SPEC, "project": "private"},
+                    headers=hdr(alice_tok),
+                )
+            resp = await client.get("/api/v1/runs?limit=3", headers=hdr(bob_tok))
+            ids = [r["id"] for r in (await resp.json())["results"]]
+            assert ids == [bob_run]
+            return True
+
+        assert drive(orch, body)
+
+    def test_only_owner_or_admin_deletes_project(self, orch):
+        _, alice_tok = orch.registry.create_user("alice")
+        _, bob_tok = orch.registry.create_user("bob")
+
+        async def body(client):
+            await client.post(
+                "/api/v1/projects", json={"name": "p"}, headers=hdr(alice_tok)
+            )
+            await client.post(
+                "/api/v1/projects/p/collaborators",
+                json={"username": "bob"},
+                headers=hdr(alice_tok),
+            )
+            resp = await client.delete("/api/v1/projects/p", headers=hdr(bob_tok))
+            assert resp.status == 403
+            resp = await client.delete("/api/v1/projects/p", headers=hdr(alice_tok))
+            assert resp.status == 200
+            return True
+
+        assert drive(orch, body)
+
+
+class TestSecretEncryption:
+    def test_secret_option_encrypted_at_rest(self, orch):
+        orch.conf.set("notifier.email_password", "hunter2")
+        stored = orch.registry.get_option("notifier.email_password")
+        assert stored.startswith("enc:v1:")
+        assert "hunter2" not in stored
+        orch.conf.invalidate()
+        assert orch.conf.get("notifier.email_password") == "hunter2"
+
+    def test_legacy_plaintext_secret_reads_through(self, orch):
+        # A row written before encryption existed must keep working.
+        orch.registry.set_option("notifier.email_password", "old-plain")
+        orch.conf.invalidate()
+        assert orch.conf.get("notifier.email_password") == "old-plain"
+
+    def test_non_secret_options_stay_plaintext(self, orch):
+        orch.conf.set("notifier.email_host", "smtp.example.com")
+        assert (
+            orch.registry.get_option("notifier.email_host") == "smtp.example.com"
+        )
+
+    def test_keyfile_created_0600_and_stable(self, tmp_path):
+        import stat
+
+        from polyaxon_tpu.conf.encryptor import Encryptor
+
+        enc = Encryptor.from_base_dir(tmp_path)
+        keyfile = tmp_path / ".secret_key"
+        assert keyfile.exists()
+        assert stat.S_IMODE(keyfile.stat().st_mode) == 0o600
+        token = enc.encrypt("s3cret")
+        # A second instance (fresh process) reads the same key back.
+        enc2 = Encryptor.from_base_dir(tmp_path)
+        assert enc2.decrypt(token) == "s3cret"
+
+    def test_wrong_key_is_loud(self, tmp_path):
+        from polyaxon_tpu.conf.encryptor import EncryptionError, Encryptor
+
+        (tmp_path / "a").mkdir()
+        (tmp_path / "b").mkdir()
+        token = Encryptor.from_base_dir(tmp_path / "a").encrypt("x")
+        with pytest.raises(EncryptionError):
+            Encryptor.from_base_dir(tmp_path / "b").decrypt(token)
