@@ -82,11 +82,15 @@ def main() -> None:
     mesh_axes = {"data": jax.local_device_count()}
     mesh = build_mesh(mesh_axes)
     template = template_for("ddp", mesh_axes)
+    # bf16 first moment: halves adam-mu HBM traffic in the update step —
+    # measured +2.6% MFU on v5e (0.529 → 0.543); loss curve unchanged at
+    # bench scale (docs/bench-notes.md).
+    optimizer = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
     ts = build_train_step(
         loss_fn=lambda p, b: loss_fn(p, b, cfg, template=template, mesh=mesh),
         init_fn=lambda k: init_params(k, cfg),
         axes_tree=param_axes(cfg),
-        optimizer=optax.adamw(3e-4),
+        optimizer=optimizer,
         mesh=mesh,
         template=template,
     )
@@ -162,6 +166,40 @@ def main() -> None:
                 "mfu": round(ltps * lfpt / peak, 4),
             }
             del lparams, lopt, lbatch
+            gc.collect()
+            # Capability stretch: T=16384 through the ring path on one
+            # device (sp_ring, n=1 — the flash block kernel over the full
+            # sequence inside the ring body). 2x the old context ceiling.
+            rcfg = cfg.scaled(max_seq=16384, attention_impl="flash")
+            rmesh_axes = {"sequence": 1}
+            rmesh = build_mesh(rmesh_axes)
+            rtmpl = template_for("sp_ring", rmesh_axes)
+            rts = build_train_step(
+                loss_fn=lambda p, b: loss_fn(p, b, rcfg, template=rtmpl, mesh=rmesh),
+                init_fn=lambda k: init_params(k, rcfg),
+                axes_tree=param_axes(rcfg),
+                optimizer=optimizer,
+                mesh=rmesh,
+                template=rtmpl,
+            )
+            rparams, ropt = rts.init(key)
+            rtok = rng.integers(0, rcfg.vocab_size, (1, 16384 + 1))
+            rbatch = rts.place_batch(
+                {"tokens": jnp.asarray(rtok[:, :-1]), "targets": jnp.asarray(rtok[:, 1:])}
+            )
+            for _ in range(2):
+                rparams, ropt, rm = rts.step(rparams, ropt, rbatch, key)
+            float(rm["loss"])
+            rt0 = time.perf_counter()
+            for _ in range(4):
+                rparams, ropt, rm = rts.step(rparams, ropt, rbatch, key)
+            float(rm["loss"])
+            rdt = time.perf_counter() - rt0
+            rtps = 4 * 16384 / rdt
+            rfpt = 6 * rcfg.n_params + 12 * rcfg.n_layers * rcfg.n_heads * rcfg.head_dim * 16384
+            longctx["ring_t16384_tokens_per_s"] = round(rtps)
+            longctx["ring_t16384_mfu"] = round(rtps * rfpt / peak, 4)
+            del rparams, ropt, rbatch
         except Exception:
             # null in the output = degraded gracefully, but the reason must
             # be visible (a flash-path regression is not an OOM).
@@ -215,15 +253,23 @@ def main() -> None:
 
     baseline_path = Path(__file__).parent / "BENCH_BASELINE.json"
     vs_baseline = 1.0
+    longctx_vs_baseline = None
     if on_tpu:
-        if baseline_path.exists():
-            base = json.loads(baseline_path.read_text()).get("tokens_per_s", 0)
-            if base:
-                vs_baseline = tokens_per_s / base
+        base = json.loads(baseline_path.read_text()) if baseline_path.exists() else {}
+        if base.get("tokens_per_s"):
+            vs_baseline = tokens_per_s / base["tokens_per_s"]
         else:
-            baseline_path.write_text(
-                json.dumps({"tokens_per_s": tokens_per_s, "mfu": mfu})
-            )
+            base["tokens_per_s"], base["mfu"] = tokens_per_s, mfu
+        # The long-context metric is baselined too (round-3 weak #5: a
+        # flash regression must not ship silently behind the headline).
+        if longctx is not None:
+            if base.get("longctx_tokens_per_s"):
+                longctx_vs_baseline = round(
+                    longctx["tokens_per_s"] / base["longctx_tokens_per_s"], 3
+                )
+            else:
+                base["longctx_tokens_per_s"] = longctx["tokens_per_s"]
+        baseline_path.write_text(json.dumps(base))
 
     print(
         json.dumps(
@@ -241,6 +287,7 @@ def main() -> None:
                     round(trials_per_hour) if trials_per_hour else None
                 ),
                 "longctx_flash_t8192": longctx,
+                "longctx_vs_baseline": longctx_vs_baseline,
             }
         )
     )

@@ -194,19 +194,15 @@ def _flash_attention(q, k, v):
 
     The HBM-bandwidth win the reference could never express (its compute
     lived in user containers): the score matrix never leaves VMEM, so long
-    sequences fit without remat. Layout adapter: model is [B,T,H,d],
-    kernel wants [B,H,T,d].
+    sequences fit without remat.  Uses the framework's own kernel
+    (parallel/flash.py — the ring body's block kernel over the full
+    sequence): measured 1.9x the jax-bundled pallas kernel in full train
+    steps at T=8192 on v5e.
     """
-    from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+    from polyaxon_tpu.parallel.flash import _on_tpu, flash_attention
 
-    out = flash_attention(
-        q.transpose(0, 2, 1, 3),
-        k.transpose(0, 2, 1, 3),
-        v.transpose(0, 2, 1, 3),
-        causal=True,
-        sm_scale=q.shape[-1] ** -0.5,
-    )
-    return out.transpose(0, 2, 1, 3)
+    cfg = (q.shape[-1] ** -0.5, 256, 256, not _on_tpu())
+    return flash_attention(cfg, q, k, v)
 
 
 def _use_flash(
@@ -218,11 +214,12 @@ def _use_flash(
         return True
     # auto: only when attention runs unsharded on a TPU backend, and only
     # where the O(T) memory matters. Measured on v5e-1, FULL train steps
-    # (remat, 671M params): dense wins wherever it fits — 0.52 vs n/a at
-    # T=1024, 0.39 vs 0.25 at T=2048, 0.32 vs 0.18 at T=4096 — and OOMs at
-    # T=8192 (25.7G > 15.75G HBM) where flash runs at 4.4k tok/s. The
-    # kernel's value in training is CAPABILITY (long context fits), so auto
-    # switches only at the memory wall.
+    # (remat, 671M params, round-4 kernel): dense wins narrowly wherever
+    # it fits — 0.39 vs 0.38 at T=2048, 0.325 vs 0.317 at T=4096 — and
+    # OOMs at T=8192 (25.7G > 15.75G HBM) where flash runs at 8.4k tok/s
+    # (1.9x the jax-bundled kernel r3 shipped). The kernel's value in
+    # training is CAPABILITY (long context fits), so auto switches only
+    # at the memory wall.
     if seq_len < 8192:
         return False
     if pipeline_axis is not None or (mesh is not None and mesh.size > 1):
