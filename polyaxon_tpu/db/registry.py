@@ -857,16 +857,20 @@ class RunRegistry:
         return cur.rowcount + packed.rowcount
 
     @staticmethod
-    def _family_clause(accelerator: str, prefix: str = "") -> Tuple[str, Tuple[Any, ...]]:
+    def _family_clause(
+        accelerator: str, prefix: str = "", col: Optional[str] = None
+    ) -> Tuple[str, Tuple[Any, ...]]:
         """Family matching shared by acquire and the free count (they MUST
         agree or hp_start dispatches trials that then fail admission).
 
         Exact-name-or-dash-prefix: family ``v5e`` matches ``v5e`` and
         ``v5e-*`` but never ``v5`` → ``v5e-8`` (prefix LIKE would) —
-        cross-generation chips aren't fungible.
+        cross-generation chips aren't fungible.  ``col`` overrides the
+        matched column/expression outright (queued-run counting matches a
+        json_extract of the spec).
         """
         family = accelerator_family(accelerator)
-        col = f"{prefix}accelerator"
+        col = col or f"{prefix}accelerator"
         clause = f"({col} = ? OR {col} LIKE ? ESCAPE '\\')"
         like = family.replace("\\", "\\\\").replace("%", "\\%").replace("_", "\\_")
         return clause, (family, like + "-%")
@@ -916,6 +920,33 @@ class RunRegistry:
             family_params,
         ).fetchall()
         return sum(r["free_chips"] // chips for r in rows if r["free_chips"] >= chips)
+
+    def queued_chips_count(self, accelerator: str) -> int:
+        """Total CHIPS queued for this accelerator family — capacity
+        already spoken for but not yet claimed.  hp_start converts this
+        into its own slot units and subtracts it from the free count so
+        two sweeps reading the same snapshot don't both dispatch into it
+        (the losers would park QUEUED while holding their group's
+        concurrency window — wave stalls).  Chips, not run counts: a
+        queued 16-chip gang spends four of a 4-chip sweep's slots, and
+        eight queued 1-chip trials spend two — run counting would get
+        both wrong."""
+        family_clause, family_params = self._family_clause(
+            accelerator,
+            col="COALESCE(json_extract(spec,"
+            " '$.environment.topology.accelerator'), 'cpu')",
+        )
+        row = self._conn().execute(
+            f"""SELECT SUM(
+                    COALESCE(json_extract(spec,
+                        '$.environment.topology.num_devices'), 1)
+                    * COALESCE(json_extract(spec,
+                        '$.environment.topology.num_slices'), 1)
+                ) AS chips
+                FROM runs WHERE status = 'queued' AND {family_clause}""",
+            family_params,
+        ).fetchone()
+        return int(row["chips"] or 0)
 
     # -- iterations (hpsearch) ------------------------------------------------
     def create_iteration(self, group_id: int, data: Dict[str, Any]) -> int:

@@ -145,8 +145,19 @@ def register_hp_tasks(ctx: HPContext) -> None:
             num_hosts=int(topo.num_hosts) * int(topo.num_slices),
         )
         if free is not None:
+            # Conservative window: capacity already QUEUED for this family
+            # (any group, or standalone) has first claim on the free
+            # count — two sweeps reading the same snapshot must not both
+            # dispatch into it (the loser's trials park QUEUED while
+            # holding their group's concurrency window: wave stalls).
+            # Queued CHIPS convert into this sweep's slot units.
+            spoken_chips = reg.queued_chips_count(topo.accelerator)
+            spoken_slots = -(-spoken_chips // max(1, per_slice))  # ceil
             # A multi-slice trial consumes num_slices whole slices.
-            window = min(window, free // max(1, int(topo.num_slices)))
+            window = min(
+                window,
+                max(0, free - spoken_slots) // max(1, int(topo.num_slices)),
+            )
         for t in pending[:window]:
             # Mark the trial dispatched BEFORE sending: a trial sitting in
             # the bus queue must not look pending to the next HP_START

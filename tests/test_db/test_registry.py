@@ -186,6 +186,39 @@ class TestDevices:
         assert reg.release_devices(1) == 1
         assert reg.free_slice_count("v5e-8", 8) == 1
 
+    def test_queued_chips_count_by_family(self, reg):
+        """QUEUED capacity is counted in CHIPS (a 16-chip gang spends four
+        of a 4-chip sweep's slots): hp_start subtracts it from the free
+        window so racing sweeps don't over-dispatch."""
+
+        def mk(accel, status, devices=1, slices=1):
+            run = reg.create_run(
+                {
+                    "kind": "experiment",
+                    "run": {"cmd": "true"},
+                    "environment": {
+                        "topology": {
+                            "accelerator": accel,
+                            "num_devices": devices,
+                            "num_hosts": 1,
+                            "num_slices": slices,
+                        }
+                    },
+                }
+            )
+            if status != "created":
+                reg.set_status(run.id, status)
+            return run
+
+        mk("v5e-8", "queued", devices=8)
+        mk("v5e-4", "queued", devices=4, slices=2)  # multi-slice: 8 total
+        mk("v5p-8", "queued", devices=8)  # other family
+        r = mk("v5e-8", "queued", devices=8)
+        reg.set_status(r.id, "building")  # left the queue
+        assert reg.queued_chips_count("v5e") == 16
+        assert reg.queued_chips_count("v5p-8") == 8
+        assert reg.queued_chips_count("cpu") == 0
+
     def test_multi_host_gang_needs_whole_unpacked_slice(self, reg):
         """Gangs spanning hosts claim exclusively: a packed trial on the
         slice blocks them (an ICI world is one jax.distributed job), and
