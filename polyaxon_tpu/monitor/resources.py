@@ -60,6 +60,36 @@ def sample_devices() -> Dict[str, float]:
     return out
 
 
+def sample_tpu_utilization() -> Dict[str, float]:
+    """TensorCore duty cycle per chip via the ``tpu_info`` library (the
+    gpustat analogue — reference ``monitor_resources/monitor.py:30-34``
+    polled NVML; on TPU-VMs the equivalent is libtpu's metrics endpoint,
+    which ``tpu-info`` wraps).  Gated: returns {} wherever the library or
+    the endpoint is absent (CPU test boxes, tunneled single-chip dev), so
+    the sampler composes it unconditionally."""
+    out: Dict[str, float] = {}
+    try:
+        from tpu_info import device as tpu_device
+        from tpu_info import metrics as tpu_metrics
+
+        chip_type, count = tpu_device.get_local_chips()
+        if not chip_type or not count:
+            return out
+        for i, usage in enumerate(tpu_metrics.get_chip_usage(chip_type)):
+            duty = getattr(usage, "duty_cycle_pct", None)
+            if duty is not None:
+                out[f"sys/tpu{i}_duty_pct"] = float(duty)
+            used = getattr(usage, "memory_usage", None)
+            total = getattr(usage, "total_memory", None)
+            if used is not None:
+                out[f"sys/tpu{i}_mem_mb"] = float(used) / 1e6
+            if used is not None and total:
+                out[f"sys/tpu{i}_mem_frac"] = float(used) / float(total)
+    except Exception:
+        pass
+    return out
+
+
 class ResourceSampler:
     """Background thread reporting resource samples at an interval."""
 
@@ -76,6 +106,7 @@ class ResourceSampler:
     def sample_once(self) -> Dict[str, Any]:
         values = sample_process(self.pid)
         values.update(sample_devices())
+        values.update(sample_tpu_utilization())
         return values
 
     def start(self) -> None:

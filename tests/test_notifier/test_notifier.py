@@ -91,3 +91,37 @@ class TestResources:
         time.sleep(0.2)
         s.stop()
         assert rec.rows and "sys/rss_mb" in rec.rows[0]
+
+    def test_tpu_utilization_via_stubbed_tpu_info(self, monkeypatch):
+        """Duty-cycle telemetry (the gpustat analogue) reads through the
+        tpu_info surface; stubbed here — the library only exists on real
+        TPU-VM hosts."""
+        import sys
+        import types
+
+        from polyaxon_tpu.monitor.resources import sample_tpu_utilization
+
+        class Usage:
+            duty_cycle_pct = 87.5
+            memory_usage = 8_000_000_000
+            total_memory = 16_000_000_000
+
+        device = types.ModuleType("tpu_info.device")
+        device.get_local_chips = lambda: ("v5e", 1)
+        metrics = types.ModuleType("tpu_info.metrics")
+        metrics.get_chip_usage = lambda chip_type: [Usage()]
+        pkg = types.ModuleType("tpu_info")
+        pkg.device, pkg.metrics = device, metrics
+        monkeypatch.setitem(sys.modules, "tpu_info", pkg)
+        monkeypatch.setitem(sys.modules, "tpu_info.device", device)
+        monkeypatch.setitem(sys.modules, "tpu_info.metrics", metrics)
+
+        values = sample_tpu_utilization()
+        assert values["sys/tpu0_duty_pct"] == 87.5
+        assert values["sys/tpu0_mem_mb"] == 8000.0
+        assert values["sys/tpu0_mem_frac"] == 0.5
+
+    def test_tpu_utilization_absent_library_degrades_to_empty(self):
+        from polyaxon_tpu.monitor.resources import sample_tpu_utilization
+
+        assert sample_tpu_utilization() == {}
