@@ -193,8 +193,12 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         # In-process filters (residual DSL conditions, project ACLs under
         # auth) must see the FULL result set before pagination — slicing
         # first would return empty/short pages while accessible runs sit
-        # beyond them.
-        post_filter = bool(residual) or request.get("auth_required", False)
+        # beyond them.  Admins skip the ACL fetch-all: the filter is a
+        # no-op for them and SQL LIMIT/OFFSET is exact.
+        post_filter = bool(residual) or (
+            request.get("auth_required", False)
+            and request.get("role") != "admin"
+        )
         runs = reg.list_runs(
             kind=q.get("kind"),
             project=q.get("project"),

@@ -310,6 +310,8 @@ class LocalClient:
         )
 
     def share_project(self, name, username):
+        if self.orch.registry.get_project(name) is None:
+            raise SystemExit(f"no project named {name!r}")
         self.orch.registry.add_collaborator(name, username)
         return self.orch.registry.get_project(name)
 

@@ -56,7 +56,12 @@ class Encryptor:
             return cls(path.read_bytes().strip())
         path.parent.mkdir(parents=True, exist_ok=True)
         key = Fernet.generate_key()
-        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+        try:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+        except FileExistsError:
+            # Two processes raced first use over a shared base dir (server
+            # + CLI); the loser reads the winner's key.
+            return cls(path.read_bytes().strip())
         try:
             os.write(fd, key)
         finally:
