@@ -723,16 +723,118 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         _audit(request, EventTypes.USER_DELETED, username=request.match_info["username"])
         return web.json_response({"ok": True})
 
+    # -- SSO (reference polyaxon/sso/ provider wizards) ------------------------
+    from polyaxon_tpu.api.sso import (
+        CALLBACK_HTML,
+        SSOError,
+        StateStore,
+        authenticate,
+        authorize_redirect_url,
+        resolve_provider,
+    )
+
+    sso_states = StateStore()
+
+    def _sso_redirect_uri(request) -> str:
+        base = orch.conf.get("sso.redirect_base") or f"{request.scheme}://{request.host}"
+        return f"{base.rstrip('/')}/auth/sso/callback"
+
+    @routes.get("/auth/sso/login")
+    async def sso_login(request):
+        provider = resolve_provider(orch.conf)
+        if provider is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "SSO is not configured"}),
+                content_type="application/json",
+            )
+        raise web.HTTPFound(
+            authorize_redirect_url(
+                provider,
+                client_id=orch.conf.get("sso.client_id"),
+                redirect_uri=_sso_redirect_uri(request),
+                state=sso_states.issue(),
+            )
+        )
+
+    @routes.get("/auth/sso/callback")
+    async def sso_callback(request):
+        provider = resolve_provider(orch.conf)
+        if provider is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "SSO is not configured"}),
+                content_type="application/json",
+            )
+        q = request.rel_url.query
+        if not sso_states.redeem(q.get("state")):
+            return web.json_response(
+                {"error": "invalid or expired SSO state"}, status=403
+            )
+        code = q.get("code")
+        if not code:
+            return web.json_response({"error": "missing code"}, status=400)
+        try:
+            username = await authenticate(
+                provider,
+                code=code,
+                client_id=orch.conf.get("sso.client_id"),
+                client_secret=orch.conf.get("sso.client_secret") or "",
+                redirect_uri=_sso_redirect_uri(request),
+            )
+        except SSOError as e:
+            return web.json_response({"error": str(e)}, status=502)
+        # Provisioning gate: a verified provider identity is NOT platform
+        # membership — on a public provider that would open the door to
+        # every account there.  Existing same-provider users log in;
+        # everyone else needs the allowlist (or the explicit auto_create
+        # opt-in).
+        existing = reg.get_user(username)
+        is_returning = (
+            existing is not None
+            and existing.get("sso_provider") == provider.name
+        )
+        allowed = {
+            u.strip()
+            for u in (orch.conf.get("sso.allowed_users") or "").split(",")
+            if u.strip()
+        }
+        if not is_returning and username not in allowed and not orch.conf.get(
+            "sso.auto_create"
+        ):
+            return web.json_response(
+                {
+                    "error": f"{provider.name} user {username!r} is not "
+                    "authorized for this platform (ask an admin to add you "
+                    "to sso.allowed_users)"
+                },
+                status=403,
+            )
+        try:
+            user, token = reg.ensure_sso_user(provider.name, username)
+        except PolyaxonTPUError as e:
+            # A colliding local/foreign-provider account: never taken over.
+            return web.json_response({"error": str(e)}, status=409)
+        if user.get("created"):
+            orch.auditor.record(
+                EventTypes.USER_CREATED, username=username, sso=provider.name
+            )
+        return web.Response(
+            text=CALLBACK_HTML.format(token=token), content_type="text/html"
+        )
+
     @web.middleware
     async def auth_middleware(request, handler):
-        # "/" (the static dashboard shell — no data in it) and the health
-        # endpoint stay open; the dashboard's API fetches carry the bearer
-        # token the user supplies once via ?token=.  Auth is required when
-        # a bootstrap token is configured OR any user exists (checked per
-        # request — users can be minted at runtime).
+        # "/" (the static dashboard shell — no data in it), the health
+        # endpoint, and the SSO entry/callback (the way IN) stay open; the
+        # dashboard's API fetches carry the bearer token from
+        # localStorage.  Auth is required when a bootstrap token is
+        # configured OR any user exists (checked per request — users can
+        # be minted at runtime).
         open_paths = ("/", f"{API_PREFIX}/status")
         required = bool(auth_token) or reg.has_users()
         request["auth_required"] = required
+        if request.path.startswith("/auth/sso/"):
+            request["actor"], request["role"] = None, None
+            return await handler(request)
         if required and request.path not in open_paths:
             resolved = _resolve_actor(request)
             if resolved is None:

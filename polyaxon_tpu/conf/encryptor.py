@@ -56,17 +56,27 @@ class Encryptor:
             return cls(path.read_bytes().strip())
         path.parent.mkdir(parents=True, exist_ok=True)
         key = Fernet.generate_key()
-        try:
-            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
-        except FileExistsError:
-            # Two processes raced first use over a shared base dir (server
-            # + CLI); the loser reads the winner's key.
-            return cls(path.read_bytes().strip())
+        # Write-then-link: the key is FULLY written to a private temp file
+        # before it becomes visible at the final name, so a process racing
+        # first use (server + CLI over a shared base dir) either wins the
+        # link or reads a complete key — never a partial/empty one.
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=".secret_key.")
         try:
             os.write(fd, key)
-        finally:
+            os.fchmod(fd, 0o600)
             os.close(fd)
-        return cls(key)
+            try:
+                os.link(tmp, path)
+            except FileExistsError:
+                return cls(path.read_bytes().strip())
+            return cls(key)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
     def encrypt(self, value: str) -> str:
         return _PREFIX + self._fernet.encrypt(str(value).encode()).decode()

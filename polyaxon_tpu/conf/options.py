@@ -82,6 +82,28 @@ _ALL = [
            "python interpreter on worker hosts"),
     Option("spawner.coordinator_port_base", int, 8476,
            "base of the 512-wide jax.distributed coordinator port range"),
+    Option("sso.provider", str, "",
+           "single sign-on provider ('' = SSO off; oidc = endpoints from "
+           "sso.*_url)",
+           choices=("", "github", "gitlab", "bitbucket", "azure", "oidc")),
+    Option("sso.client_id", str, "", "OAuth2 client id"),
+    Option("sso.client_secret", str, "", "OAuth2 client secret", secret=True),
+    Option("sso.authorize_url", str, "",
+           "authorize endpoint override (oidc/self-hosted providers)"),
+    Option("sso.token_url", str, "", "token endpoint override"),
+    Option("sso.userinfo_url", str, "", "userinfo endpoint override"),
+    Option("sso.username_field", str, "",
+           "userinfo JSON field naming the user ('' = provider default)"),
+    Option("sso.redirect_base", str, "",
+           "public base URL of this platform for the OAuth callback "
+           "('' = derive from the request)"),
+    Option("sso.allowed_users", str, "",
+           "comma-separated provider usernames allowed to self-provision "
+           "via SSO (existing same-provider users always may log in)"),
+    Option("sso.auto_create", bool, False,
+           "create a platform user for ANY provider identity — on a "
+           "public provider this opens the platform to every account "
+           "there; prefer the allowlist"),
     Option("provision.zone", str, "",
            "GCE zone for tpu-vm provisioning (e.g. us-central2-b); "
            "'' disables the pools commands"),

@@ -311,6 +311,11 @@ class RunRegistry:
             if "owner" not in proj_cols:
                 # Pre-ownership projects stay ownerless (= open access).
                 conn.execute("ALTER TABLE projects ADD COLUMN owner TEXT")
+            user_cols = {r[1] for r in conn.execute("PRAGMA table_info(users)")}
+            if "sso_provider" not in user_cols:
+                # NULL = locally-created user; set = which SSO provider
+                # owns this identity (no cross-takeover by name collision).
+                conn.execute("ALTER TABLE users ADD COLUMN sso_provider TEXT")
 
     # -- connection management ------------------------------------------------
     def _conn(self) -> sqlite3.Connection:
@@ -1313,6 +1318,81 @@ class RunRegistry:
         except sqlite3.IntegrityError as e:
             raise RegistryError(f"User {username!r} already exists") from e
         return {"id": user_id, "username": username, "role": role}, token
+
+    def ensure_sso_user(
+        self, provider: str, username: str, role: str = "user"
+    ) -> Tuple[Dict[str, Any], str]:
+        """Upsert the SSO identity ``provider:username``, minting a FRESH
+        token (returned once, stored hashed) — every login rotates it, so
+        a stale leaked token dies at the next sign-in.  Existing role is
+        preserved (an admin promoted in-platform stays admin).
+
+        An identity only ever matches a user row CREATED BY THE SAME
+        PROVIDER: a locally-minted user (or another provider's) with a
+        colliding name is a hard error, never a takeover — on a public
+        provider anyone can register any free username."""
+        if role not in ("admin", "user"):
+            raise RegistryError(f"Unknown role {role!r} (admin|user)")
+        import secrets
+
+        token = secrets.token_hex(20)
+        with self._lock, self._conn() as conn:
+            row = conn.execute(
+                "SELECT id, role, sso_provider FROM users WHERE username = ?",
+                (username,),
+            ).fetchone()
+            if row is not None and row["sso_provider"] != provider:
+                kind = (
+                    "locally-created"
+                    if not row["sso_provider"]
+                    else f"{row['sso_provider']}-linked"
+                )
+                raise RegistryError(
+                    f"A {kind} user named {username!r} already exists; "
+                    f"refusing to link the {provider} identity to it"
+                )
+            if row is None:
+                cur = conn.execute(
+                    "INSERT INTO users (username, token_hash, role,"
+                    " sso_provider, created_at) VALUES (?, ?, ?, ?, ?)",
+                    (
+                        username,
+                        self._token_hash(token),
+                        role,
+                        provider,
+                        time.time(),
+                    ),
+                )
+                return (
+                    {
+                        "id": cur.lastrowid,
+                        "username": username,
+                        "role": role,
+                        "created": True,
+                    },
+                    token,
+                )
+            conn.execute(
+                "UPDATE users SET token_hash = ? WHERE id = ?",
+                (self._token_hash(token), row["id"]),
+            )
+            return (
+                {
+                    "id": row["id"],
+                    "username": username,
+                    "role": row["role"],
+                    "created": False,
+                },
+                token,
+            )
+
+    def get_user(self, username: str) -> Optional[Dict[str, Any]]:
+        row = self._conn().execute(
+            "SELECT id, username, role, sso_provider, last_used_at FROM users"
+            " WHERE username = ?",
+            (username,),
+        ).fetchone()
+        return dict(row) if row is not None else None
 
     def get_user_by_token(self, token: str) -> Optional[Dict[str, Any]]:
         row = self._conn().execute(

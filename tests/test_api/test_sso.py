@@ -1,0 +1,331 @@
+"""SSO login flow against a stub OAuth2 provider.
+
+Parity: reference ``polyaxon/sso/`` (GitHub/GitLab/Bitbucket/Azure
+wizards).  The stub provider is a local aiohttp app playing /token and
+/userinfo; the flow under test is the platform's: login redirect with a
+single-use state, server-side code exchange, user upsert with token
+rotation, and the localStorage handoff page.
+"""
+
+import asyncio
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from polyaxon_tpu.api.app import create_app
+from polyaxon_tpu.api.sso import (
+    PROVIDERS,
+    StateStore,
+    authorize_redirect_url,
+    resolve_provider,
+)
+from polyaxon_tpu.orchestrator import Orchestrator
+
+ROOT = "root-secret"
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(tmp_path / "plat", monitor_interval=0.05)
+    yield o
+    o.stop()
+
+
+def make_stub_provider(routes_web, username="octocat", token_status=200):
+    """An aiohttp app standing in for the provider."""
+    from aiohttp import web
+
+    calls = {"token": [], "userinfo": []}
+
+    async def token(request):
+        form = await request.post()
+        calls["token"].append(dict(form))
+        if token_status != 200:
+            return web.json_response({"error": "nope"}, status=token_status)
+        return web.json_response({"access_token": "prov-access-xyz"})
+
+    async def userinfo(request):
+        calls["userinfo"].append(request.headers.get("Authorization"))
+        return web.json_response({"login": username})
+
+    app = web.Application()
+    app.router.add_post("/token", token)
+    app.router.add_get("/userinfo", userinfo)
+    return app, calls
+
+
+def drive(orch, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        app = create_app(orch, auth_token=ROOT)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+class TestProviderCatalog:
+    def test_reference_providers_present(self):
+        assert set(PROVIDERS) == {"github", "gitlab", "bitbucket", "azure", "oidc"}
+        gh = PROVIDERS["github"]
+        assert "github.com" in gh.authorize_url and gh.username_field == "login"
+
+    def test_resolver_off_without_provider_or_client(self, orch):
+        assert resolve_provider(orch.conf) is None
+        orch.conf.set("sso.provider", "github")
+        assert resolve_provider(orch.conf) is None  # no client id
+        orch.conf.set("sso.client_id", "cid")
+        orch.conf.invalidate()
+        assert resolve_provider(orch.conf).name == "github"
+
+    def test_oidc_requires_urls(self, orch):
+        from polyaxon_tpu.api.sso import SSOError
+
+        orch.conf.set("sso.provider", "oidc")
+        orch.conf.set("sso.client_id", "cid")
+        with pytest.raises(SSOError):
+            resolve_provider(orch.conf)
+        orch.conf.set("sso.authorize_url", "https://idp/authorize")
+        orch.conf.set("sso.token_url", "https://idp/token")
+        orch.conf.set("sso.userinfo_url", "https://idp/userinfo")
+        orch.conf.invalidate()
+        assert resolve_provider(orch.conf).authorize_url == "https://idp/authorize"
+
+    def test_authorize_url_carries_state_and_redirect(self):
+        url = authorize_redirect_url(
+            PROVIDERS["github"], "cid", "https://plat/auth/sso/callback", "st8"
+        )
+        q = parse_qs(urlparse(url).query)
+        assert q["client_id"] == ["cid"]
+        assert q["state"] == ["st8"]
+        assert q["redirect_uri"] == ["https://plat/auth/sso/callback"]
+        assert q["response_type"] == ["code"]
+
+
+class TestStateStore:
+    def test_single_use_and_ttl(self):
+        store = StateStore(ttl=0.2)
+        s = store.issue()
+        assert store.redeem(s)
+        assert not store.redeem(s)  # single use
+        s2 = store.issue()
+        import time
+
+        time.sleep(0.25)
+        assert not store.redeem(s2)  # expired
+        assert not store.redeem(None)
+        assert not store.redeem("forged")
+
+
+class TestSSOFlow:
+    def test_full_login_flow_with_stub_provider(self, orch):
+        from aiohttp import web
+        from aiohttp.test_utils import TestClient, TestServer
+
+        stub_app, calls = make_stub_provider(web)
+
+        async def body():
+            stub = TestClient(TestServer(stub_app))
+            await stub.start_server()
+            base = f"http://{stub.host}:{stub.port}"
+            orch.conf.set("sso.provider", "oidc")
+            orch.conf.set("sso.client_id", "cid")
+            orch.conf.set("sso.client_secret", "shh")
+            orch.conf.set("sso.authorize_url", f"{base}/authorize")
+            orch.conf.set("sso.token_url", f"{base}/token")
+            orch.conf.set("sso.userinfo_url", f"{base}/userinfo")
+            orch.conf.set("sso.username_field", "login")
+            orch.conf.set("sso.allowed_users", "octocat, other")
+            orch.conf.invalidate()
+
+            app = create_app(orch, auth_token=ROOT)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                # 1. login redirects to the provider with a state.
+                resp = await client.get(
+                    "/auth/sso/login", allow_redirects=False
+                )
+                assert resp.status == 302
+                loc = resp.headers["Location"]
+                assert loc.startswith(f"{base}/authorize")
+                state = parse_qs(urlparse(loc).query)["state"][0]
+
+                # 2. provider calls back with a code; the platform
+                # exchanges it, fetches the identity, mints a token.
+                resp = await client.get(
+                    f"/auth/sso/callback?code=abc&state={state}"
+                )
+                assert resp.status == 200
+                html = await resp.text()
+                assert "px_token" in html
+                token = html.split("'px_token', '")[1].split("'")[0]
+                # The exchange carried our secret and the code.
+                assert calls["token"][0]["code"] == "abc"
+                assert calls["token"][0]["client_secret"] == "shh"
+                assert calls["userinfo"] == ["Bearer prov-access-xyz"]
+
+                # 3. the minted token authenticates as the SSO identity.
+                resp = await client.get(
+                    "/api/v1/runs",
+                    headers={"Authorization": f"Bearer {token}"},
+                )
+                assert resp.status == 200
+                users = orch.registry.list_users()
+                assert [u["username"] for u in users] == ["octocat"]
+
+                # 4. a second login rotates the token; the old one dies.
+                resp = await client.get(
+                    "/auth/sso/login", allow_redirects=False
+                )
+                state2 = parse_qs(
+                    urlparse(resp.headers["Location"]).query
+                )["state"][0]
+                resp = await client.get(
+                    f"/auth/sso/callback?code=def&state={state2}"
+                )
+                html2 = await resp.text()
+                token2 = html2.split("'px_token', '")[1].split("'")[0]
+                assert token2 != token
+                resp = await client.get(
+                    "/api/v1/runs", headers={"Authorization": f"Bearer {token}"}
+                )
+                assert resp.status == 401
+                resp = await client.get(
+                    "/api/v1/runs", headers={"Authorization": f"Bearer {token2}"}
+                )
+                assert resp.status == 200
+                assert len(orch.registry.list_users()) == 1  # upsert, no dup
+                return True
+            finally:
+                await client.close()
+                await stub.close()
+
+        assert asyncio.run(body())
+
+    def _configured_client(self, orch, stub_base, allowed=""):
+        orch.conf.set("sso.provider", "oidc")
+        orch.conf.set("sso.client_id", "cid")
+        orch.conf.set("sso.authorize_url", f"{stub_base}/authorize")
+        orch.conf.set("sso.token_url", f"{stub_base}/token")
+        orch.conf.set("sso.userinfo_url", f"{stub_base}/userinfo")
+        orch.conf.set("sso.username_field", "login")
+        if allowed:
+            orch.conf.set("sso.allowed_users", allowed)
+        orch.conf.invalidate()
+
+    async def _login(self, client):
+        resp = await client.get("/auth/sso/login", allow_redirects=False)
+        state = parse_qs(urlparse(resp.headers["Location"]).query)["state"][0]
+        return await client.get(f"/auth/sso/callback?code=c&state={state}")
+
+    def test_unknown_identity_cannot_self_provision(self, orch):
+        """A verified provider identity is NOT platform membership: no
+        allowlist entry + no auto_create = 403 (on a public provider the
+        alternative is an open platform)."""
+        from aiohttp import web
+        from aiohttp.test_utils import TestClient, TestServer
+
+        stub_app, _ = make_stub_provider(web, username="rando")
+
+        async def body():
+            stub = TestClient(TestServer(stub_app))
+            await stub.start_server()
+            self._configured_client(orch, f"http://{stub.host}:{stub.port}")
+            app = create_app(orch, auth_token=ROOT)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                resp = await self._login(client)
+                assert resp.status == 403
+                assert orch.registry.list_users() == []
+                # auto_create opt-in opens it.
+                orch.conf.set("sso.auto_create", True)
+                orch.conf.invalidate()
+                resp = await self._login(client)
+                assert resp.status == 200
+                assert [u["username"] for u in orch.registry.list_users()] == [
+                    "rando"
+                ]
+                return True
+            finally:
+                await client.close()
+                await stub.close()
+
+        assert asyncio.run(body())
+
+    def test_provider_identity_cannot_take_over_local_user(self, orch):
+        """A github 'alice' must never inherit the local admin 'alice' —
+        name collisions on public providers are attacker-controlled."""
+        from aiohttp import web
+        from aiohttp.test_utils import TestClient, TestServer
+
+        user, local_token = orch.registry.create_user("alice", role="admin")
+        stub_app, _ = make_stub_provider(web, username="alice")
+
+        async def body():
+            stub = TestClient(TestServer(stub_app))
+            await stub.start_server()
+            self._configured_client(
+                orch, f"http://{stub.host}:{stub.port}", allowed="alice"
+            )
+            app = create_app(orch, auth_token=ROOT)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                resp = await self._login(client)
+                assert resp.status == 409  # refused, not linked
+                # The local admin's token still works (no rotation).
+                resp = await client.get(
+                    "/api/v1/users",
+                    headers={"Authorization": f"Bearer {local_token}"},
+                )
+                assert resp.status == 200
+                return True
+            finally:
+                await client.close()
+                await stub.close()
+
+        assert asyncio.run(body())
+
+    def test_registry_scopes_identity_to_provider(self, tmp_registry):
+        from polyaxon_tpu.db.registry import RegistryError
+
+        _, t1 = tmp_registry.ensure_sso_user("github", "bob")
+        user, t2 = tmp_registry.ensure_sso_user("github", "bob")
+        assert not user["created"] and t1 != t2
+        with pytest.raises(RegistryError):
+            tmp_registry.ensure_sso_user("gitlab", "bob")
+        tmp_registry.create_user("carol")
+        with pytest.raises(RegistryError):
+            tmp_registry.ensure_sso_user("github", "carol")
+
+    def test_state_store_is_bounded(self):
+        store = StateStore(ttl=600.0, max_size=10)
+        for _ in range(50):
+            store.issue()
+        assert len(store._states) <= 10
+
+    def test_callback_rejects_forged_or_replayed_state(self, orch):
+        async def body(client):
+            orch.conf.set("sso.provider", "github")
+            orch.conf.set("sso.client_id", "cid")
+            orch.conf.invalidate()
+            resp = await client.get("/auth/sso/callback?code=x&state=forged")
+            assert resp.status == 403
+            return True
+
+        assert drive(orch, body)
+
+    def test_sso_disabled_404s(self, orch):
+        async def body(client):
+            resp = await client.get("/auth/sso/login", allow_redirects=False)
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)
