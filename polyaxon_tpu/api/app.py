@@ -739,35 +739,57 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         base = orch.conf.get("sso.redirect_base") or f"{request.scheme}://{request.host}"
         return f"{base.rstrip('/')}/auth/sso/callback"
 
-    @routes.get("/auth/sso/login")
-    async def sso_login(request):
-        provider = resolve_provider(orch.conf)
+    def _sso_provider_or_error(request):
+        try:
+            provider = resolve_provider(orch.conf)
+        except SSOError as e:
+            # Half-configured SSO (oidc without endpoint URLs) must fail
+            # with the same clean JSON shape as every other misconfig.
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": str(e)}),
+                content_type="application/json",
+            )
         if provider is None:
             raise web.HTTPNotFound(
                 text=json.dumps({"error": "SSO is not configured"}),
                 content_type="application/json",
             )
-        raise web.HTTPFound(
+        return provider
+
+    @routes.get("/auth/sso/login")
+    async def sso_login(request):
+        provider = _sso_provider_or_error(request)
+        state = sso_states.issue()
+        resp = web.HTTPFound(
             authorize_redirect_url(
                 provider,
                 client_id=orch.conf.get("sso.client_id"),
                 redirect_uri=_sso_redirect_uri(request),
-                state=sso_states.issue(),
+                state=state,
             )
         )
+        # Bind the state to THIS browser: server-side issuance alone can't
+        # stop a login-CSRF where an attacker feeds a victim a callback
+        # URL carrying the attacker's own valid state+code (session
+        # fixation into the attacker's account).
+        resp.set_cookie(
+            "px_sso_state", state, httponly=True, samesite="Lax", max_age=600
+        )
+        raise resp
 
     @routes.get("/auth/sso/callback")
     async def sso_callback(request):
-        provider = resolve_provider(orch.conf)
-        if provider is None:
-            raise web.HTTPNotFound(
-                text=json.dumps({"error": "SSO is not configured"}),
-                content_type="application/json",
-            )
+        provider = _sso_provider_or_error(request)
         q = request.rel_url.query
-        if not sso_states.redeem(q.get("state")):
+        state = q.get("state")
+        if not sso_states.redeem(state):
             return web.json_response(
                 {"error": "invalid or expired SSO state"}, status=403
+            )
+        if request.cookies.get("px_sso_state") != state:
+            return web.json_response(
+                {"error": "SSO state does not match this browser's login"},
+                status=403,
             )
         code = q.get("code")
         if not code:
@@ -817,9 +839,11 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             orch.auditor.record(
                 EventTypes.USER_CREATED, username=username, sso=provider.name
             )
-        return web.Response(
+        resp = web.Response(
             text=CALLBACK_HTML.format(token=token), content_type="text/html"
         )
+        resp.del_cookie("px_sso_state")
+        return resp
 
     @web.middleware
     async def auth_middleware(request, handler):

@@ -59,29 +59,33 @@ def resume_counter(ctx: Context) -> None:
     ctx.log_text(f"resume_counter attempt {n + 1}")
 
 
-def cnn_train(ctx: Context) -> None:
-    """Train the CNN image classifier (the CIFAR-10 quick-start shape).
+def _train_image_classifier(
+    ctx: Context,
+    *,
+    label: str,
+    loss_fn,
+    accuracy_fn,
+    init_fn,
+    axes_tree,
+    optimizer,
+) -> None:
+    """Shared image-classifier train loop (cnn_train / vit_train).
 
-    Two data paths, same train loop:
+    Two data paths, one loop:
 
     - ``dataset: <name>`` — a store-registered dataset (see
-      ``runtime/datasets.py``): host-sharded shard reading, per-epoch
-      shuffles, uint8 on the wire with on-device normalization, and a
-      position-exact resume (the data stream fast-forwards to the restored
-      step).  ``cifar10-train`` after ``register_cifar10`` is the
-      reference's CIFAR-10 guide (``docs/guides/training-cifar10.md``).
+      ``runtime/datasets.py``): host-sharded mmap shard reading, per-epoch
+      shuffles, uint8 on the wire, and a position-exact resume (the data
+      stream fast-forwards to the restored step).  ``cifar10-train`` after
+      ``register_cifar10`` is the reference's CIFAR-10 guide
+      (``docs/guides/training-cifar10.md``).
     - no dataset — synthetic class-conditional images (deterministic from
       the seed), isolating compute+collectives from IO for benchmarks.
-
-    Params: steps, batch (global), image_size, classes, lr, channels,
-    dataset, save_every.
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
-    import optax
 
-    from polyaxon_tpu.models import cnn
     from polyaxon_tpu.parallel import template_for
     from polyaxon_tpu.runtime.data import global_batch_from_host_data
     from polyaxon_tpu.runtime.train import build_train_step
@@ -90,13 +94,8 @@ def cnn_train(ctx: Context) -> None:
     batch_size = int(ctx.get_param("batch", 64))
     image_size = int(ctx.get_param("image_size", 32))
     n_classes = int(ctx.get_param("classes", 10))
-    lr = float(ctx.get_param("lr", 1e-3))
-    channels = tuple(ctx.get_param("channels", (64, 128, 256)))
     dataset = ctx.get_param("dataset")
     save_every = int(ctx.get_param("save_every", 0))
-    cfg = cnn.CNNConfig(
-        image_size=image_size, n_classes=n_classes, channels=channels
-    )
 
     mesh = ctx.mesh
     if mesh is None:
@@ -105,17 +104,11 @@ def cnn_train(ctx: Context) -> None:
         mesh = build_mesh({"data": jax.device_count()})
     template = template_for(ctx.strategy, dict(mesh.shape), ctx.strategy_options)
 
-    def normalized_loss(p, b):
-        # uint8 rides the host→HBM wire (4x smaller than f32); normalize
-        # on device where it fuses into the first conv.
-        images = b["images"].astype(cfg.dtype) / 255.0 - 0.5
-        return cnn.loss_fn(p, {**b, "images": images}, cfg)
-
     ts = build_train_step(
-        loss_fn=normalized_loss,
-        init_fn=lambda k: cnn.init_params(k, cfg),
-        axes_tree=cnn.param_axes(cfg),
-        optimizer=optax.adamw(lr),
+        loss_fn=lambda p, b: loss_fn(p, b, template, mesh),
+        init_fn=init_fn,
+        axes_tree=axes_tree,
+        optimizer=optimizer,
         mesh=mesh,
         template=template,
     )
@@ -175,11 +168,7 @@ def cnn_train(ctx: Context) -> None:
         def next_batch():
             return fixed
 
-    def normalized_accuracy(p, b):
-        images = b["images"].astype(cfg.dtype) / 255.0 - 0.5
-        return cnn.accuracy(p, {**b, "images": images}, cfg)
-
-    acc_fn = jax.jit(normalized_accuracy)
+    acc_fn = jax.jit(lambda p, b: accuracy_fn(p, b, template, mesh))
     t0 = time.time()
     metrics = None
     batch = None
@@ -196,7 +185,7 @@ def cnn_train(ctx: Context) -> None:
     steps_run = steps - start_step
     if steps_run <= 0 or batch is None:
         if ctx.is_leader:
-            ctx.log_text("cnn_train: nothing to do (checkpoint already at end)")
+            ctx.log_text(f"{label}: nothing to do (checkpoint already at end)")
         return
     dt = time.time() - t0
     # Every process must join the (global-array) accuracy computation —
@@ -206,9 +195,88 @@ def cnn_train(ctx: Context) -> None:
         ips = steps_run * batch_size / dt
         ctx.log_metrics(step=steps, accuracy=acc, images_per_s=ips)
         ctx.log_text(
-            f"cnn_train done: {steps} steps, strategy={template.name}, "
+            f"{label} done: {steps} steps, strategy={template.name}, "
             f"loss {float(metrics['loss']):.4f}, acc {acc:.3f}, {ips:.0f} img/s"
         )
+
+
+def cnn_train(ctx: Context) -> None:
+    """Train the CNN image classifier (the CIFAR-10 quick-start shape).
+
+    Params: steps, batch (global), image_size, classes, lr, channels,
+    dataset, save_every — data/checkpoint contracts in
+    :func:`_train_image_classifier`.
+    """
+    import optax
+
+    from polyaxon_tpu.models import cnn
+
+    cfg = cnn.CNNConfig(
+        image_size=int(ctx.get_param("image_size", 32)),
+        n_classes=int(ctx.get_param("classes", 10)),
+        channels=tuple(ctx.get_param("channels", (64, 128, 256))),
+    )
+
+    def normalized(fn):
+        # uint8 rides the host→HBM wire (4x smaller than f32); normalize
+        # on device where it fuses into the first conv.
+        def wrapped(p, b, template, mesh):
+            images = b["images"].astype(cfg.dtype) / 255.0 - 0.5
+            return fn(p, {**b, "images": images}, cfg)
+
+        return wrapped
+
+    _train_image_classifier(
+        ctx,
+        label="cnn_train",
+        loss_fn=normalized(cnn.loss_fn),
+        accuracy_fn=normalized(cnn.accuracy),
+        init_fn=lambda k: cnn.init_params(k, cfg),
+        axes_tree=cnn.param_axes(cfg),
+        optimizer=optax.adamw(float(ctx.get_param("lr", 1e-3))),
+    )
+
+
+def vit_train(ctx: Context) -> None:
+    """Train the Vision Transformer image classifier.
+
+    The ViT family exercises attention/MLP templates (tp/fsdp) the conv
+    net cannot.  Params: steps, batch, image_size, patch_size, classes,
+    lr, d_model, n_layers, n_heads, head_dim, d_ff, dataset, save_every —
+    data/checkpoint contracts in :func:`_train_image_classifier`.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    from polyaxon_tpu.models import vit
+
+    d_model = int(ctx.get_param("d_model", 192))
+    n_heads = int(ctx.get_param("n_heads", 6))
+    cfg = vit.ViTConfig(
+        image_size=int(ctx.get_param("image_size", 32)),
+        patch_size=int(ctx.get_param("patch_size", 4)),
+        n_classes=int(ctx.get_param("classes", 10)),
+        d_model=d_model,
+        n_layers=int(ctx.get_param("n_layers", 6)),
+        n_heads=n_heads,
+        head_dim=int(ctx.get_param("head_dim", max(8, d_model // n_heads))),
+        d_ff=int(ctx.get_param("d_ff", 4 * d_model)),
+    )
+    _train_image_classifier(
+        ctx,
+        label="vit_train",
+        loss_fn=lambda p, b, template, mesh: vit.loss_fn(
+            p, b, cfg, template=template, mesh=mesh
+        ),
+        accuracy_fn=lambda p, b, template, mesh: vit.accuracy(
+            p, b, cfg, template=template, mesh=mesh
+        ),
+        init_fn=lambda k: vit.init_params(k, cfg),
+        axes_tree=vit.param_axes(cfg),
+        optimizer=optax.adamw(
+            float(ctx.get_param("lr", 1e-3)), mu_dtype=jnp.bfloat16
+        ),
+    )
 
 
 def metric_probe(ctx: Context) -> None:

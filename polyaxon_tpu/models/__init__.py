@@ -5,6 +5,7 @@ from polyaxon_tpu.models.transformer import (
     loss_fn,
     param_axes,
 )
+from polyaxon_tpu.models import cnn, vit
 
 __all__ = [
     "TransformerConfig",
@@ -12,4 +13,6 @@ __all__ = [
     "init_params",
     "loss_fn",
     "param_axes",
+    "cnn",
+    "vit",
 ]

@@ -1,0 +1,214 @@
+"""Vision Transformer: the image-classification flagship family.
+
+Parity framing: the reference ships no models (workloads live in user
+containers — SURVEY §2.8); the TPU framework makes them first-class so
+sharding templates apply to vision exactly as to language.  This ViT
+reuses the transformer's design vocabulary end to end:
+
+- **patchify as one einsum** — [B,H,W,C] → [B,N,D] is a single MXU-shaped
+  contraction over (patch_h, patch_w, C), not a conv;
+- **bidirectional attention** (no mask, no rope — learned position
+  embeddings), einsum-only;
+- **stacked layer params + ``lax.scan``** — the same compile-once block
+  body, leading ``layers`` axis ready for pp sharding;
+- **the shared logical-axis names** (``embed``/``heads``/``mlp``/
+  ``vocab``…) — every parallelism template (ddp/fsdp/tp/…) applies with
+  zero model changes;
+- mean-pool head (no CLS token: pooling is free and shards trivially).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from polyaxon_tpu.parallel.axes import AxisRules, with_logical_constraint
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 32
+    patch_size: int = 4
+    in_channels: int = 3
+    d_model: int = 192
+    n_layers: int = 6
+    n_heads: int = 6
+    head_dim: int = 32
+    d_ff: int = 768
+    n_classes: int = 10
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    def scaled(self, **overrides) -> "ViTConfig":
+        return replace(self, **overrides)
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.in_channels
+
+    @property
+    def n_params(self) -> int:
+        c = self
+        attn = c.d_model * c.n_heads * c.head_dim * 4
+        mlp = c.d_model * c.d_ff * 3
+        per_layer = attn + mlp + 2 * c.d_model
+        return (
+            c.patch_dim * c.d_model  # patch embed
+            + c.num_patches * c.d_model  # positions
+            + c.n_layers * per_layer
+            + c.d_model  # final norm
+            + c.d_model * c.n_classes  # head
+        )
+
+
+def param_axes(cfg: ViTConfig) -> Dict[str, Any]:
+    """Logical axes mirror the LM's (``transformer.param_axes``) so the
+    same templates shard both families."""
+    block = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "heads", "head_dim"),
+        "wv": ("layers", "embed", "heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "wi": ("layers", "embed", "mlp"),
+        "wg": ("layers", "embed", "mlp"),
+        "wd": ("layers", "mlp", "embed"),
+    }
+    return {
+        "patch_embed": (None, "embed"),
+        "pos_embed": (None, "embed"),
+        "final_norm": ("embed",),
+        "head": ("embed", "vocab"),
+        "block": block,
+    }
+
+
+def init_params(key: jax.Array, cfg: ViTConfig) -> Dict[str, Any]:
+    c = cfg
+    k = iter(jax.random.split(key, 16))
+    dt = c.param_dtype
+
+    def norm(*shape, scale):
+        return jax.random.normal(next(k), shape, dt) * scale
+
+    L, D, H, hd, F = c.n_layers, c.d_model, c.n_heads, c.head_dim, c.d_ff
+    block = {
+        "attn_norm": jnp.ones((L, D), dt),
+        "wq": norm(L, D, H, hd, scale=D**-0.5),
+        "wk": norm(L, D, H, hd, scale=D**-0.5),
+        "wv": norm(L, D, H, hd, scale=D**-0.5),
+        "wo": norm(L, H, hd, D, scale=(H * hd) ** -0.5),
+        "mlp_norm": jnp.ones((L, D), dt),
+        "wi": norm(L, D, F, scale=D**-0.5),
+        "wg": norm(L, D, F, scale=D**-0.5),
+        "wd": norm(L, F, D, scale=F**-0.5),
+    }
+    return {
+        "patch_embed": norm(c.patch_dim, D, scale=c.patch_dim**-0.5),
+        "pos_embed": norm(c.num_patches, D, scale=0.02),
+        "final_norm": jnp.ones((D,), dt),
+        "head": norm(D, c.n_classes, scale=D**-0.5),
+        "block": block,
+    }
+
+
+def _rmsnorm(x: jax.Array, w: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + 1e-6).astype(x.dtype)) * w.astype(x.dtype)
+
+
+def _patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[B,H,W,C] uint8/float → [B, num_patches, patch_dim] model dtype."""
+    B = images.shape[0]
+    p = cfg.patch_size
+    g = cfg.image_size // p
+    x = images.astype(jnp.float32) / 255.0 - 0.5
+    x = x.reshape(B, g, p, g, p, cfg.in_channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, g * g, cfg.patch_dim)
+    return x.astype(cfg.dtype)
+
+
+def forward(
+    params: Dict[str, Any],
+    images: jax.Array,
+    cfg: ViTConfig,
+    template=None,
+    mesh=None,
+) -> jax.Array:
+    """images [B,H,W,C] → logits [B, n_classes] (float32)."""
+    c = cfg
+    rules: AxisRules = template.rules if template is not None else {}
+
+    x = jnp.einsum(
+        "bnp,pd->bnd", _patchify(images, c), params["patch_embed"].astype(c.dtype)
+    )
+    x = x + params["pos_embed"].astype(c.dtype)[None]
+    x = with_logical_constraint(x, ("batch", "seq", None), rules, mesh)
+
+    def block(x, layer):
+        h = _rmsnorm(x, layer["attn_norm"])
+        q = jnp.einsum("bnd,dhk->bnhk", h, layer["wq"].astype(h.dtype))
+        k = jnp.einsum("bnd,dhk->bnhk", h, layer["wk"].astype(h.dtype))
+        v = jnp.einsum("bnd,dhk->bnhk", h, layer["wv"].astype(h.dtype))
+        q = with_logical_constraint(q, ("batch", None, "attn_heads", None), rules, mesh)
+        k = with_logical_constraint(k, ("batch", None, "attn_heads", None), rules, mesh)
+        v = with_logical_constraint(v, ("batch", None, "attn_heads", None), rules, mesh)
+        scale = c.head_dim**-0.5
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        attn = with_logical_constraint(
+            attn, ("batch", "seq", "attn_heads", None), rules, mesh
+        )
+        x = x + jnp.einsum("bnhk,hkd->bnd", attn, layer["wo"].astype(h.dtype))
+
+        h = _rmsnorm(x, layer["mlp_norm"])
+        up = jnp.einsum("bnd,df->bnf", h, layer["wi"].astype(h.dtype))
+        gate = jnp.einsum("bnd,df->bnf", h, layer["wg"].astype(h.dtype))
+        y = jax.nn.silu(gate) * up
+        y = with_logical_constraint(y, ("batch", "seq", "act_mlp"), rules, mesh)
+        x = x + jnp.einsum("bnf,fd->bnd", y, layer["wd"].astype(h.dtype))
+        x = with_logical_constraint(x, ("batch", "seq", None), rules, mesh)
+        return x, None
+
+    body = jax.checkpoint(block) if c.remat else block
+    x, _ = lax.scan(lambda carry, layer: body(carry, layer), x, params["block"])
+
+    x = _rmsnorm(x, params["final_norm"])
+    pooled = jnp.mean(x, axis=1)  # [B, D]
+    logits = jnp.einsum("bd,dk->bk", pooled, params["head"].astype(x.dtype))
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: ViTConfig,
+    template=None,
+    mesh=None,
+) -> jax.Array:
+    logits = forward(params, batch["images"], cfg, template=template, mesh=mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["labels"][:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def accuracy(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: ViTConfig,
+    template=None,
+    mesh=None,
+) -> jax.Array:
+    logits = forward(params, batch["images"], cfg, template=template, mesh=mesh)
+    return jnp.mean((jnp.argmax(logits, axis=-1) == batch["labels"]).astype(jnp.float32))

@@ -354,48 +354,22 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
         paths = ctx.layout.run_paths(run.uuid)
 
         def _upload() -> None:
-            # Failures must stay operator-visible even though the retry is
-            # self-managed: mirror the bus's retry/dead_letter counters and
-            # error window (an upload dead-letter is a LOST checkpoint).
-            import traceback as _tb
-
-            task_name = SchedulerTasks.ARTIFACTS_SYNC
-            try:
-                n = sync_run_up(store, paths, run.uuid)
-            except Exception as e:
-                if _attempt + 1 > ARTIFACT_SYNC_MAX_ATTEMPTS:
-                    logger.exception(
-                        "Artifact sync for run %s dead-lettered after %d attempts",
-                        run_id,
-                        _attempt + 1,
-                    )
-                    if bus.stats is not None:
-                        bus.stats.incr(f"tasks.{task_name}.dead_letter")
-                    bus.errors.append(
-                        (
-                            task_name,
-                            e,
-                            f"artifact sync for run {run_id} dead-lettered after "
-                            f"{_attempt + 1} attempts\n{_tb.format_exc()}",
-                        )
-                    )
-                    return
-                logger.exception(
-                    "Artifact sync failed for run %s (attempt %d)", run_id, _attempt + 1
-                )
-                if bus.stats is not None:
-                    bus.stats.incr(f"tasks.{task_name}.retry")
-                bus.send(
-                    task_name,
-                    {"run_id": run_id, "_attempt": _attempt + 1},
-                    countdown=5.0,
-                )
-                return
+            n = sync_run_up(store, paths, run.uuid)
             ctx.auditor.record(
                 EventTypes.EXPERIMENT_ARTIFACTS_SYNCED, run_id=run_id, files=n
             )
 
-        bus.offload(_upload, name=f"artifacts-sync-{run_id}")
+        # Failure handling lives in the bus (same retry/dead-letter
+        # counters and error window as in-thread tasks): an upload
+        # dead-letter is a LOST checkpoint and must stay operator-visible.
+        bus.offload_with_retry(
+            _upload,
+            task=SchedulerTasks.ARTIFACTS_SYNC,
+            kwargs={"run_id": run_id},
+            attempt=_attempt,
+            max_attempts=ARTIFACT_SYNC_MAX_ATTEMPTS,
+            name=f"artifacts-sync-{run_id}",
+        )
 
     @bus.register(SchedulerTasks.ADMISSION_CHECK)
     def admission_check() -> None:

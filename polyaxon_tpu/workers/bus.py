@@ -204,6 +204,59 @@ class TaskBus:
         else:
             fn()
 
+    def offload_with_retry(
+        self,
+        fn: Callable[[], Any],
+        *,
+        task: str,
+        kwargs: Dict[str, Any],
+        attempt: int,
+        max_attempts: int,
+        countdown: float = 5.0,
+        name: Optional[str] = None,
+    ) -> None:
+        """Offload ``fn`` with the bus's own retry/dead-letter accounting.
+
+        The off-thread analogue of raising :class:`Retry` from a task: any
+        exception re-sends ``task`` with ``kwargs + {"_attempt": n+1}``
+        until ``max_attempts``, then dead-letters into the same stats
+        counters and error window ``_run_one`` feeds — so heavy-IO tasks
+        (artifact uploads) keep ONE retry implementation instead of each
+        mirroring the bus's internals.
+        """
+
+        def guarded() -> None:
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — retried, not fatal
+                if attempt + 1 > max_attempts:
+                    logger.exception(
+                        "Offloaded task %s dead-lettered after %d attempts",
+                        task,
+                        attempt + 1,
+                    )
+                    if self.stats is not None:
+                        self.stats.incr(f"tasks.{task}.dead_letter")
+                    self.errors.append(
+                        (
+                            task,
+                            e,
+                            f"offloaded {task} dead-lettered after "
+                            f"{attempt + 1} attempts\n{traceback.format_exc()}",
+                        )
+                    )
+                    return
+                logger.exception(
+                    "Offloaded task %s failed (attempt %d)", task, attempt + 1
+                )
+                if self.stats is not None:
+                    self.stats.incr(f"tasks.{task}.retry")
+                self.send(
+                    task, {**kwargs, "_attempt": attempt + 1}, countdown=countdown
+                )
+
+        self.offload(guarded, name=name or task)
+
     # -- service mode ---------------------------------------------------------
     def start(self) -> None:
         if self._thread is not None:

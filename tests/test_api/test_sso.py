@@ -322,6 +322,40 @@ class TestSSOFlow:
 
         assert drive(orch, body)
 
+    def test_callback_rejects_state_from_another_browser(self, orch):
+        """Login CSRF: a server-issued state carried by a DIFFERENT browser
+        (no px_sso_state cookie) must not complete — otherwise an attacker
+        can fixate a victim into the attacker's account by handing them a
+        callback URL with the attacker's own valid state+code."""
+
+        async def body(client):
+            orch.conf.set("sso.provider", "github")
+            orch.conf.set("sso.client_id", "cid")
+            orch.conf.invalidate()
+            resp = await client.get("/auth/sso/login", allow_redirects=False)
+            assert resp.status == 302
+            state = parse_qs(urlparse(resp.headers["Location"]).query)["state"][0]
+            # Replay the state without the binding cookie (victim browser).
+            client.session.cookie_jar.clear()
+            resp = await client.get(f"/auth/sso/callback?code=x&state={state}")
+            assert resp.status == 403
+            assert "browser" in (await resp.json())["error"]
+            return True
+
+        assert drive(orch, body)
+
+    def test_half_configured_oidc_is_a_clean_400(self, orch):
+        async def body(client):
+            orch.conf.set("sso.provider", "oidc")
+            orch.conf.set("sso.client_id", "cid")  # but no endpoint URLs
+            orch.conf.invalidate()
+            resp = await client.get("/auth/sso/login", allow_redirects=False)
+            assert resp.status == 400
+            assert "URLs" in (await resp.json())["error"]
+            return True
+
+        assert drive(orch, body)
+
     def test_sso_disabled_404s(self, orch):
         async def body(client):
             resp = await client.get("/auth/sso/login", allow_redirects=False)

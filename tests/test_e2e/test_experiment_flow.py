@@ -179,6 +179,36 @@ class TestCNNWorkload:
 
 
 @pytest.mark.e2e
+class TestViTWorkload:
+    def test_vit_distributed_learns(self, orch):
+        # Third model family: attention/MLP image classifier through the
+        # same gang + template machinery.
+        run = orch.submit(
+            spec_for(
+                "vit_train",
+                devices=4,
+                declarations={
+                    "steps": 30,
+                    "batch": 32,
+                    "image_size": 16,
+                    "patch_size": 4,
+                    "classes": 4,
+                    "d_model": 32,
+                    "n_layers": 2,
+                    "n_heads": 4,
+                    "lr": 3e-3,
+                },
+                seed=3,
+            ),
+            name="vit-e2e",
+        )
+        done = orch.wait(run.id, timeout=240)
+        assert done.status == S.SUCCEEDED, orch.registry.get_logs(run.id)
+        assert done.last_metric["accuracy"] > 0.5
+        assert done.last_metric["images_per_s"] > 0
+
+
+@pytest.mark.e2e
 class TestZombieDetection:
     def test_heartbeatless_run_is_failed_by_cron(self, tmp_path):
         # Parity: reference zombie cron (crons/tasks/heartbeats.py +

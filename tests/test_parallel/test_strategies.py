@@ -150,6 +150,70 @@ class TestStrategyNumerics:
         assert "pipeline" in spec and "tensor" in spec, spec
 
 
+class TestViTStrategies:
+    """The ViT family shares the LM's logical axes, so the same templates
+    must shard it with identical numerics."""
+
+    @pytest.fixture(scope="class")
+    def vit_setup(self):
+        from polyaxon_tpu.models import vit
+
+        cfg = vit.ViTConfig(
+            image_size=8, patch_size=2, d_model=32, n_layers=2, n_heads=4,
+            head_dim=8, d_ff=64, n_classes=4, dtype=jnp.float32,
+        )
+        rng = np.random.default_rng(0)
+        batch = {
+            "images": jnp.asarray(
+                rng.integers(0, 255, (8, 8, 8, 3), dtype=np.uint8)
+            ),
+            "labels": jnp.asarray(rng.integers(0, 4, 8).astype(np.int32)),
+        }
+        params = vit.init_params(KEY, cfg)
+        ref = float(vit.loss_fn(params, batch, cfg))
+        return vit, cfg, batch, ref
+
+    @pytest.mark.parametrize(
+        "strategy,mesh_axes",
+        [("ddp", {"data": 8}), ("fsdp", {"data": 8}),
+         ("tp", {"data": 2, "tensor": 4})],
+    )
+    def test_sharded_loss_matches_single_device(
+        self, vit_setup, strategy, mesh_axes
+    ):
+        vit, cfg, batch, ref = vit_setup
+        mesh = build_mesh(mesh_axes)
+        tmpl = template_for(strategy, mesh_axes)
+        ts = build_train_step(
+            loss_fn=lambda p, b: vit.loss_fn(p, b, cfg, template=tmpl, mesh=mesh),
+            init_fn=lambda k: vit.init_params(k, cfg),
+            axes_tree=vit.param_axes(cfg),
+            optimizer=optax.adamw(1e-2),
+            mesh=mesh,
+            template=tmpl,
+        )
+        params, opt_state = ts.init(KEY)
+        b = ts.place_batch(batch)
+        _, _, metrics = ts.step(params, opt_state, b, KEY)
+        assert float(metrics["loss"]) == pytest.approx(ref, abs=2e-4), strategy
+
+    def test_params_shard_under_tp(self, vit_setup):
+        vit, cfg, batch, _ = vit_setup
+        mesh_axes = {"data": 2, "tensor": 4}
+        mesh = build_mesh(mesh_axes)
+        tmpl = template_for("tp", mesh_axes)
+        ts = build_train_step(
+            loss_fn=lambda p, b: vit.loss_fn(p, b, cfg, template=tmpl, mesh=mesh),
+            init_fn=lambda k: vit.init_params(k, cfg),
+            axes_tree=vit.param_axes(cfg),
+            optimizer=optax.adamw(1e-2),
+            mesh=mesh,
+            template=tmpl,
+        )
+        spec = str(ts.param_shardings["block"]["wq"].spec)
+        assert "tensor" in spec, spec
+
+
 class TestRingAttention:
     def test_matches_dense_attention(self):
         from polyaxon_tpu.models.transformer import _dense_attention
