@@ -205,6 +205,12 @@ def _flash_attention(q, k, v):
     return flash_attention(cfg, q, k, v)
 
 
+def _platform_is_tpu() -> bool:
+    from polyaxon_tpu.parallel.flash import _on_tpu
+
+    return _on_tpu()
+
+
 def _use_flash(
     cfg: TransformerConfig, mesh, ring_axis, pipeline_axis, seq_len: int
 ) -> bool:
@@ -312,6 +318,19 @@ def forward(
     composed = bool(template is not None and template.pipeline_composed)
     cmesh = None if (pipeline_axis and not composed) else mesh
     use_flash = _use_flash(c, mesh, ring_axis, pipeline_axis, T)
+    # Ulysses long-context: the flash kernel can't ride GSPMD (a pallas
+    # call is an unpartitionable custom call), so past the dense memory
+    # wall (or when forced) the attention goes through the EXPLICIT
+    # all-to-all shard_map twin instead of the attn_heads constraints.
+    ulysses_axis = getattr(template, "ulysses_axis", None) if template else None
+    ulysses_flash = bool(
+        ulysses_axis is not None
+        and pipeline_axis is None
+        and (
+            c.attention_impl == "flash"
+            or (c.attention_impl == "auto" and T >= 8192 and _platform_is_tpu())
+        )
+    )
 
     table = params["embed"].astype(c.dtype)
     if cmesh is not None and cmesh.size > 1 and (
@@ -351,18 +370,28 @@ def forward(
         v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(h.dtype))
         q = _rope(q, pos, c.rope_theta)
         k = _rope(k, pos, c.rope_theta)
-        # Ulysses switch-point: constraining attn_heads re-shards heads
-        # across the sequence axis (XLA inserts the all-to-all).
-        q = with_logical_constraint(q, ("batch", None, "attn_heads", None), rules, cmesh)
-        k = with_logical_constraint(k, ("batch", None, "attn_heads", None), rules, cmesh)
-        v = with_logical_constraint(v, ("batch", None, "attn_heads", None), rules, cmesh)
+        if not ulysses_flash:
+            # Ulysses switch-point (GSPMD/dense form): constraining
+            # attn_heads re-shards heads across the sequence axis (XLA
+            # inserts the all-to-all).  The flash form does its own
+            # all-to-alls inside shard_map — constraining here would just
+            # add a redundant reshard round-trip before it.
+            q = with_logical_constraint(q, ("batch", None, "attn_heads", None), rules, cmesh)
+            k = with_logical_constraint(k, ("batch", None, "attn_heads", None), rules, cmesh)
+            v = with_logical_constraint(v, ("batch", None, "attn_heads", None), rules, cmesh)
         # Named AFTER the attn_heads constraint so remat policies save the
         # post-reshard tensors: under Ulysses the bwd recompute must not
         # re-run the all-to-alls the save exists to skip.
         q = checkpoint_name(q, "q_proj")
         k = checkpoint_name(k, "k_proj")
         v = checkpoint_name(v, "v_proj")
-        if ring_axis is not None:
+        if ulysses_flash:
+            from polyaxon_tpu.parallel.ulysses import ulysses_attention_sharded
+
+            attn = ulysses_attention_sharded(
+                q, k, v, mesh, ulysses_axis, batch_axes=rules.get("batch")
+            )
+        elif ring_axis is not None:
             from polyaxon_tpu.parallel.ring import ring_attention_sharded
 
             # The ring resolves its own kernel: pallas flash per block on

@@ -50,6 +50,10 @@ class StrategyTemplate:
     batch_axes: Tuple[str, ...]
     #: attention runs the ring kernel over this mesh axis (sp_ring)
     ring_axis: Optional[str] = None
+    #: Ulysses sequence axis: the flash path swaps seq↔heads with explicit
+    #: all-to-alls in a shard_map (``parallel/ulysses.py``); the dense path
+    #: keeps the GSPMD attn_heads-constraint formulation
+    ulysses_axis: Optional[str] = None
     #: layers are pipeline stages over this mesh axis (pp)
     pipeline_axis: Optional[str] = None
     #: microbatch count for the pipeline schedule
@@ -176,9 +180,14 @@ def template_for(
             raise RuntimeLayerError("ulysses strategy needs a 'sequence' mesh axis")
         # Outside attention the sequence is sharded; inside attention the
         # heads are — annotating both lets XLA insert the two all-to-alls
-        # (DeepSpeed-Ulysses, expressed as sharding constraints).
+        # (DeepSpeed-Ulysses, expressed as sharding constraints). With
+        # flash attention the all-to-alls go explicit instead
+        # (ulysses_axis → parallel/ulysses.py) since GSPMD can't partition
+        # a pallas call.
         rules = {**batch_rules, "seq": "sequence", "attn_heads": "sequence"}
-        return StrategyTemplate("ulysses", rules, data, options=options)
+        return StrategyTemplate(
+            "ulysses", rules, data, ulysses_axis="sequence", options=options
+        )
 
     if strategy == "ep":
         if "expert" not in mesh_axes:
