@@ -80,6 +80,13 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             ctx["actor"] = actor
         orch.auditor.record(event_type, **ctx)
 
+    def _json_error(exc_cls, message: str):
+        """An aiohttp HTTP error carrying the API's JSON error shape."""
+        return exc_cls(
+            text=json.dumps({"error": message}),
+            content_type="application/json",
+        )
+
     def _project_denied(request, project: str) -> bool:
         """Project-scoped access (reference ``ownership/`` + ``scopes/``):
         owned projects admit owner + collaborators; admins (including the
@@ -91,11 +98,8 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
 
     def _require_project(request, project: str) -> None:
         if _project_denied(request, project):
-            raise web.HTTPForbidden(
-                text=json.dumps(
-                    {"error": f"no access to project {project!r}"}
-                ),
-                content_type="application/json",
+            raise _json_error(
+                web.HTTPForbidden, f"no access to project {project!r}"
             )
 
     def _require_project_owner(request, project: str) -> None:
@@ -105,11 +109,9 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         proj = reg.get_project(project)
         owner = (proj or {}).get("owner")
         if owner and owner != request.get("actor"):
-            raise web.HTTPForbidden(
-                text=json.dumps(
-                    {"error": f"only the owner of {project!r} (or an admin) may do this"}
-                ),
-                content_type="application/json",
+            raise _json_error(
+                web.HTTPForbidden,
+                f"only the owner of {project!r} (or an admin) may do this",
             )
 
     def _run_or_404(request) -> Run:
@@ -357,24 +359,17 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             # Non-admins may only own projects themselves (no assigning
             # ownership to third parties)...
             if owner not in (None, actor):
-                raise web.HTTPForbidden(
-                    text=json.dumps(
-                        {"error": "only admins may assign another owner"}
-                    ),
-                    content_type="application/json",
+                raise _json_error(
+                    web.HTTPForbidden, "only admins may assign another owner"
                 )
             # ...and may not CLAIM a run-implied project others already use
             # (registering 'ml' with an owner would 403 every existing
             # user of it — an ownership takeover).
             if owner is not None and reg.get_project(body.get("name", "")):
-                raise web.HTTPForbidden(
-                    text=json.dumps(
-                        {
-                            "error": "project already has runs; an admin must "
-                            "register its ownership"
-                        }
-                    ),
-                    content_type="application/json",
+                raise _json_error(
+                    web.HTTPForbidden,
+                    "project already has runs; an admin must register its "
+                    "ownership",
                 )
         try:
             project = reg.create_project(
@@ -433,10 +428,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 {"error": "collaborator needs a username"}, status=400
             )
         if reg.get_project(name) is None:
-            raise web.HTTPNotFound(
-                text=json.dumps({"error": "no such project"}),
-                content_type="application/json",
-            )
+            raise _json_error(web.HTTPNotFound, "no such project")
         reg.add_collaborator(name, username)
         _audit(
             request, EventTypes.PROJECT_SHARED, project=name, username=username
@@ -448,10 +440,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         name = request.match_info["name"]
         _require_project_owner(request, name)
         if not reg.remove_collaborator(name, request.match_info["username"]):
-            raise web.HTTPNotFound(
-                text=json.dumps({"error": "not a collaborator"}),
-                content_type="application/json",
-            )
+            raise _json_error(web.HTTPNotFound, "not a collaborator")
         _audit(
             request,
             EventTypes.PROJECT_UNSHARED,
@@ -745,15 +734,9 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         except SSOError as e:
             # Half-configured SSO (oidc without endpoint URLs) must fail
             # with the same clean JSON shape as every other misconfig.
-            raise web.HTTPBadRequest(
-                text=json.dumps({"error": str(e)}),
-                content_type="application/json",
-            )
+            raise _json_error(web.HTTPBadRequest, str(e))
         if provider is None:
-            raise web.HTTPNotFound(
-                text=json.dumps({"error": "SSO is not configured"}),
-                content_type="application/json",
-            )
+            raise _json_error(web.HTTPNotFound, "SSO is not configured")
         return provider
 
     @routes.get("/auth/sso/login")

@@ -322,7 +322,7 @@ def forward(
     # call is an unpartitionable custom call), so past the dense memory
     # wall (or when forced) the attention goes through the EXPLICIT
     # all-to-all shard_map twin instead of the attn_heads constraints.
-    ulysses_axis = getattr(template, "ulysses_axis", None) if template else None
+    ulysses_axis = template.ulysses_axis if template is not None else None
     ulysses_flash = bool(
         ulysses_axis is not None
         and pipeline_axis is None

@@ -20,12 +20,13 @@ reuses the transformer's design vocabulary end to end:
 from __future__ import annotations
 
 from dataclasses import dataclass, replace
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from polyaxon_tpu.models.transformer import _rmsnorm
 from polyaxon_tpu.parallel.axes import AxisRules, with_logical_constraint
 
 
@@ -120,11 +121,6 @@ def init_params(key: jax.Array, cfg: ViTConfig) -> Dict[str, Any]:
         "head": norm(D, c.n_classes, scale=D**-0.5),
         "block": block,
     }
-
-
-def _rmsnorm(x: jax.Array, w: jax.Array) -> jax.Array:
-    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
-    return (x * lax.rsqrt(var + 1e-6).astype(x.dtype)) * w.astype(x.dtype)
 
 
 def _patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:

@@ -25,7 +25,6 @@ mesh-compatible, which is how the 8-device virtual-CPU suite verifies ring
 from __future__ import annotations
 
 import functools
-import math
 from typing import Tuple
 
 import jax
