@@ -59,6 +59,13 @@ class TransformerConfig:
     #: "save_attn_mlp" (also keep the post-activation MLP product).
     remat_policy: str = "none"
 
+    #: Grouped-query attention: number of K/V heads (None = n_heads, i.e.
+    #: full multi-head).  Fewer KV heads shrink the KV params/optimizer
+    #: state and — under sp_ring — the per-hop ppermute payload by
+    #: n_heads/n_kv_heads (the ring rotates UNEXPANDED KV blocks and
+    #: broadcasts them to the query heads only inside the kernel call).
+    n_kv_heads: Optional[int] = None
+
     def __post_init__(self) -> None:
         allowed = (
             "none", "dots", "dots_no_batch", "save_attn", "save_attn_mlp",
@@ -68,6 +75,22 @@ class TransformerConfig:
             raise ValueError(
                 f"Unknown remat_policy {self.remat_policy!r} (one of {allowed})"
             )
+        if self.n_kv_heads is not None and not (
+            0 < self.n_kv_heads <= self.n_heads
+        ):
+            raise ValueError(
+                f"n_kv_heads ({self.n_kv_heads}) must be in [1, n_heads="
+                f"{self.n_heads}]"
+            )
+        if self.n_heads % self.kv_heads:
+            raise ValueError(
+                f"n_heads ({self.n_heads}) must be divisible by n_kv_heads "
+                f"({self.kv_heads})"
+            )
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_heads if self.n_kv_heads is None else self.n_kv_heads
     #: "auto" = pallas flash kernel on single-device TPU, XLA attention
     #: elsewhere; "dense" forces XLA; "flash" forces the pallas kernel.
     #: (A pallas call is a custom call GSPMD can't partition, so the
@@ -84,7 +107,7 @@ class TransformerConfig:
     def n_params(self) -> int:
         """Parameter count (for MFU math)."""
         c = self
-        attn = c.d_model * c.n_heads * c.head_dim * 4
+        attn = c.d_model * c.head_dim * (2 * c.n_heads + 2 * c.kv_heads)
         if c.n_experts:
             mlp = c.d_model * c.n_experts + c.n_experts * c.d_model * c.d_ff * 3
         else:
@@ -133,11 +156,12 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
         return jax.random.normal(next(k), shape, dt) * scale
 
     L, D, H, hd, F = c.n_layers, c.d_model, c.n_heads, c.head_dim, c.d_ff
+    Hkv = c.kv_heads
     block: Dict[str, Any] = {
         "attn_norm": jnp.ones((L, D), dt),
         "wq": norm(L, D, H, hd, scale=D**-0.5),
-        "wk": norm(L, D, H, hd, scale=D**-0.5),
-        "wv": norm(L, D, H, hd, scale=D**-0.5),
+        "wk": norm(L, D, Hkv, hd, scale=D**-0.5),
+        "wv": norm(L, D, Hkv, hd, scale=D**-0.5),
         "wo": norm(L, H, hd, D, scale=(H * hd) ** -0.5),
         "mlp_norm": jnp.ones((L, D), dt),
     }
@@ -370,12 +394,23 @@ def forward(
         v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(h.dtype))
         q = _rope(q, pos, c.rope_theta)
         k = _rope(k, pos, c.rope_theta)
-        if not ulysses_flash:
+        # GQA: the ring carries UNEXPANDED KV (its ppermute payload shrinks
+        # by n_heads/n_kv_heads and the ring broadcasts inside the kernel
+        # call); every other path broadcasts KV heads to the query heads
+        # here — the einsum/flash/Ulysses machinery then sees plain MHA.
+        group = c.n_heads // c.kv_heads
+        if group > 1 and ring_axis is None:
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
+        if not ulysses_flash and ring_axis is None:
             # Ulysses switch-point (GSPMD/dense form): constraining
             # attn_heads re-shards heads across the sequence axis (XLA
             # inserts the all-to-all).  The flash form does its own
-            # all-to-alls inside shard_map — constraining here would just
-            # add a redundant reshard round-trip before it.
+            # all-to-alls inside shard_map, and the RING likewise wants
+            # its seq-sharded inputs untouched — for both, constraining
+            # here would force a redundant gather/reshard round-trip
+            # (sp_ring maps no attn_heads rule, so the constraint would
+            # degrade to "replicate the sequence dim").
             q = with_logical_constraint(q, ("batch", None, "attn_heads", None), rules, cmesh)
             k = with_logical_constraint(k, ("batch", None, "attn_heads", None), rules, cmesh)
             v = with_logical_constraint(v, ("batch", None, "attn_heads", None), rules, cmesh)

@@ -345,7 +345,12 @@ def _hop_case(i, idx):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
 def ring_flash_attention(cfg, q, k, v):
-    """Causal ring attention with pallas flash blocks. q/k/v: [B,Tl,H,d]."""
+    """Causal ring attention with pallas flash blocks.
+
+    q: [B,Tl,H,d]; k/v: [B,Tl,Hkv,d] with Hkv dividing H (GQA) — the ring
+    rotates the UNEXPANDED KV blocks (ppermute payload shrinks by H/Hkv)
+    and broadcasts them to the query heads only at each kernel call.
+    """
     return _ring_flash_fwd(cfg, q, k, v)[0]
 
 
@@ -359,11 +364,34 @@ def _unbhd(x, B, H):
     return x.reshape(B, H, T, d).transpose(0, 2, 1, 3)
 
 
+def _gqa_expand(x, B, group):
+    """[B*Hkv, T, d] → [B*H, T, d] by repeating each KV head ``group``×."""
+    if group == 1:
+        return x
+    BHkv, T, d = x.shape
+    return jnp.repeat(x.reshape(B, BHkv // B, T, d), group, axis=1).reshape(
+        B * (BHkv // B) * group, T, d
+    )
+
+
+def _gqa_reduce(dx, B, group):
+    """Transpose of :func:`_gqa_expand`: sum query-head grads per KV head."""
+    if group == 1:
+        return dx
+    BH, T, d = dx.shape
+    return (
+        dx.reshape(B, BH // B // group, group, T, d)
+        .sum(axis=2)
+        .reshape(BH // group, T, d)
+    )
+
+
 def _ring_flash_fwd(cfg, q, k, v):
     axis_name, sm_scale, block_q, block_k, interpret = cfg
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, Tl, H, d = q.shape
+    group = H // k.shape[2]
     qf, kf, vf = _bhd(q), _bhd(k), _bhd(v)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
@@ -374,7 +402,10 @@ def _ring_flash_fwd(cfg, q, k, v):
         def run(args):
             o, lse, kc, vc = args
             o_b, lse_b = flash_block_fwd(
-                qf, kc, vc, causal=causal, sm_scale=sm_scale,
+                qf,
+                _gqa_expand(kc, B, group),
+                _gqa_expand(vc, B, group),
+                causal=causal, sm_scale=sm_scale,
                 block_q=block_q, block_k=block_k, interpret=interpret,
             )
             return _merge(o, lse, o_b, lse_b)
@@ -401,6 +432,8 @@ def _ring_flash_bwd(cfg, res, do):
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, Tl, H, d = q.shape
+    Hkv = k.shape[2]
+    group = H // Hkv
     qf, kf, vf = _bhd(q), _bhd(k), _bhd(v)
     dof = _bhd(do.astype(q.dtype))
     of = _bhd(out)
@@ -408,16 +441,20 @@ def _ring_flash_bwd(cfg, res, do):
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     dq0 = jnp.zeros((B * H, Tl, d), jnp.float32)
-    dkv0 = jnp.zeros((B * H, Tl, d), jnp.float32)
+    dkv0 = jnp.zeros((B * Hkv, Tl, d), jnp.float32)
 
     def block(causal):
         def run(args):
             kc, vc = args
-            return flash_block_bwd(
-                qf, kc, vc, dof, lse, delta, causal=causal,
+            dq_i, dk_i, dv_i = flash_block_bwd(
+                qf,
+                _gqa_expand(kc, B, group),
+                _gqa_expand(vc, B, group),
+                dof, lse, delta, causal=causal,
                 sm_scale=sm_scale, block_q=block_q, block_k=block_k,
                 interpret=interpret,
             )
+            return dq_i, _gqa_reduce(dk_i, B, group), _gqa_reduce(dv_i, B, group)
         return run
 
     def skip(args):
@@ -439,8 +476,8 @@ def _ring_flash_bwd(cfg, res, do):
     dq, _, _, dk, dv = lax.fori_loop(0, n, body, (dq0, kf, vf, dkv0, dkv0))
     return (
         _unbhd(dq, B, H).astype(q.dtype),
-        _unbhd(dk, B, H).astype(k.dtype),
-        _unbhd(dv, B, H).astype(v.dtype),
+        _unbhd(dk, B, Hkv).astype(k.dtype),
+        _unbhd(dv, B, Hkv).astype(v.dtype),
     )
 
 

@@ -84,6 +84,11 @@ def ring_attention_sharded(
 
     from polyaxon_tpu.parallel.flash import _on_tpu
 
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"query heads ({q.shape[2]}) must be divisible by KV heads "
+            f"({k.shape[2]}) for grouped-query attention"
+        )
     if impl == "auto":
         impl = "flash" if _on_tpu() else "dense"
     if impl == "flash":
@@ -93,6 +98,13 @@ def ring_attention_sharded(
         cfg = (seq_axis, d**-0.5, block_q, block_k, not _on_tpu())
         body = partial(ring_flash_attention, cfg)
     elif impl == "dense":
+        # The dense blockwise body is plain MHA; broadcast GQA KV heads to
+        # the query heads up front (the flash body instead broadcasts
+        # per hop so the ppermute payload stays Hkv-sized).
+        group = q.shape[2] // k.shape[2]
+        if group > 1:
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
         body = partial(_ring_attention, axis_name=seq_axis)
     else:
         raise ValueError(f"Unknown ring attention impl {impl!r}")

@@ -13,8 +13,43 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from polyaxon_tpu.exceptions import RuntimeLayerError
 from polyaxon_tpu.parallel.axes import tree_shardings, tree_specs
 from polyaxon_tpu.parallel.templates import StrategyTemplate
+
+
+def _validate_param_shapes(init_fn, param_specs, mesh_axes) -> None:
+    """Every sharded param dim must divide by its mesh axes — checked up
+    front so a config/mesh mismatch (e.g. 2 GQA KV heads tensor-sharded
+    4 ways) reads as a one-line config error naming the parameter, not a
+    pjit internals traceback out of jit_init."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    flat_shapes, _ = jax.tree.flatten(abstract)
+    flat_specs, _ = jax.tree.flatten(
+        param_specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )
+    paths = [
+        "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        for path, _ in jax.tree.flatten_with_path(abstract)[0]
+    ]
+    for name, leaf, spec in zip(paths, flat_shapes, flat_specs):
+        for dim, entry in zip(leaf.shape, spec):
+            if entry is None:
+                continue
+            axes = (entry,) if isinstance(entry, str) else entry
+            size = 1
+            for a in axes:
+                size *= mesh_axes.get(a, 1)
+            if size > 1 and dim % size:
+                raise RuntimeLayerError(
+                    f"Parameter {name!r} dim of size {dim} cannot shard over "
+                    f"mesh axes {axes} (total {size}) — adjust the model "
+                    f"config or the mesh (e.g. GQA kv heads vs tensor "
+                    f"parallelism)"
+                )
 
 
 @dataclass
@@ -59,6 +94,7 @@ def build_train_step(
     param_shardings = tree_shardings(mesh, param_specs)
     batch_sharding = NamedSharding(mesh, template.batch_spec())
 
+    _validate_param_shapes(init_fn, param_specs, mesh_axes)
     jit_init = jax.jit(init_fn, out_shardings=param_shardings)
 
     def _opt_state_shardings(params):

@@ -150,6 +150,102 @@ class TestStrategyNumerics:
         assert "pipeline" in spec and "tensor" in spec, spec
 
 
+class TestGQA:
+    """Grouped-query attention: fewer KV heads, same numerics as the
+    equivalent MHA with tied KV weights, working under every path."""
+
+    def _cfgs(self):
+        gqa = CFG.scaled(n_kv_heads=2)
+        return gqa
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="divisible"):
+            CFG.scaled(n_kv_heads=3)
+
+    def test_gqa_matches_mha_with_tied_kv_weights(self):
+        """Repeating the GQA KV projections into full-head MHA weights
+        must reproduce the GQA forward exactly — the broadcast is the
+        whole trick."""
+        from polyaxon_tpu.models.transformer import forward
+
+        gqa = self._cfgs()
+        params = init_params(KEY, gqa)
+        rng = np.random.default_rng(21)
+        tokens = jnp.asarray(rng.integers(0, gqa.vocab_size, (2, 16)))
+        out_gqa = forward(params, tokens, gqa)
+
+        group = gqa.n_heads // gqa.kv_heads
+        mha_params = jax.tree.map(lambda x: x, params)
+        mha_params["block"] = dict(params["block"])
+        mha_params["block"]["wk"] = jnp.repeat(params["block"]["wk"], group, axis=2)
+        mha_params["block"]["wv"] = jnp.repeat(params["block"]["wv"], group, axis=2)
+        out_mha = forward(mha_params, tokens, CFG)
+        np.testing.assert_allclose(
+            np.asarray(out_gqa), np.asarray(out_mha), atol=2e-5
+        )
+
+    @pytest.mark.parametrize(
+        "strategy,mesh_axes,impl",
+        [
+            ("fsdp", {"data": 8}, "dense"),
+            ("sp_ring", {"data": 2, "sequence": 4}, "flash"),
+            ("ulysses", {"data": 2, "sequence": 4}, "flash"),
+        ],
+    )
+    def test_gqa_sharded_matches_single_device(
+        self, batch, strategy, mesh_axes, impl
+    ):
+        gqa = self._cfgs().scaled(attention_impl=impl if impl == "flash" else "auto")
+        params = init_params(KEY, gqa)
+        ref = float(loss_fn(params, batch, gqa.scaled(attention_impl="dense")))
+        loss, _ = strategy_loss(strategy, mesh_axes, batch, cfg=gqa)
+        assert loss == pytest.approx(ref, abs=2e-4), strategy
+
+    def test_gqa_under_tp_with_divisible_kv_heads(self, batch):
+        """GQA composes with tensor parallelism when the KV head count
+        divides the tensor axis."""
+        gqa = CFG.scaled(n_kv_heads=4)  # 4 kv heads over tensor=4
+        params = init_params(KEY, gqa)
+        ref = float(loss_fn(params, batch, gqa))
+        loss, _ = strategy_loss("tp", {"data": 2, "tensor": 4}, batch, cfg=gqa)
+        assert loss == pytest.approx(ref, abs=2e-4)
+
+    def test_gqa_tp_mismatch_is_a_clear_config_error(self, batch):
+        """2 KV heads cannot shard over tensor=4: the builder must say so
+        in one line naming the parameter, not a pjit traceback."""
+        from polyaxon_tpu.exceptions import RuntimeLayerError
+
+        gqa = self._cfgs()  # n_kv_heads=2
+        with pytest.raises(RuntimeLayerError, match="wk.*cannot shard|cannot shard"):
+            strategy_loss("tp", {"data": 2, "tensor": 4}, batch, cfg=gqa)
+
+    def test_invalid_kv_head_values_rejected(self):
+        with pytest.raises(ValueError):
+            CFG.scaled(n_kv_heads=0)
+        with pytest.raises(ValueError):
+            CFG.scaled(n_kv_heads=-4)
+        with pytest.raises(ValueError):
+            CFG.scaled(n_kv_heads=16)  # > n_heads
+
+    def test_ring_entry_rejects_indivisible_heads(self):
+        from polyaxon_tpu.parallel.ring import ring_attention_sharded
+
+        mesh = build_mesh({"sequence": 8})
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.standard_normal((2, 32, 6, 8)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((2, 32, 4, 8)), jnp.float32)
+        with pytest.raises(ValueError, match="divisible"):
+            ring_attention_sharded(q, k, k, mesh, "sequence")
+
+    def test_gqa_shrinks_kv_params(self):
+        gqa = self._cfgs()
+        p_mha = init_params(KEY, CFG)
+        p_gqa = init_params(KEY, gqa)
+        assert p_gqa["block"]["wk"].shape[2] == 2
+        assert p_mha["block"]["wk"].shape[2] == CFG.n_heads
+        assert gqa.n_params < CFG.n_params
+
+
 class TestUlyssesFlash:
     """Ulysses with explicit all-to-alls + the flash kernel per head
     shard — the long-context form GSPMD's dense path can't express."""
@@ -400,6 +496,34 @@ class TestRingFlash:
             argnums=(0, 1, 2),
         )(q, k, v)
         for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+    def test_ring_flash_gqa_matches_dense_ring(self):
+        """GQA through the ring: unexpanded KV rotates (Hkv-sized
+        ppermute payload), broadcast happens per kernel call — numerics
+        and grads must match the dense ring on pre-expanded KV."""
+        from polyaxon_tpu.parallel.ring import ring_attention_sharded
+
+        mesh = build_mesh({"sequence": 8})
+        rng = np.random.default_rng(13)
+        B, T, H, Hkv, d = 2, 64, 4, 2, 8
+        q = jnp.asarray(rng.standard_normal((B, T, H, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, Hkv, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, Hkv, d)), jnp.float32)
+        do = jnp.asarray(rng.standard_normal((B, T, H, d)), jnp.float32)
+
+        def obj(impl):
+            return lambda q, k, v: jnp.sum(
+                ring_attention_sharded(q, k, v, mesh, "sequence", impl=impl) * do
+            )
+
+        dense = ring_attention_sharded(q, k, v, mesh, "sequence", impl="dense")
+        flash = ring_attention_sharded(q, k, v, mesh, "sequence", impl="flash")
+        np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), atol=2e-5)
+        gd = jax.grad(obj("dense"), argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(obj("flash"), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            assert a.shape == b.shape  # KV grads stay [B,T,Hkv,d]
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
 
     def test_sp_ring_flash_full_model_matches_single_device(self, batch, ref_loss):
