@@ -50,9 +50,9 @@ def main() -> None:
         # non-matmul overhead; batch 16 beats 8/24/32 (0.526 vs 0.506/
         # 0.498/OOM); the save_attn remat policy keeps the attention
         # output across the bwd recompute (+0.4 MFU pt) — full sweep in
-        # the round-3 notes. Dense attention: flash loses in full train
-        # steps until the dense path hits the HBM wall at T=8192 (see the
-        # longctx metric below).
+        # bench-notes. auto attention resolves to the in-house flash
+        # kernel (1024-edge tiles), which beats XLA dense at every
+        # measured T since the round-4 block sweep.
         cfg = TransformerConfig(
             vocab_size=32768,
             d_model=2048,
@@ -63,7 +63,6 @@ def main() -> None:
             max_seq=1024,
             remat=True,
             remat_policy="save_attn",
-            attention_impl="dense",
         )
         batch_size, seq, steps, warmup = 16, 1024, 20, 3
     else:

@@ -324,13 +324,15 @@ def lm_train(ctx: Context) -> None:
     seq = int(ctx.get_param("seq", 128))
     lr = float(ctx.get_param("lr", 3e-4))
     cfg_fields = {
-        f: type(getattr(TransformerConfig, f))(ctx.get_param(f))
+        f: int(ctx.get_param(f))
         for f in (
             "vocab_size", "d_model", "n_layers", "n_heads",
-            "head_dim", "d_ff", "n_experts",
+            "head_dim", "d_ff", "n_experts", "n_kv_heads",
         )
         if ctx.get_param(f) is not None
     }
+    if ctx.get_param("attention_impl") is not None:
+        cfg_fields["attention_impl"] = str(ctx.get_param("attention_impl"))
     cfg = TransformerConfig(max_seq=seq, **cfg_fields)
 
     mesh = ctx.mesh

@@ -59,6 +59,11 @@ class TransformerConfig:
     #: "save_attn_mlp" (also keep the post-activation MLP product).
     remat_policy: str = "none"
 
+    #: Pallas flash kernel tile edge (block_q = block_k); a VMEM-budget
+    #: knob.  1024 is the measured v5e optimum — 3.9x the throughput of
+    #: 128 at T=8192; 2048 exceeds the 16M scoped-vmem limit
+    #: (docs/bench-notes.md).
+    flash_block: int = 1024
     #: Grouped-query attention: number of K/V heads (None = n_heads, i.e.
     #: full multi-head).  Fewer KV heads shrink the KV params/optimizer
     #: state and — under sp_ring — the per-hop ppermute payload by
@@ -213,7 +218,7 @@ def _dense_attention(q, k, v, q_pos, k_pos):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _flash_attention(q, k, v):
+def _flash_attention(q, k, v, block: int = 1024):
     """Pallas fused causal attention (TPU): O(T) memory, no [T,T] scores.
 
     The HBM-bandwidth win the reference could never express (its compute
@@ -225,7 +230,7 @@ def _flash_attention(q, k, v):
     """
     from polyaxon_tpu.parallel.flash import _on_tpu, flash_attention
 
-    cfg = (q.shape[-1] ** -0.5, 256, 256, not _on_tpu())
+    cfg = (q.shape[-1] ** -0.5, block, block, not _on_tpu())
     return flash_attention(cfg, q, k, v)
 
 
@@ -242,24 +247,16 @@ def _use_flash(
         return False
     if cfg.attention_impl == "flash":
         return True
-    # auto: only when attention runs unsharded on a TPU backend, and only
-    # where the O(T) memory matters. Measured on v5e-1, FULL train steps
-    # (remat, 671M params, round-4 kernel): dense wins narrowly wherever
-    # it fits — 0.39 vs 0.38 at T=2048, 0.325 vs 0.317 at T=4096 — and
-    # OOMs at T=8192 (25.7G > 15.75G HBM) where flash runs at 8.4k tok/s
-    # (1.9x the jax-bundled kernel r3 shipped). The kernel's value in
-    # training is CAPABILITY (long context fits), so auto switches only
-    # at the memory wall.
-    if seq_len < 8192:
-        return False
+    # auto: whenever attention runs unsharded on a TPU backend. With
+    # 1024-edge tiles the in-house kernel beats XLA's dense path at EVERY
+    # measured shape on v5e full train steps (remat, 671M params):
+    # 0.554 vs 0.529 at T=1024, 0.507 vs 0.394 at T=2048, 0.482 vs 0.325
+    # at T=4096, and past the dense HBM wall it is the only thing that
+    # runs (0.459 at T=8192, 0.405 at T=16384 via sp_ring n=1) — see
+    # docs/bench-notes.md for the sweep.
     if pipeline_axis is not None or (mesh is not None and mesh.size > 1):
         return False
-    try:
-        import jax as _jax
-
-        return _jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+    return _platform_is_tpu()
 
 
 def _moe_mlp(x, layer, cfg: TransformerConfig, rules: AxisRules, mesh):
@@ -424,7 +421,10 @@ def forward(
             from polyaxon_tpu.parallel.ulysses import ulysses_attention_sharded
 
             attn = ulysses_attention_sharded(
-                q, k, v, mesh, ulysses_axis, batch_axes=rules.get("batch")
+                q, k, v, mesh, ulysses_axis,
+                batch_axes=rules.get("batch"),
+                block_q=c.flash_block,
+                block_k=c.flash_block,
             )
         elif ring_axis is not None:
             from polyaxon_tpu.parallel.ring import ring_attention_sharded
@@ -435,9 +435,11 @@ def forward(
                 q, k, v, mesh, ring_axis,
                 batch_axes=rules.get("batch"),
                 impl=c.attention_impl,
+                block_q=c.flash_block,
+                block_k=c.flash_block,
             )
         elif use_flash:
-            attn = _flash_attention(q, k, v)
+            attn = _flash_attention(q, k, v, block=c.flash_block)
         else:
             attn = _dense_attention(q, k, v, pos, pos)
         attn = with_logical_constraint(

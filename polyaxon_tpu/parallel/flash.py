@@ -121,8 +121,8 @@ def flash_block_fwd(
     *,
     causal: bool,
     sm_scale: float,
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: bool | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """One attention block: returns ``(o, lse)`` with o float32-normalized.
@@ -267,7 +267,7 @@ def _dkv_kernel(
 
 def flash_block_bwd(
     q, k, v, do, lse, delta, *, causal, sm_scale,
-    block_q: int = 256, block_k: int = 256, interpret: bool | None = None,
+    block_q: int = 1024, block_k: int = 1024, interpret: bool | None = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Gradients for one block pair: returns ``(dq, dk, dv)`` float32."""
     if interpret is None:

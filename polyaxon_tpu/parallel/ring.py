@@ -69,8 +69,8 @@ def ring_attention_sharded(
     seq_axis: str,
     batch_axes: Union[str, Tuple[str, ...], None] = None,
     impl: str = "auto",
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: int = 1024,
+    block_k: int = 1024,
 ) -> jax.Array:
     """Global-view entry: q/k/v [B, T, H, d] with T sharded on ``seq_axis``.
 

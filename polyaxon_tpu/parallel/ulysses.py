@@ -49,8 +49,8 @@ def ulysses_attention_sharded(
     mesh,
     seq_axis: str,
     batch_axes: Union[str, Tuple[str, ...], None] = None,
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: int = 1024,
+    block_k: int = 1024,
 ) -> jax.Array:
     """Global-view entry: q/k/v [B, T, H, d] with T sharded on ``seq_axis``
     and H divisible by the axis size."""

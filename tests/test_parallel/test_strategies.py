@@ -526,6 +526,16 @@ class TestRingFlash:
             assert a.shape == b.shape  # KV grads stay [B,T,Hkv,d]
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
 
+    def test_flash_block_tunable_plumbs_through(self, batch, ref_loss):
+        """A non-default flash_block must flow into the kernels (ring and
+        ulysses paths) without changing numerics."""
+        for strategy in ("sp_ring", "ulysses"):
+            cfg = CFG.scaled(attention_impl="flash", flash_block=8)
+            loss, _ = strategy_loss(
+                strategy, {"data": 2, "sequence": 4}, batch, cfg=cfg
+            )
+            assert loss == pytest.approx(ref_loss, abs=2e-4), strategy
+
     def test_sp_ring_flash_full_model_matches_single_device(self, batch, ref_loss):
         """End to end: a full train step under sp_ring with the flash ring
         body reproduces the single-device loss — the kernel, the VJP, and
