@@ -279,6 +279,100 @@ def vit_train(ctx: Context) -> None:
     )
 
 
+def lm_generate(ctx: Context) -> None:
+    """Autoregressive generation from the flagship LM (the serving story).
+
+    Params: ``target`` (run uuid whose checkpoint to load — typically an
+    ``lm_train`` run with ``save_every``; omitted = fresh random weights,
+    useful as a pure decode benchmark), ``prompt_len``, ``max_new_tokens``,
+    ``batch``, ``temperature``, plus the model-shape params of ``lm_train``
+    (must match the checkpointed config when ``target`` is set).  Reports
+    ``decode_tokens_per_s`` and logs a sample of the generated ids.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from polyaxon_tpu.models import TransformerConfig, decode, init_params
+
+    cfg_fields = {
+        f: int(ctx.get_param(f))
+        for f in (
+            "vocab_size", "d_model", "n_layers", "n_heads",
+            "head_dim", "d_ff", "n_kv_heads", "n_experts",
+        )
+        if ctx.get_param(f) is not None
+    }
+    seq = int(ctx.get_param("seq", 256))
+    cfg = TransformerConfig(max_seq=seq, **cfg_fields)
+    batch = int(ctx.get_param("batch", 1))
+    prompt_len = int(ctx.get_param("prompt_len", 16))
+    max_new = int(ctx.get_param("max_new_tokens", 64))
+    temperature = float(ctx.get_param("temperature", 0.0))
+
+    key = jax.random.PRNGKey(ctx.seed or 0)
+    params = init_params(key, cfg)
+
+    target = ctx.get_param("target")
+    if target is not None:
+        from polyaxon_tpu.runtime.checkpoint import CheckpointManager
+
+        runs_root = ctx.runs_root
+        ckpt_dir = runs_root / str(target) / "checkpoints"
+        ckpt = CheckpointManager(ckpt_dir)
+        try:
+            # Weights-only restore: no optimizer template, no optimizer IO.
+            restored = ckpt.restore_params(params)
+        except ValueError:
+            # Pre-round-4 checkpoint layout: needs a full-state template.
+            import optax
+
+            restored = ckpt.restore(params, optax.adamw(1e-3).init(params))
+        ckpt.close()
+        if restored is None:
+            raise RuntimeError(f"No checkpoint under {ckpt_dir}")
+        params = restored["params"]
+        ctx.log_text(f"restored weights from run {target} step {restored['step']}")
+
+    rng = np.random.default_rng(ctx.seed or 0)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, prompt_len)))
+    gen = jax.jit(
+        lambda p, prompt, key: decode.generate(
+            p, prompt, cfg, max_new_tokens=max_new,
+            temperature=temperature, rng=key,
+        )
+    )
+    pre = jax.jit(
+        lambda p, prompt: decode.prefill(
+            p, prompt, decode.init_cache(cfg, batch, prompt_len + max_new), cfg
+        )[0]
+    )
+    # Host reads are the timing barriers (block_until_ready can return
+    # early on axon tunnels). Prefill is timed separately so the decode
+    # rate isn't diluted by the O(T^2) prompt pass.
+    out = gen(params, prompt, key)
+    np.asarray(out[0, 0])
+    np.asarray(pre(params, prompt)[0, 0])
+    p0 = time.time()
+    np.asarray(pre(params, prompt)[0, 0])
+    prefill_s = time.time() - p0
+    t0 = time.time()
+    out = gen(params, prompt, key)
+    first = np.asarray(out[0, :16])
+    total_s = time.time() - t0
+    tps = batch * max_new / max(total_s - prefill_s, 1e-9)
+    if ctx.is_leader:
+        ctx.log_metrics(
+            decode_tokens_per_s=tps,
+            prefill_s=prefill_s,
+            generated=batch * max_new,
+        )
+        ctx.log_text(
+            f"lm_generate done: {batch}x{max_new} tokens at {tps:.0f} tok/s "
+            f"decode (prefill {prefill_s*1e3:.0f} ms); sample: {first.tolist()}"
+        )
+
+
 def metric_probe(ctx: Context) -> None:
     """Report a deterministic metric of the hyperparams (hpsearch probe).
 

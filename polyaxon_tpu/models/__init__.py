@@ -5,7 +5,7 @@ from polyaxon_tpu.models.transformer import (
     loss_fn,
     param_axes,
 )
-from polyaxon_tpu.models import cnn, vit
+from polyaxon_tpu.models import cnn, decode, vit
 
 __all__ = [
     "TransformerConfig",
@@ -14,5 +14,6 @@ __all__ = [
     "loss_fn",
     "param_axes",
     "cnn",
+    "decode",
     "vit",
 ]

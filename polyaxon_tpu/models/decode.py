@@ -1,0 +1,189 @@
+"""Autoregressive decoding for the flagship LM: KV cache + sampling.
+
+The inference half of the model family (the reference has no serving
+story at all — its notebooks/tensorboards are the closest surface; this
+is capability beyond parity).  TPU-first shape:
+
+- **static-shape KV cache** — a [L, B, max_len, Hkv, d] ring of keys and
+  values updated with ``lax.dynamic_update_slice`` at the current
+  position; no dynamic shapes anywhere, so the whole decode loop is one
+  compiled ``lax.scan``.
+- **GQA-native cache** — the cache stores the UNEXPANDED KV heads
+  (n_kv_heads), the dominant HBM saving of grouped-query attention at
+  inference; broadcast to the query heads happens inside the per-token
+  attention contraction.
+- **prefill via one batched forward** over the prompt (MXU-shaped), then
+  one-token steps; both paths share the same cache layout.
+
+Decode is memory-bandwidth-bound (one token's FLOPs against the whole
+cache), so attention here is plain einsum with a position mask — the
+flash kernel's VMEM blocking buys nothing at query length 1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from polyaxon_tpu.models.transformer import (
+    TransformerConfig,
+    _rmsnorm,
+    _rope,
+)
+
+
+def init_cache(
+    cfg: TransformerConfig, batch: int, max_len: int
+) -> Dict[str, jax.Array]:
+    """Zeroed KV cache: k/v [L, B, max_len, Hkv, d] in the compute dtype."""
+    c = cfg
+    shape = (c.n_layers, batch, max_len, c.kv_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, c.dtype),
+        "v": jnp.zeros(shape, c.dtype),
+    }
+
+
+def _attend_cached(q, ck, cv, pos, group):
+    """One-token attention against the cache.
+
+    q: [B, 1, H, d]; ck/cv: [B, max_len, Hkv, d]; ``pos`` is the current
+    absolute position (entries > pos are future/zero slots — masked).
+    """
+    B, L, Hkv, d = ck.shape
+    scale = d**-0.5
+    # GQA stays grouped INSIDE the contraction — the cache is never
+    # materialized at the query-head count, which is the point of storing
+    # unexpanded heads in the bandwidth-bound decode loop.
+    qg = q.reshape(B, 1, Hkv, group, d)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, ck) * scale  # [B,Hkv,g,1,L]
+    valid = (jnp.arange(L) <= pos)[None, None, None, None, :]
+    s = jnp.where(valid, s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, cv)
+    return out.reshape(B, 1, Hkv * group, d)
+
+
+def _block_step(x, pos, layer, ck, cv, cfg: TransformerConfig):
+    """One transformer block for ONE new token, reading+updating the cache.
+
+    x: [B, 1, D]; ck/cv: [B, max_len, Hkv, d] (this layer's cache slices).
+    Returns (x, ck, cv) with the token's KV rows written at ``pos``.
+    """
+    c = cfg
+    h = _rmsnorm(x, layer["attn_norm"])
+    q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(h.dtype))
+    k = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(h.dtype))
+    v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(h.dtype))
+    positions = jnp.full((x.shape[0], 1), pos)
+    q = _rope(q, positions, c.rope_theta)
+    k = _rope(k, positions, c.rope_theta)
+    ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+    cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+    attn = _attend_cached(q, ck, cv, pos, c.n_heads // c.kv_heads)
+    x = x + jnp.einsum("bthk,hkd->btd", attn, layer["wo"].astype(h.dtype))
+
+    h = _rmsnorm(x, layer["mlp_norm"])
+    up = jnp.einsum("btd,df->btf", h, layer["wi"].astype(h.dtype))
+    gate = jnp.einsum("btd,df->btf", h, layer["wg"].astype(h.dtype))
+    y = jax.nn.silu(gate) * up
+    x = x + jnp.einsum("btf,fd->btd", y, layer["wd"].astype(h.dtype))
+    return x, ck, cv
+
+
+def decode_step(
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    token: jax.Array,
+    pos: jax.Array,
+    cfg: TransformerConfig,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """token [B] at absolute ``pos`` → (logits [B, vocab], updated cache)."""
+    c = cfg
+    x = params["embed"].astype(c.dtype)[token][:, None, :]  # [B,1,D]
+
+    def layer_body(carry, inputs):
+        x = carry
+        layer, ck, cv = inputs
+        x, ck, cv = _block_step(x, pos, layer, ck, cv, c)
+        return x, (ck, cv)
+
+    x, (new_ck, new_cv) = lax.scan(
+        layer_body, x, (params["block"], cache["k"], cache["v"])
+    )
+    x = _rmsnorm(x, params["final_norm"])
+    logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(x.dtype))
+    return logits[:, 0].astype(jnp.float32), {"k": new_ck, "v": new_cv}
+
+
+def prefill(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cache: Dict[str, jax.Array],
+    cfg: TransformerConfig,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Run the prompt [B, T] through the model, filling cache[:, :, :T].
+
+    Rides the TRAINING forward (``return_kv=True``) — one batched
+    MXU-shaped pass whose block is the exact code training runs, so
+    prefill can never drift from it; only the cache write lives here.
+    """
+    from polyaxon_tpu.models.transformer import forward
+
+    logits, (k, v) = forward(params, tokens, cfg, return_kv=True)
+    ck = lax.dynamic_update_slice(cache["k"], k, (0, 0, 0, 0, 0))
+    cv = lax.dynamic_update_slice(cache["v"], v, (0, 0, 0, 0, 0))
+    return logits[:, -1], {"k": ck, "v": cv}
+
+
+def generate(
+    params: Dict[str, Any],
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """prompt [B, T] → generated tokens [B, max_new_tokens].
+
+    Greedy when ``temperature == 0``; otherwise temperature sampling.
+    The whole decode loop is one ``lax.scan`` of compiled one-token
+    steps — no host round-trips between tokens.
+    """
+    if cfg.n_experts:
+        raise NotImplementedError("MoE decoding is not supported yet")
+    B, T = prompt.shape
+    max_len = T + max_new_tokens
+    if max_len > cfg.max_seq:
+        raise ValueError(
+            f"prompt ({T}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq ({cfg.max_seq})"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    cache = init_cache(cfg, B, max_len)
+    logits, cache = prefill(params, prompt, cache, cfg)
+
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        return jax.random.categorical(key, logits / temperature, axis=-1)
+
+    def step(carry, i):
+        logits, cache, key = carry
+        key, sub = jax.random.split(key)
+        token = pick(logits, sub)
+        logits, cache = decode_step(params, cache, token, T + i, cfg)
+        return (logits, cache, key), token
+
+    # N-1 scanned steps; the final token needs only a pick, not another
+    # full decode_step whose logits nobody reads.
+    (logits, _, key), tokens = lax.scan(
+        step, (logits, cache, rng), jnp.arange(max_new_tokens - 1)
+    )
+    last = pick(logits, jax.random.split(key)[1])
+    return jnp.concatenate([tokens.T, last[:, None]], axis=1)

@@ -315,8 +315,16 @@ def forward(
     template=None,
     mesh=None,
     positions: Optional[jax.Array] = None,
+    return_kv: bool = False,
 ) -> jax.Array:
     """tokens [B,T] → logits [B,T,vocab] (float32).
+
+    ``return_kv`` additionally returns the per-layer POST-rope,
+    UNEXPANDED (GQA) key/value stacks ``[L,B,T,Hkv,d]`` — the decode
+    prefill (``models/decode.py``) rides this so the cache layout comes
+    from the SAME block the training forward runs, instead of a
+    duplicated one.  Plain-scan single-program path only (no template),
+    dense MLP only.
 
     ``template`` (a :class:`~polyaxon_tpu.parallel.StrategyTemplate`) plus
     ``mesh`` activate logical sharding constraints and select the attention/
@@ -339,6 +347,11 @@ def forward(
     composed = bool(template is not None and template.pipeline_composed)
     cmesh = None if (pipeline_axis and not composed) else mesh
     use_flash = _use_flash(c, mesh, ring_axis, pipeline_axis, T)
+    if return_kv and (template is not None or c.n_experts):
+        raise NotImplementedError(
+            "return_kv supports the plain-scan dense path only (no "
+            "parallelism template, no MoE)"
+        )
     # Ulysses long-context: the flash kernel can't ride GSPMD (a pallas
     # call is an unpartitionable custom call), so past the dense memory
     # wall (or when forced) the attention goes through the EXPLICIT
@@ -396,6 +409,7 @@ def forward(
         # call); every other path broadcasts KV heads to the query heads
         # here — the einsum/flash/Ulysses machinery then sees plain MHA.
         group = c.n_heads // c.kv_heads
+        kv_cache_k, kv_cache_v = k, v  # post-rope, pre-broadcast (GQA)
         if group > 1 and ring_axis is None:
             k = jnp.repeat(k, group, axis=2)
             v = jnp.repeat(v, group, axis=2)
@@ -417,6 +431,7 @@ def forward(
         q = checkpoint_name(q, "q_proj")
         k = checkpoint_name(k, "k_proj")
         v = checkpoint_name(v, "v_proj")
+        kv_out = (kv_cache_k, kv_cache_v) if return_kv else None
         if ulysses_flash:
             from polyaxon_tpu.parallel.ulysses import ulysses_attention_sharded
 
@@ -465,7 +480,7 @@ def forward(
         y = checkpoint_name(y, "mlp_act")
         x = x + jnp.einsum("btf,fd->btd", y, layer["wd"].astype(h.dtype))
         x = with_logical_constraint(x, ("batch", "seq", None), rules, cmesh)
-        return x, None
+        return x, kv_out
 
     if c.remat:
         # The policy trades HBM for recompute FLOPs: keeping dot outputs
@@ -539,6 +554,8 @@ def forward(
     logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(x.dtype))
     logits = with_logical_constraint(logits, ("batch", "seq", None), rules, cmesh)
     if c.n_experts and aux is not None:
+        return logits.astype(jnp.float32), aux
+    if return_kv:
         return logits.astype(jnp.float32), aux
     return logits.astype(jnp.float32)
 

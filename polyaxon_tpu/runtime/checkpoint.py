@@ -46,16 +46,54 @@ class CheckpointManager:
         opt_state: Any,
         force: bool = False,
     ) -> bool:
-        """Save training state at ``step``; returns whether a save happened."""
+        """Save training state at ``step``; returns whether a save happened.
+
+        Params and optimizer state are separate orbax ITEMS so inference
+        consumers (``lm_generate``) can restore weights without knowing —
+        or paying the memory/IO for — the training optimizer.
+        """
         import orbax.checkpoint as ocp
 
-        state = {"params": params, "opt_state": opt_state}
         return self._mgr.save(
-            step, args=ocp.args.StandardSave(state), force=force
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardSave(params),
+                opt_state=ocp.args.StandardSave(opt_state),
+            ),
+            force=force,
         )
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
+
+    def restore_params(
+        self, params_template: Any, step: Optional[int] = None
+    ) -> Optional[Dict[str, Any]]:
+        """Restore ONLY the model weights (inference path): no optimizer
+        template needed, no optimizer IO paid.  Raises ``ValueError`` for
+        pre-round-4 single-item checkpoints (callers may fall back to
+        :meth:`restore` with an optimizer template for those)."""
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(params_template)
+            ),
+        )
+        import jax
+
+        params = jax.tree.map(
+            lambda t, r: jax.device_put(r, t.sharding)
+            if hasattr(t, "sharding")
+            else r,
+            params_template,
+            restored["params"],
+        )
+        return {"params": params, "step": step}
 
     def restore(
         self,
@@ -67,7 +105,8 @@ class CheckpointManager:
 
         Templates are the freshly-initialized (sharded) state — orbax
         restores each leaf with the template's sharding, so a checkpoint
-        written under one mesh restores correctly onto another.
+        written under one mesh restores correctly onto another.  Reads
+        both the composite (round-4+) and legacy single-item layouts.
         """
         import orbax.checkpoint as ocp
 
@@ -75,9 +114,23 @@ class CheckpointManager:
         if step is None:
             return None
         target = {"params": params_template, "opt_state": opt_state_template}
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(target)
-        )
+        try:
+            composite = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    params=ocp.args.StandardRestore(params_template),
+                    opt_state=ocp.args.StandardRestore(opt_state_template),
+                ),
+            )
+            restored = {
+                "params": composite["params"],
+                "opt_state": composite["opt_state"],
+            }
+        except (ValueError, KeyError, TypeError):
+            # Legacy layout: one StandardSave dict holding both halves.
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(target)
+            )
         # Re-place every leaf onto its template's sharding: orbax restores
         # scalar leaves (e.g. optax's step count) onto the default device,
         # which poisons the jitted step with mixed device sets on a mesh.

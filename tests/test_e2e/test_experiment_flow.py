@@ -179,6 +179,47 @@ class TestCNNWorkload:
 
 
 @pytest.mark.e2e
+class TestGeneration:
+    def test_train_then_generate_from_checkpoint(self, orch):
+        """The serving story: train an LM with checkpoints, then a second
+        run loads those weights by run uuid and decodes — reporting
+        decode throughput as a metric."""
+        shape = {
+            "seq": 32, "d_model": 32, "n_layers": 2, "n_heads": 4,
+            "head_dim": 8, "d_ff": 64, "vocab_size": 64,
+        }
+        train = orch.submit(
+            spec_for(
+                "lm_train",
+                declarations={**shape, "steps": 3, "batch": 4, "save_every": 1},
+            ),
+            name="gen-train",
+        )
+        done = orch.wait(train.id, timeout=120)
+        assert done.status == S.SUCCEEDED, orch.registry.get_logs(train.id)
+
+        gen = orch.submit(
+            spec_for(
+                "lm_generate",
+                declarations={
+                    **shape,
+                    "target": done.uuid,
+                    "prompt_len": 8,
+                    "max_new_tokens": 16,
+                    "batch": 2,
+                },
+            ),
+            name="gen-decode",
+        )
+        gdone = orch.wait(gen.id, timeout=120)
+        logs = "\n".join(l["line"] for l in orch.registry.get_logs(gen.id))
+        assert gdone.status == S.SUCCEEDED, logs
+        assert f"restored weights from run {done.uuid}" in logs
+        assert gdone.last_metric["decode_tokens_per_s"] > 0
+        assert gdone.last_metric["generated"] == 32
+
+
+@pytest.mark.e2e
 class TestViTWorkload:
     def test_vit_distributed_learns(self, orch):
         # Third model family: attention/MLP image classifier through the

@@ -1,0 +1,99 @@
+"""Autoregressive decoding: KV-cache steps must equal the training forward.
+
+The one invariant that makes generation trustworthy: feeding the same
+token sequence through cached one-token steps reproduces the batched
+training ``forward``'s logits position for position (prefill included).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models import TransformerConfig, decode, forward, init_params
+
+CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    head_dim=8,
+    d_ff=64,
+    max_seq=32,
+    dtype=jnp.float32,
+)
+KEY = jax.random.PRNGKey(0)
+
+
+class TestDecodeMatchesForward:
+    @pytest.mark.parametrize("n_kv_heads", [None, 2])
+    def test_cached_steps_reproduce_forward_logits(self, n_kv_heads):
+        cfg = CFG.scaled(n_kv_heads=n_kv_heads)
+        params = init_params(KEY, cfg)
+        rng = np.random.default_rng(4)
+        B, T = 2, 12
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)))
+        ref = forward(params, tokens, cfg)  # [B, T, vocab]
+
+        # Prefill on the first half, then teacher-forced cached steps.
+        t0 = 6
+        cache = decode.init_cache(cfg, B, T)
+        logits, cache = decode.prefill(params, tokens[:, :t0], cache, cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref[:, t0 - 1]), atol=2e-4
+        )
+        for pos in range(t0, T):
+            logits, cache = decode.decode_step(
+                params, cache, tokens[:, pos], pos, cfg
+            )
+            np.testing.assert_allclose(
+                np.asarray(logits), np.asarray(ref[:, pos]), atol=2e-4,
+                err_msg=f"pos {pos}",
+            )
+
+    def test_greedy_generation_is_deterministic_and_in_vocab(self):
+        params = init_params(KEY, CFG)
+        rng = np.random.default_rng(5)
+        prompt = jnp.asarray(rng.integers(0, CFG.vocab_size, (2, 8)))
+        a = decode.generate(params, prompt, CFG, max_new_tokens=10)
+        b = decode.generate(params, prompt, CFG, max_new_tokens=10)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a.shape == (2, 10)
+        assert (np.asarray(a) >= 0).all() and (np.asarray(a) < CFG.vocab_size).all()
+
+    def test_greedy_matches_argmax_of_forward(self):
+        """The first generated token must be the argmax of the training
+        forward at the prompt's last position."""
+        params = init_params(KEY, CFG)
+        rng = np.random.default_rng(6)
+        prompt = jnp.asarray(rng.integers(0, CFG.vocab_size, (2, 8)))
+        out = decode.generate(params, prompt, CFG, max_new_tokens=1)
+        ref = forward(params, prompt, CFG)
+        np.testing.assert_array_equal(
+            np.asarray(out[:, 0]), np.asarray(jnp.argmax(ref[:, -1], axis=-1))
+        )
+
+    def test_sampling_respects_temperature_rng(self):
+        params = init_params(KEY, CFG)
+        rng = np.random.default_rng(7)
+        prompt = jnp.asarray(rng.integers(0, CFG.vocab_size, (1, 8)))
+        a = decode.generate(
+            params, prompt, CFG, max_new_tokens=12, temperature=1.0,
+            rng=jax.random.PRNGKey(1),
+        )
+        b = decode.generate(
+            params, prompt, CFG, max_new_tokens=12, temperature=1.0,
+            rng=jax.random.PRNGKey(1),
+        )
+        c = decode.generate(
+            params, prompt, CFG, max_new_tokens=12, temperature=1.0,
+            rng=jax.random.PRNGKey(2),
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_overlong_generation_rejected(self):
+        params = init_params(KEY, CFG)
+        prompt = jnp.zeros((1, 30), jnp.int32)
+        with pytest.raises(ValueError, match="max_seq"):
+            decode.generate(params, prompt, CFG, max_new_tokens=10)
