@@ -46,6 +46,7 @@ def run_to_dict(run: Run) -> Dict[str, Any]:
         "created_at": run.created_at,
         "started_at": run.started_at,
         "finished_at": run.finished_at,
+        "archived_at": run.archived_at,
         "spec": run.spec_data,
     }
 
@@ -201,6 +202,10 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             request.get("auth_required", False)
             and request.get("role") != "admin"
         )
+        # ?archived=true → archived only; ?archived=all → both; default =
+        # live runs only (the reference's default model manager).
+        archived_q = (q.get("archived") or "").lower()
+        archived = {"true": True, "1": True, "all": None}.get(archived_q, False)
         runs = reg.list_runs(
             kind=q.get("kind"),
             project=q.get("project"),
@@ -210,6 +215,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             extra_where=(clauses, params) if clauses else None,
             limit=None if post_filter else limit,
             offset=0 if post_filter else offset,
+            archived=archived,
         )
         if residual:
             runs = apply_query(runs, conditions=residual)
@@ -253,6 +259,38 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         run = _run_or_404(request)
         clone = orch.clone_run(run.id, strategy="copy", actor=request.get("actor"))
         return web.json_response(run_to_dict(clone), status=201)
+
+    # -- archival + deletion (reference api/archives/ + delete views) ---------
+    @routes.post(f"{API_PREFIX}/runs/{{run_id}}/archive")
+    async def archive_run(request):
+        run = _run_or_404(request)
+        orch.archive_run(run.id, actor=request.get("actor"))
+        return web.json_response(run_to_dict(reg.get_run(run.id)))
+
+    @routes.post(f"{API_PREFIX}/runs/{{run_id}}/restore")
+    async def restore_run(request):
+        run = _run_or_404(request)
+        orch.restore_run(run.id, actor=request.get("actor"))
+        return web.json_response(run_to_dict(reg.get_run(run.id)))
+
+    @routes.delete(f"{API_PREFIX}/runs/{{run_id}}")
+    async def delete_run(request):
+        run = _run_or_404(request)
+        deleted = orch.delete_run(run.id, actor=request.get("actor"))
+        return web.json_response({"ok": True, "deleted": deleted})
+
+    @routes.get(f"{API_PREFIX}/archives")
+    async def list_archives(request):
+        """Archived runs, visible-project-filtered (reference archives API)."""
+        runs = reg.list_runs(archived=True)
+        decided: Dict[str, bool] = {}
+        visible = []
+        for r in runs:
+            if r.project not in decided:
+                decided[r.project] = not _project_denied(request, r.project)
+            if decided[r.project]:
+                visible.append(r)
+        return web.json_response({"results": [run_to_dict(r) for r in visible]})
 
     # -- sub-resources --------------------------------------------------------
     @routes.get(f"{API_PREFIX}/runs/{{run_id}}/statuses")
@@ -406,7 +444,11 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
     async def delete_project(request):
         _require_project_owner(request, request.match_info["name"])
         try:
-            removed = reg.delete_project(request.match_info["name"])
+            # Orchestrator-level: cascades to the project's archived runs
+            # and GCs their artifacts; refuses while live runs exist.
+            removed = orch.delete_project(
+                request.match_info["name"], actor=request.get("actor")
+            )
         except PolyaxonTPUError as e:
             return web.json_response({"error": str(e)}, status=400)
         if not removed:
@@ -414,7 +456,6 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 text=json.dumps({"error": "no such project"}),
                 content_type="application/json",
             )
-        _audit(request, EventTypes.PROJECT_DELETED, project=request.match_info["name"])
         return web.json_response({"ok": True})
 
     @routes.post(f"{API_PREFIX}/projects/{{name}}/collaborators")
@@ -499,6 +540,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         runs = reg.list_runs(
             extra_where=(clauses, params) if clauses else None,
             limit=None if residual else limit,
+            archived=False,
         )
         if residual:
             runs = apply_query(runs, conditions=residual)[:limit]

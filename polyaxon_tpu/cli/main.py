@@ -80,6 +80,18 @@ class RemoteClient:
     def clone(self, run_id, strategy):
         return self._request("POST", f"/api/v1/runs/{run_id}/{strategy}")
 
+    def archive(self, run_id):
+        return self._request("POST", f"/api/v1/runs/{run_id}/archive")
+
+    def restore(self, run_id):
+        return self._request("POST", f"/api/v1/runs/{run_id}/restore")
+
+    def delete(self, run_id):
+        return self._request("DELETE", f"/api/v1/runs/{run_id}")
+
+    def list_archives(self):
+        return self._request("GET", "/api/v1/archives")["results"]
+
     def logs(self, run_id, since_id=0):
         return self._request(
             "GET", f"/api/v1/runs/{run_id}/logs?since_id={since_id}"
@@ -209,6 +221,7 @@ class LocalClient:
         runs = self.orch.registry.list_runs(
             project=query.get("project"),
             kind=query.get("kind"),
+            archived=False,
         )
         if query.get("q"):
             from polyaxon_tpu.query import apply_query
@@ -227,6 +240,25 @@ class LocalClient:
 
     def clone(self, run_id, strategy):
         return self._to_dict(self.orch.clone_run(int(run_id), strategy=strategy))
+
+    def archive(self, run_id):
+        self.orch.archive_run(int(run_id))
+        self.orch.pump(max_wait=1.0)
+        return self._to_dict(self.orch.get_run(int(run_id)))
+
+    def restore(self, run_id):
+        self.orch.restore_run(int(run_id))
+        return self._to_dict(self.orch.get_run(int(run_id)))
+
+    def delete(self, run_id):
+        deleted = self.orch.delete_run(int(run_id))
+        return {"ok": True, "deleted": deleted}
+
+    def list_archives(self):
+        return [
+            self._to_dict(r)
+            for r in self.orch.registry.list_runs(archived=True)
+        ]
 
     def logs(self, run_id, since_id=0):
         self.orch.pump()
@@ -301,7 +333,9 @@ class LocalClient:
             raise SystemExit(f"no search named {name!r}")
         from polyaxon_tpu.query import apply_query
 
-        runs = apply_query(self.orch.registry.list_runs(), search["query"])
+        runs = apply_query(
+            self.orch.registry.list_runs(archived=False), search["query"]
+        )
         return [self._to_dict(r) for r in runs]
 
     def create_project(self, name, description, owner=None):
@@ -324,7 +358,13 @@ class LocalClient:
         return self.orch.registry.list_projects()
 
     def delete_project(self, name):
-        if not self.orch.registry.delete_project(name):
+        from polyaxon_tpu.exceptions import PolyaxonTPUError
+
+        try:
+            removed = self.orch.delete_project(name)
+        except PolyaxonTPUError as e:
+            raise SystemExit(str(e))
+        if not removed:
             raise SystemExit(f"no project named {name!r}")
         return {"ok": True}
 
@@ -365,7 +405,7 @@ CLONE_STRATEGIES = ("restart", "resume", "copy")
 #: stranded work on startup. `logs --follow` is included: following a run
 #: started by a previous invocation requires reattaching its gang to make
 #: progress (each CLI invocation is a fresh control plane).
-_DRIVING_COMMANDS = {"run", "stop", *CLONE_STRATEGIES}
+_DRIVING_COMMANDS = {"run", "stop", "archive", "delete", *CLONE_STRATEGIES}
 
 
 def _client(args):
@@ -510,6 +550,21 @@ def main(argv=None) -> int:
 
     p_stop = sub.add_parser("stop", help="stop a run")
     p_stop.add_argument("run_id")
+
+    p_archive = sub.add_parser(
+        "archive", help="hide a run from listings (stops it if live)"
+    )
+    p_archive.add_argument("run_id")
+
+    p_restore = sub.add_parser("restore", help="un-archive a run")
+    p_restore.add_argument("run_id")
+
+    p_delete = sub.add_parser(
+        "delete", help="purge a run: rows, outputs, logs, store artifacts"
+    )
+    p_delete.add_argument("run_id")
+
+    sub.add_parser("archives", help="list archived runs")
 
     for strategy in CLONE_STRATEGIES:
         p = sub.add_parser(strategy, help=f"{strategy} a run as a clone")
@@ -701,6 +756,24 @@ def main(argv=None) -> int:
         if args.command == "stop":
             client.stop(args.run_id)
             print("stopped", file=sys.stderr)
+            return 0
+        if args.command == "archive":
+            run = client.archive(args.run_id)
+            print(f"archived run {run['id']}", file=sys.stderr)
+            return 0
+        if args.command == "restore":
+            run = client.restore(args.run_id)
+            print(f"restored run {run['id']}", file=sys.stderr)
+            return 0
+        if args.command == "delete":
+            out = client.delete(args.run_id)
+            print(
+                f"deleted {out.get('deleted', 1)} run(s) and their data",
+                file=sys.stderr,
+            )
+            return 0
+        if args.command == "archives":
+            _print_runs(client.list_archives())
             return 0
         if args.command in CLONE_STRATEGIES:
             clone = client.clone(args.run_id, args.command)

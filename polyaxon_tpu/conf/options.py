@@ -135,6 +135,8 @@ _ALL = [
     Option("restarts.max_allowed", int, 10,
            "upper bound on restart_policy.max_restarts"),
     Option("logs.retention_days", float, 30.0, "activity/log cleanup horizon"),
+    Option("cleaning.archives_ttl_days", float, 7.0,
+           "archived runs older than this are purged by the cron"),
     Option("api.page_size", int, 100, "default list page size"),
     Option("stats.backend", str, "memory",
            "operational metrics sink (restart required)",

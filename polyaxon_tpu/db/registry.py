@@ -74,7 +74,8 @@ CREATE TABLE IF NOT EXISTS runs (
     created_at REAL NOT NULL,
     updated_at REAL NOT NULL,
     started_at REAL,
-    finished_at REAL
+    finished_at REAL,
+    archived_at REAL
 );
 CREATE INDEX IF NOT EXISTS ix_runs_kind ON runs (kind);
 CREATE INDEX IF NOT EXISTS ix_runs_group ON runs (group_id);
@@ -241,6 +242,9 @@ class Run:
     updated_at: float = 0.0
     started_at: Optional[float] = None
     finished_at: Optional[float] = None
+    #: Set = hidden from default listings, eligible for the retention
+    #: purge cron (reference archived managers + ``crons/tasks/deletion.py``).
+    archived_at: Optional[float] = None
 
     @property
     def spec(self) -> BaseSpecification:
@@ -279,6 +283,7 @@ def _row_to_run(row: sqlite3.Row) -> Run:
         updated_at=row["updated_at"],
         started_at=row["started_at"],
         finished_at=row["finished_at"],
+        archived_at=row["archived_at"],
     )
 
 
@@ -316,6 +321,8 @@ class RunRegistry:
                 # NULL = locally-created user; set = which SSO provider
                 # owns this identity (no cross-takeover by name collision).
                 conn.execute("ALTER TABLE users ADD COLUMN sso_provider TEXT")
+            if "archived_at" not in run_cols:
+                conn.execute("ALTER TABLE runs ADD COLUMN archived_at REAL")
 
     # -- connection management ------------------------------------------------
     def _conn(self) -> sqlite3.Connection:
@@ -413,11 +420,23 @@ class RunRegistry:
         limit: Optional[int] = None,
         offset: int = 0,
         extra_where: Optional[Tuple[Sequence[str], Sequence[Any]]] = None,
+        archived: Optional[bool] = None,
     ) -> List[Run]:
         """``extra_where`` is (clauses, params) compiled by the query DSL
         builder — pushed-down conditions on real columns (the reference
-        compiles its DSL into queryset filters, ``query/builder.py:18-31``)."""
+        compiles its DSL into queryset filters, ``query/builder.py:18-31``).
+
+        ``archived`` mirrors the reference's default/archived model
+        managers (its archives API lists them separately): False = live
+        runs only, True = archived only, None = both.  The default is
+        None — include everything — because the control plane itself
+        (polyflow dag checks, hpsearch trial accounting, recovery) must
+        see archived rows; USER listing surfaces (API/CLI) pass False."""
         clauses, params = [], []
+        if archived is False:
+            clauses.append("archived_at IS NULL")
+        elif archived is True:
+            clauses.append("archived_at IS NOT NULL")
         if extra_where is not None:
             clauses.extend(extra_where[0])
             params.extend(extra_where[1])
@@ -467,6 +486,122 @@ class RunRegistry:
                 f"UPDATE runs SET {sets}, updated_at = ? WHERE id = ?",
                 (*fields.values(), time.time(), run_id),
             )
+
+    # -- archival + deletion ---------------------------------------------------
+    # Parity: the reference's archived model managers + archives API
+    # (``api/archives/``) and its archive-deletion beat pipeline
+    # (``crons/tasks/deletion.py`` → ``scheduler/tasks/deletion.py``,
+    # scheduled at ``config_settings/celery_settings.py:740-860``).  The
+    # registry only flips rows; stopping gangs and GC-ing artifacts is the
+    # orchestrator's job (it owns the spawner and the stores).
+
+    def archive_run(self, run_id: int) -> bool:
+        """Hide a run (and its children — a group's trials, a pipeline's
+        ops) from user listings; returns False if already archived.
+        Archived runs keep full history (statuses/metrics/logs) until the
+        retention cron or an explicit delete purges them.  Cascading here
+        keeps archive symmetric with delete_run's cascade: nothing can be
+        purged by the parent's retention sweep while still presenting as
+        a live run in the default view."""
+        family = self._family_ids(run_id)
+        marks = ",".join("?" * len(family))
+        now = time.time()
+        with self._lock, self._conn() as conn:
+            cur = conn.execute(
+                f"UPDATE runs SET archived_at = ?, updated_at = ?"
+                f" WHERE id IN ({marks}) AND archived_at IS NULL",
+                (now, now, *family),
+            )
+        return cur.rowcount > 0
+
+    def restore_run(self, run_id: int) -> bool:
+        """Un-archive a run and its children (the reference archives
+        API's restore endpoints)."""
+        family = self._family_ids(run_id)
+        marks = ",".join("?" * len(family))
+        with self._lock, self._conn() as conn:
+            cur = conn.execute(
+                f"UPDATE runs SET archived_at = NULL, updated_at = ?"
+                f" WHERE id IN ({marks}) AND archived_at IS NOT NULL",
+                (time.time(), *family),
+            )
+        return cur.rowcount > 0
+
+    def _family_ids(self, run_id: int) -> List[int]:
+        """``run_id`` plus every transitive child (trials via group_id,
+        pipeline ops via pipeline_id).  Raises if the root is missing."""
+        if not self._run_exists(run_id):
+            raise RegistryError(f"No run with id={run_id}")
+        out: List[int] = []
+        frontier = [run_id]
+        seen = set()
+        while frontier:
+            rid = frontier.pop()
+            if rid in seen:
+                continue
+            seen.add(rid)
+            out.append(rid)
+            for child in self._conn().execute(
+                "SELECT id FROM runs WHERE group_id = ? OR pipeline_id = ?",
+                (rid, rid),
+            ):
+                frontier.append(child["id"])
+        return out
+
+    def _run_exists(self, run_id: int) -> bool:
+        return (
+            self._conn()
+            .execute("SELECT 1 FROM runs WHERE id = ?", (run_id,))
+            .fetchone()
+            is not None
+        )
+
+    def delete_run(self, run_id: int) -> List[Run]:
+        """Purge a run and every row that references it, CASCADING to its
+        children (a group's trials, a pipeline's operations — the reference
+        gets this from FK on_delete cascades).  Returns the deleted Run
+        records (pre-delete snapshots) so the caller can GC outputs dirs
+        and store artifacts — the registry never touches the filesystem."""
+        victims = [self.get_run(rid) for rid in self._family_ids(run_id)]
+        ids = [r.id for r in victims]
+        marks = ",".join("?" * len(ids))
+        with self._lock, self._conn() as conn:
+            # Free any held slices before the claim rows go away.
+            conn.execute(
+                f"UPDATE devices SET run_id = NULL, updated_at = ?"
+                f" WHERE run_id IN ({marks})",
+                (time.time(), *ids),
+            )
+            for table, col in (
+                ("device_claims", "run_id"),
+                ("statuses", "run_id"),
+                ("metrics", "run_id"),
+                ("logs", "run_id"),
+                ("heartbeats", "run_id"),
+                ("processes", "run_id"),
+                ("bookmarks", "run_id"),
+                ("iterations", "group_id"),
+                ("runs", "id"),
+            ):
+                conn.execute(
+                    f"DELETE FROM {table} WHERE {col} IN ({marks})", ids
+                )
+        return victims
+
+    def archived_runs_older_than(
+        self, seconds: float, now: Optional[float] = None
+    ) -> List[Run]:
+        """Archived runs past the retention horizon — the purge cron's
+        worklist (reference ``CLEANING_INTERVALS_ARCHIVES`` date check).
+        Children of an archived group/pipeline are purged with their
+        parent via delete_run's cascade, so only top-level rows return."""
+        cutoff = (now or time.time()) - seconds
+        rows = self._conn().execute(
+            "SELECT * FROM runs WHERE archived_at IS NOT NULL AND archived_at < ?"
+            " ORDER BY id",
+            (cutoff,),
+        ).fetchall()
+        return [_row_to_run(r) for r in rows]
 
     # -- statuses -------------------------------------------------------------
     def set_status(
@@ -1215,16 +1350,35 @@ class RunRegistry:
             "collaborators": self.project_collaborators(name),
         }
 
-    def delete_project(self, name: str) -> bool:
-        """Refuses while runs still reference it (archive them first)."""
-        n = self._conn().execute(
-            "SELECT COUNT(*) FROM runs WHERE project = ?", (name,)
+    def delete_project(self, name: str) -> Tuple[bool, List[Run]]:
+        """Delete a project, cascading to its ARCHIVED runs (returned so
+        the caller can GC their artifacts).  Refuses while live (non-
+        archived) runs still reference it — archive-then-delete is the
+        flow, matching the reference where only archived entities are
+        deletable and ``project.delete()`` cascades."""
+        live = self._conn().execute(
+            "SELECT COUNT(*) FROM runs WHERE project = ? AND archived_at IS NULL",
+            (name,),
         ).fetchone()[0]
-        if n:
-            raise RegistryError(f"Project {name!r} still has {n} runs")
+        if live:
+            raise RegistryError(
+                f"Project {name!r} still has {live} live runs; archive or"
+                " delete them first"
+            )
+        victims: List[Run] = []
+        for row in self._conn().execute(
+            "SELECT id FROM runs WHERE project = ?", (name,)
+        ).fetchall():
+            try:
+                victims.extend(self.delete_run(row["id"]))
+            except RegistryError:
+                continue  # already cascaded away with an earlier parent
         with self._lock, self._conn() as conn:
+            conn.execute(
+                "DELETE FROM project_collaborators WHERE project_name = ?", (name,)
+            )
             cur = conn.execute("DELETE FROM projects WHERE name = ?", (name,))
-            return cur.rowcount > 0
+            return cur.rowcount > 0, victims
 
     # -- saved searches (reference api/searches/) ------------------------------
     def create_search(

@@ -37,6 +37,9 @@ class EventTypes:
     EXPERIMENT_DONE = "experiment.done"
     EXPERIMENT_ZOMBIE = "experiment.zombie"
     EXPERIMENT_ARTIFACTS_SYNCED = "experiment.artifacts_synced"
+    EXPERIMENT_ARCHIVED = "experiment.archived"
+    EXPERIMENT_RESTORED = "experiment.restored"
+    EXPERIMENT_DELETED = "experiment.deleted"
 
     # groups (events/registry/experiment_group.py)
     GROUP_CREATED = "group.created"

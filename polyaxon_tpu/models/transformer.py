@@ -547,7 +547,10 @@ def forward(
         x, scan_aux = lax.scan(
             lambda carry, layer: body(carry, positions, layer), x, params["block"]
         )
-        if c.n_experts:
+        # scan_aux is the per-layer stack of whatever ``block`` returned as
+        # its aux: MoE gate stats when n_experts, else the (k, v) cache
+        # rows when return_kv (each [L, B, T, Hkv, d] after stacking).
+        if c.n_experts or return_kv:
             aux = scan_aux
 
     x = _rmsnorm(x, norm_w(params["final_norm"]))

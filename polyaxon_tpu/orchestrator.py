@@ -16,6 +16,7 @@ Two operating modes:
 
 from __future__ import annotations
 
+import logging
 import time
 from pathlib import Path
 from typing import Any, Dict, Optional, Union
@@ -32,6 +33,8 @@ from polyaxon_tpu.schemas.specifications import BaseSpecification, Kinds
 from polyaxon_tpu.scheduler.tasks import SchedulerContext, register_scheduler_tasks
 from polyaxon_tpu.stores import StoreLayout
 from polyaxon_tpu.workers import CronTasks, SchedulerTasks, TaskBus
+
+logger = logging.getLogger(__name__)
 
 
 class Orchestrator:
@@ -384,6 +387,14 @@ class Orchestrator:
             3600.0,
             {"retention_seconds": self.conf.get("logs.retention_days") * 86400.0},
         )
+        # Archived-run purge (reference DELETE_ARCHIVED_* beat entries,
+        # ``celery_settings.py:740-860``): archived runs past the TTL are
+        # deleted outright, data and all.
+        self.bus.add_cron(
+            CronTasks.CLEAN_ARCHIVES,
+            3600.0,
+            {"ttl_seconds": self.conf.get("cleaning.archives_ttl_days") * 86400.0},
+        )
         self.bus.start()
 
     def _release_lease(self) -> None:
@@ -505,6 +516,78 @@ class Orchestrator:
 
     def get_run(self, run_id: Union[int, str]) -> Run:
         return self.registry.get_run(run_id)
+
+    # -- archival + deletion ---------------------------------------------------
+    # Parity: reference archive/restore/delete views + the deletion tasks
+    # (``api/experiments/views.py`` archive/restore actions,
+    # ``scheduler/tasks/deletion.py``).  Archive stops a live run first —
+    # an archived run must not keep burning a slice.
+
+    def archive_run(self, run_id: int, actor: Optional[str] = None) -> bool:
+        run = self.registry.get_run(run_id)
+        extra = {"actor": actor} if actor else {}
+        if not run.is_done:
+            self.stop_run(run_id, actor=actor)
+        changed = self.registry.archive_run(run_id)
+        if changed:
+            self.auditor.record(
+                EventTypes.EXPERIMENT_ARCHIVED, run_id=run_id, **extra
+            )
+        return changed
+
+    def restore_run(self, run_id: int, actor: Optional[str] = None) -> bool:
+        changed = self.registry.restore_run(run_id)
+        if changed:
+            self.auditor.record(
+                EventTypes.EXPERIMENT_RESTORED,
+                run_id=run_id,
+                **({"actor": actor} if actor else {}),
+            )
+        return changed
+
+    def delete_run(self, run_id: int, actor: Optional[str] = None) -> int:
+        """Purge a run (cascading to trials/ops), its outputs dirs, and its
+        store artifacts.  Live runs are stopped SYNCHRONOUSLY first: the
+        stop task must not race the row deletion on the bus."""
+        run = self.registry.get_run(run_id)
+        if not run.is_done:
+            self.stop_run(run_id, actor=actor)
+            # The stop rides the bus; deletion is destructive, so wait for
+            # the gang to die rather than deleting rows out from under the
+            # stop handler. A stuck stop doesn't block the purge: the
+            # handler's late status writes fail harmlessly once the row is
+            # gone (set_status raises on a missing run; the bus logs it).
+            try:
+                self.wait(run_id, timeout=10.0)
+            except PolyaxonTPUError:
+                pass
+        victims = self.registry.delete_run(run_id)
+        self._gc_run_data(victims)
+        self.auditor.record(
+            EventTypes.EXPERIMENT_DELETED,
+            run_id=run_id,
+            cascaded=len(victims) - 1,
+            **({"actor": actor} if actor else {}),
+        )
+        return len(victims)
+
+    def delete_project(self, name: str, actor: Optional[str] = None) -> bool:
+        """Archive-then-delete flow: refuses while live runs exist, then
+        purges the project row AND its archived runs' data."""
+        removed, victims = self.registry.delete_project(name)
+        self._gc_run_data(victims)
+        if removed:
+            self.auditor.record(
+                EventTypes.PROJECT_DELETED,
+                project=name,
+                **({"actor": actor} if actor else {}),
+            )
+        return removed
+
+    def _gc_run_data(self, victims: list) -> None:
+        from polyaxon_tpu.stores import gc_run_data
+
+        gc_run_data(self.layout, self.artifact_store, victims)
 
     def clone_run(
         self, run_id: int, strategy: str = "restart", actor: Optional[str] = None

@@ -19,7 +19,7 @@ from typing import Dict, Optional
 
 from polyaxon_tpu.auditor import Auditor
 from polyaxon_tpu.compiler import compile_gang_plan
-from polyaxon_tpu.db.registry import RunRegistry
+from polyaxon_tpu.db.registry import RegistryError, RunRegistry
 from polyaxon_tpu.events import EventTypes
 from polyaxon_tpu.exceptions import PolyaxonTPUError
 from polyaxon_tpu.lifecycles import StatusOptions as S
@@ -393,6 +393,32 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
         removed = reg.clean_old_rows(retention_seconds)
         if any(removed.values()):
             logger.info("Retention cleanup removed %s", removed)
+
+    @bus.register(CronTasks.CLEAN_ARCHIVES)
+    def clean_archives(ttl_seconds: float = 7 * 86400.0) -> None:
+        """Purge archived runs past the retention horizon — rows, outputs
+        dirs, and store trees.  Parity: the reference's DELETE_ARCHIVED_*
+        beat crons (``crons/tasks/deletion.py`` → the scheduler deletion
+        tasks), collapsed to one pass over the registry."""
+        from polyaxon_tpu.stores import gc_run_data
+
+        for run in reg.archived_runs_older_than(ttl_seconds):
+            try:
+                victims = reg.delete_run(run.id)
+            except RegistryError:
+                continue  # already cascaded away with an earlier parent
+            gc_run_data(ctx.layout, ctx.artifact_store, victims)
+            ctx.auditor.record(
+                EventTypes.EXPERIMENT_DELETED,
+                run_id=run.id,
+                cascaded=len(victims) - 1,
+                reason="archive_retention",
+            )
+            logger.info(
+                "Archive retention purged run %s (+%d children)",
+                run.id,
+                len(victims) - 1,
+            )
 
     @bus.register(CronTasks.HEARTBEAT_CHECK)
     def heartbeat_check() -> None:

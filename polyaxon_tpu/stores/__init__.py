@@ -3,6 +3,7 @@ from polyaxon_tpu.stores.artifacts import (
     GsutilArtifactStore,
     LocalArtifactStore,
     artifact_store_from_url,
+    gc_run_data,
     run_prefix,
     sync_run_down,
     sync_run_up,
@@ -22,4 +23,5 @@ __all__ = [
     "run_prefix",
     "sync_run_up",
     "sync_run_down",
+    "gc_run_data",
 ]

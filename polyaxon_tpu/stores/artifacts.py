@@ -316,3 +316,25 @@ def sync_run_down(store: ArtifactStore, run_paths, run_uuid: str) -> int:
             f"{run_prefix(run_uuid)}/{sub}", run_paths.root / sub
         )
     return n
+
+
+def gc_run_data(layout, store: "ArtifactStore | None", victims) -> None:
+    """Remove deleted runs' local dirs and durable store trees.
+
+    The one GC body behind every deletion path (user DELETE, project
+    cascade, archived-retention cron) so they can't drift apart.  A
+    failed store delete is logged, never raised: data GC must not block
+    row deletion (the reference's deletion tasks swallow store errors
+    the same way)."""
+    import logging
+    import shutil
+
+    for v in victims:
+        shutil.rmtree(layout.run_paths(v.uuid).root, ignore_errors=True)
+        if store is not None:
+            try:
+                store.delete(run_prefix(v.uuid))
+            except Exception:  # noqa: BLE001 — GC must not block deletion
+                logging.getLogger(__name__).warning(
+                    "Artifact GC failed for %s", v.uuid, exc_info=True
+                )

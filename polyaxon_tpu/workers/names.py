@@ -40,3 +40,4 @@ class CronTasks:
     HEARTBEAT_CHECK = "crons.heartbeat_check"
     STATUS_RECONCILE = "crons.status_reconcile"
     CLEAN_ACTIVITY = "crons.clean_activity"
+    CLEAN_ARCHIVES = "crons.clean_archives"

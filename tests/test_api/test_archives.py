@@ -1,0 +1,129 @@
+"""REST surface for archival + deletion.
+
+Parity: reference archives API (``api/archives/``) + experiment delete
+views.
+"""
+
+import asyncio
+
+import pytest
+
+from polyaxon_tpu.api.app import create_app
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.orchestrator import Orchestrator
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(tmp_path / "plat", monitor_interval=0.05)
+    yield o
+    o.stop()
+
+
+def drive(orch, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        app = create_app(orch)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+async def _wait_done(orch, client, run_id, timeout=60.0):
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        await loop.run_in_executor(None, orch.pump, 0.05)
+        resp = await client.get(f"/api/v1/runs/{run_id}")
+        data = await resp.json()
+        if data["is_done"]:
+            return data
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"run {run_id} not done after {timeout}s")
+
+
+class TestArchivesAPI:
+    def test_archive_restore_roundtrip(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            await _wait_done(orch, client, run["id"])
+
+            resp = await client.post(f"/api/v1/runs/{run['id']}/archive")
+            assert resp.status == 200
+            archived = await resp.json()
+            assert archived["archived_at"] is not None
+
+            # Default listing hides it; ?archived=true and /archives show it.
+            listed = await (await client.get("/api/v1/runs")).json()
+            assert run["id"] not in [r["id"] for r in listed["results"]]
+            arch = await (
+                await client.get("/api/v1/runs?archived=true")
+            ).json()
+            assert [r["id"] for r in arch["results"]] == [run["id"]]
+            arch2 = await (await client.get("/api/v1/archives")).json()
+            assert [r["id"] for r in arch2["results"]] == [run["id"]]
+            everything = await (
+                await client.get("/api/v1/runs?archived=all")
+            ).json()
+            assert run["id"] in [r["id"] for r in everything["results"]]
+
+            resp = await client.post(f"/api/v1/runs/{run['id']}/restore")
+            assert (await resp.json())["archived_at"] is None
+            listed = await (await client.get("/api/v1/runs")).json()
+            assert run["id"] in [r["id"] for r in listed["results"]]
+            return True
+
+        assert drive(orch, body)
+
+    def test_delete_run_endpoint(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            done = await _wait_done(orch, client, run["id"])
+            assert done["status"] == S.SUCCEEDED
+            resp = await client.delete(f"/api/v1/runs/{run['id']}")
+            assert resp.status == 200
+            out = await resp.json()
+            assert out["ok"] and out["deleted"] == 1
+            resp = await client.get(f"/api/v1/runs/{run['id']}")
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)
+
+    def test_project_delete_requires_archival(self, orch):
+        async def body(client):
+            await client.post("/api/v1/projects", json={"name": "padel"})
+            run = await (
+                await client.post(
+                    "/api/v1/runs", json={"spec": SPEC, "project": "padel"}
+                )
+            ).json()
+            await _wait_done(orch, client, run["id"])
+            resp = await client.delete("/api/v1/projects/padel")
+            assert resp.status == 400  # live run blocks deletion
+            await client.post(f"/api/v1/runs/{run['id']}/archive")
+            resp = await client.delete("/api/v1/projects/padel")
+            assert resp.status == 200  # archived runs cascade away
+            resp = await client.get(f"/api/v1/runs/{run['id']}")
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)

@@ -1,0 +1,161 @@
+"""Run archival + deletion at the registry layer.
+
+Parity target: the reference's archived model managers, archives API
+(``api/archives/``), and the archived-deletion beat pipeline
+(``crons/tasks/deletion.py`` → ``scheduler/tasks/deletion.py``).
+"""
+
+import pytest
+
+from polyaxon_tpu.db.registry import RegistryError, RunRegistry
+from polyaxon_tpu.lifecycles import StatusOptions as S
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "noop:main"},
+    "environment": {"topology": {"accelerator": "cpu", "num_devices": 1}},
+}
+
+
+@pytest.fixture()
+def reg(tmp_path):
+    r = RunRegistry(tmp_path / "reg.db")
+    yield r
+    r.close()
+
+
+def _finished(reg, **kw):
+    run = reg.create_run(dict(SPEC), **kw)
+    for s in (S.SCHEDULED, S.STARTING, S.RUNNING, S.SUCCEEDED):
+        reg.set_status(run.id, s)
+    return reg.get_run(run.id)
+
+
+class TestArchive:
+    def test_archive_hides_from_user_listing(self, reg):
+        run = _finished(reg)
+        other = _finished(reg)
+        assert reg.archive_run(run.id)
+        # archived=False is what user surfaces (API/CLI) pass.
+        ids = [r.id for r in reg.list_runs(archived=False)]
+        assert run.id not in ids and other.id in ids
+        assert [r.id for r in reg.list_runs(archived=True)] == [run.id]
+        # The default (None) keeps the control plane's view complete —
+        # polyflow dag checks and hpsearch accounting must see everything.
+        both = [r.id for r in reg.list_runs()]
+        assert set(both) == {run.id, other.id}
+        assert reg.get_run(run.id).archived_at is not None
+
+    def test_archive_cascades_to_children(self, reg):
+        group = reg.create_run({**SPEC, "kind": "group"})
+        t1 = reg.create_run(dict(SPEC), group_id=group.id)
+        t2 = reg.create_run(dict(SPEC), group_id=group.id)
+        assert reg.archive_run(group.id)
+        assert all(
+            reg.get_run(i).archived_at is not None
+            for i in (group.id, t1.id, t2.id)
+        )
+        # Restore brings the whole family back.
+        assert reg.restore_run(group.id)
+        assert all(
+            reg.get_run(i).archived_at is None
+            for i in (group.id, t1.id, t2.id)
+        )
+
+    def test_archive_is_idempotent_and_restorable(self, reg):
+        run = _finished(reg)
+        assert reg.archive_run(run.id)
+        assert not reg.archive_run(run.id)  # second flip reports no-op
+        assert reg.restore_run(run.id)
+        assert not reg.restore_run(run.id)
+        assert reg.get_run(run.id).archived_at is None
+        assert run.id in [r.id for r in reg.list_runs(archived=False)]
+
+    def test_archive_missing_run_raises(self, reg):
+        with pytest.raises(RegistryError):
+            reg.archive_run(999)
+
+    def test_retention_worklist(self, reg):
+        old = _finished(reg)
+        fresh = _finished(reg)
+        reg.archive_run(old.id)
+        reg.archive_run(fresh.id)
+        # Backdate one archive stamp past the horizon.
+        with reg._lock, reg._conn() as conn:
+            conn.execute(
+                "UPDATE runs SET archived_at = archived_at - 1000 WHERE id = ?",
+                (old.id,),
+            )
+        due = reg.archived_runs_older_than(500)
+        assert [r.id for r in due] == [old.id]
+
+
+class TestDelete:
+    def test_delete_purges_all_rows(self, reg):
+        run = _finished(reg)
+        reg.add_metric(run.id, {"loss": 1.0}, step=1)
+        reg.add_log(run.id, "hello")
+        reg.ping_heartbeat(run.id)
+        reg.upsert_process(run.id, 0, pid=1, status=S.SUCCEEDED)
+        reg.add_bookmark(run.id)
+        victims = reg.delete_run(run.id)
+        assert [v.id for v in victims] == [run.id]
+        with pytest.raises(RegistryError):
+            reg.get_run(run.id)
+        conn = reg._conn()
+        for table, col in (
+            ("statuses", "run_id"),
+            ("metrics", "run_id"),
+            ("logs", "run_id"),
+            ("heartbeats", "run_id"),
+            ("processes", "run_id"),
+            ("bookmarks", "run_id"),
+        ):
+            n = conn.execute(
+                f"SELECT COUNT(*) FROM {table} WHERE {col} = ?", (run.id,)
+            ).fetchone()[0]
+            assert n == 0, table
+
+    def test_delete_cascades_to_group_trials(self, reg):
+        group = reg.create_run(
+            {**SPEC, "kind": "group", "hptuning": {"matrix": {"lr": {"values": [1]}},
+                                                  "grid_search": {"n_experiments": 1}}},
+        )
+        t1 = reg.create_run(dict(SPEC), group_id=group.id)
+        t2 = reg.create_run(dict(SPEC), group_id=group.id)
+        reg.create_iteration(group.id, {"iteration": 0})
+        victims = reg.delete_run(group.id)
+        assert {v.id for v in victims} == {group.id, t1.id, t2.id}
+        assert (
+            reg._conn()
+            .execute(
+                "SELECT COUNT(*) FROM iterations WHERE group_id = ?", (group.id,)
+            )
+            .fetchone()[0]
+            == 0
+        )
+
+    def test_delete_releases_devices(self, reg):
+        reg.register_device("slice-0", "cpu-1", 1)
+        run = reg.create_run(dict(SPEC))
+        reg.set_status(run.id, S.QUEUED)
+        assert reg.acquire_device(run.id, "cpu-1", 1)
+        victims = reg.delete_run(run.id)
+        assert len(victims) == 1
+        dev = reg.get_device("slice-0")
+        assert dev["run_id"] is None
+
+
+class TestProjectDeletion:
+    def test_refuses_with_live_runs_then_cascades_archived(self, reg):
+        reg.create_project("vision")
+        run = _finished(reg, project="vision")
+        with pytest.raises(RegistryError):
+            reg.delete_project("vision")
+        reg.archive_run(run.id)
+        removed, victims = reg.delete_project("vision")
+        assert removed
+        assert [v.id for v in victims] == [run.id]
+        with pytest.raises(RegistryError):
+            reg.get_run(run.id)
+        assert reg.get_project("vision") is None

@@ -1,0 +1,138 @@
+"""End-to-end archival lifecycle: run → archive → hidden → purge → project
+deletable.
+
+Parity: the reference's archive-then-delete operator flow — archives API
+(``api/archives/``) + the DELETE_ARCHIVED_* beat crons
+(``crons/tasks/deletion.py``, scheduled at ``celery_settings.py:740-860``).
+"""
+
+import pytest
+
+from polyaxon_tpu.db.registry import RegistryError
+from polyaxon_tpu.events import EventTypes
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.orchestrator import Orchestrator
+from polyaxon_tpu.workers import CronTasks
+
+
+@pytest.fixture()
+def orch(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "POLYAXON_TPU_STORES_ARTIFACTS_URL", f"file://{tmp_path}/artifacts"
+    )
+    o = Orchestrator(
+        tmp_path / "plat",
+        monitor_interval=0.1,
+        heartbeat_interval=0.2,
+    )
+    yield o
+    o.stop()
+
+
+def spec(project_devices=1):
+    return {
+        "kind": "experiment",
+        "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+        "environment": {
+            "topology": {
+                "accelerator": "cpu",
+                "num_devices": project_devices,
+                "num_hosts": 1,
+            }
+        },
+    }
+
+
+@pytest.mark.e2e
+class TestArchivalFlow:
+    def test_archive_purge_then_project_delete(self, orch):
+        orch.registry.create_project("exp-archive")
+        run = orch.submit(spec(), project="exp-archive", name="to-archive")
+        done = orch.wait(run.id, timeout=60)
+        assert done.status == S.SUCCEEDED
+
+        run_root = orch.layout.run_paths(done.uuid).root
+        assert run_root.exists()
+
+        # Archive: vanishes from the default listing, shows in archives.
+        assert orch.archive_run(run.id)
+        assert run.id not in [r.id for r in orch.registry.list_runs(archived=False)]
+        assert run.id in [
+            r.id for r in orch.registry.list_runs(archived=True)
+        ]
+        events = [
+            a["event_type"] for a in orch.registry.get_activities()
+        ]
+        assert EventTypes.EXPERIMENT_ARCHIVED in events
+
+        # Project delete refuses while a LIVE run exists elsewhere in it.
+        live = orch.submit(spec(), project="exp-archive", name="live")
+        orch.wait(live.id, timeout=60)
+        with pytest.raises(RegistryError):
+            orch.delete_project("exp-archive")
+        orch.delete_run(live.id)
+
+        # Retention cron: backdate the archive stamp, fire the cron, gone —
+        # rows AND the run dir.
+        with orch.registry._lock, orch.registry._conn() as conn:
+            conn.execute(
+                "UPDATE runs SET archived_at = archived_at - 10000 WHERE id = ?",
+                (run.id,),
+            )
+        orch.bus.send(CronTasks.CLEAN_ARCHIVES, {"ttl_seconds": 5000})
+        orch.pump(max_wait=1.0)
+        with pytest.raises(RegistryError):
+            orch.registry.get_run(run.id)
+        assert not run_root.exists()
+
+        # Now the project deletes cleanly.
+        assert orch.delete_project("exp-archive")
+        assert orch.registry.get_project("exp-archive") is None
+
+    def test_archive_stops_a_live_run(self, orch):
+        run = orch.submit(
+            {
+                "kind": "experiment",
+                "run": {
+                    "entrypoint": "polyaxon_tpu.builtins.trainers:sleepy"
+                },
+                "declarations": {"seconds": 30.0},
+                "environment": {
+                    "topology": {
+                        "accelerator": "cpu",
+                        "num_devices": 1,
+                        "num_hosts": 1,
+                    }
+                },
+            },
+            name="long",
+        )
+        # Drive until the gang is actually up, then archive mid-flight.
+        deadline = 60
+        import time
+
+        t0 = time.time()
+        while time.time() - t0 < deadline:
+            orch.pump(max_wait=0.2)
+            if orch.registry.get_run(run.id).status == S.RUNNING:
+                break
+        orch.archive_run(run.id)
+        done = orch.wait(run.id, timeout=30)
+        assert done.status in (S.STOPPED, S.FAILED)
+        assert done.archived_at is not None
+        assert run.id not in [r.id for r in orch.registry.list_runs(archived=False)]
+
+    def test_delete_run_purges_outputs_and_store(self, orch):
+        run = orch.submit(spec(), name="to-delete")
+        done = orch.wait(run.id, timeout=60)
+        assert done.status == S.SUCCEEDED
+        run_root = orch.layout.run_paths(done.uuid).root
+        assert run_root.exists()
+        n = orch.delete_run(run.id)
+        assert n == 1
+        with pytest.raises(RegistryError):
+            orch.registry.get_run(run.id)
+        assert not run_root.exists()
+        from polyaxon_tpu.stores import run_prefix
+
+        assert orch.artifact_store.list(run_prefix(done.uuid)) == []
