@@ -656,20 +656,74 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             )
         return web.json_response({"ok": True})
 
+    # -- query vocabulary (dashboard autocomplete) ----------------------------
+    @routes.get(f"{API_PREFIX}/query/fields")
+    async def query_fields(request):
+        """The completable query-DSL vocabulary: plain columns, the
+        metric./declarations. keys present in current runs, and known
+        status values.  Parity: the reference client's autocomplete
+        component fed from its query spec (``client/src/components/
+        autocomplete/``); here the backend that owns the grammar
+        (``query/builder.py``) serves it."""
+        from polyaxon_tpu.lifecycles import StatusOptions
+        from polyaxon_tpu.query.builder import _FIELDS
+
+        metric_keys, param_keys = set(), set()
+        decided: Dict[str, bool] = {}
+        for r in reg.list_runs(limit=500, archived=False):
+            # Same per-project ACL as every listing surface: keys harvested
+            # from restricted projects must not leak into completions.
+            if r.project not in decided:
+                decided[r.project] = not _project_denied(request, r.project)
+            if not decided[r.project]:
+                continue
+            metric_keys.update(
+                k for k in r.last_metric if not k.startswith("sys/")
+            )
+            param_keys.update(r.spec_data.get("declarations", {}) or {})
+        statuses = sorted(
+            v
+            for k, v in vars(StatusOptions).items()
+            if k.isupper() and isinstance(v, str)
+        )
+        return web.json_response(
+            {
+                "fields": sorted(_FIELDS) + ["tags"],
+                "metric_keys": sorted(metric_keys),
+                "param_keys": sorted(param_keys),
+                "statuses": statuses,
+                "ops": [":", ":~", ":>", ":>=", ":<", ":<=", "|", ".."],
+            }
+        )
+
     # -- live streaming (WS) --------------------------------------------------
     async def _ws_tail(request, fetch, poll: float = 0.5):
         """Generic WS tail loop: push new rows until the run is done."""
         run = _run_or_404(request)
-        ws = web.WebSocketResponse(heartbeat=30)
+        # Echo whatever subprotocol the client offered (browsers abort the
+        # handshake if the server doesn't select one they requested — the
+        # bearer.<token> auth subprotocol rides this).
+        offered = tuple(
+            p.strip()
+            for p in request.headers.get("Sec-WebSocket-Protocol", "").split(",")
+            if p.strip()
+        )
+        ws = web.WebSocketResponse(heartbeat=30, protocols=offered)
         await ws.prepare(request)
         cursor = 0
         try:
             while not ws.closed:
-                rows = fetch(run.id, cursor)
+                # The run can be DELETEd out from under a live tail; close
+                # the stream cleanly instead of crashing the handler.
+                try:
+                    rows = fetch(run.id, cursor)
+                    current = reg.get_run(run.id)
+                except PolyaxonTPUError:
+                    await ws.send_json({"event": "deleted"})
+                    break
                 for row in rows:
                     cursor = max(cursor, row.get("id", cursor))
                     await ws.send_json(row)
-                current = reg.get_run(run.id)
                 if current.is_done and not rows:
                     await ws.send_json({"event": "done", "status": current.status})
                     break
@@ -696,18 +750,9 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         )
 
     # -- users (per-user tokens; reference scopes/ + user models) --------------
-    def _resolve_actor(request):
-        """(actor, role) for the supplied bearer token; None = bad token.
-
-        The shared bootstrap token maps to the 'root' admin; user tokens
-        are looked up hashed in the registry.
-        """
+    def _actor_for_token(token: str):
         import hmac
 
-        supplied = request.headers.get("Authorization", "")
-        if not supplied.startswith("Bearer "):
-            return None
-        token = supplied[len("Bearer "):]
         if auth_token and hmac.compare_digest(
             token.encode("utf-8", "surrogateescape"), auth_token.encode()
         ):
@@ -715,6 +760,26 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         user = reg.get_user_by_token(token)
         if user is not None:
             return (user["username"], user["role"])
+        return None
+
+    def _resolve_actor(request):
+        """(actor, role) for the supplied bearer token; None = bad token.
+
+        The shared bootstrap token maps to the 'root' admin; user tokens
+        are looked up hashed in the registry.  WS upgrades may carry the
+        token as a ``bearer.<token>`` subprotocol instead — the browser
+        WebSocket API cannot set an Authorization header, and a ``?token=``
+        query param would land the secret in access logs/history (the same
+        reason the dashboard login is a form).
+        """
+        supplied = request.headers.get("Authorization", "")
+        if supplied.startswith("Bearer "):
+            return _actor_for_token(supplied[len("Bearer "):])
+        if request.path.startswith("/ws/"):
+            for proto in request.headers.get("Sec-WebSocket-Protocol", "").split(","):
+                proto = proto.strip()
+                if proto.startswith("bearer."):
+                    return _actor_for_token(proto[len("bearer."):])
         return None
 
     def _require_admin(request):

@@ -58,6 +58,14 @@ DASHBOARD_HTML = """<!doctype html>
   a.svc { color:var(--accent); }
   .dim { color:var(--dim); }
   #login { display:none; margin-bottom:12px; }
+  #qwrap { position:relative; display:inline-block; }
+  #qsuggest { position:absolute; top:34px; left:0; z-index:10; display:none;
+              background:var(--panel); border:1px solid #2a323c; border-radius:6px;
+              min-width:340px; max-height:260px; overflow:auto; }
+  #qsuggest div { padding:5px 12px; cursor:pointer; }
+  #qsuggest div.sel, #qsuggest div:hover { background:#2d3c50; }
+  #qsuggest span { color:var(--dim); float:right; margin-left:16px; }
+  #logs-state { float:right; font-size:12px; }
 </style>
 </head>
 <body>
@@ -79,7 +87,11 @@ DASHBOARD_HTML = """<!doctype html>
 </nav>
 
 <div id="view-runs">
-  <input id="query" placeholder='filter: status:running, metric.loss:<0.5' />
+  <span id="qwrap">
+    <input id="query" placeholder='filter: status:running, metric.loss:<0.5'
+           autocomplete="off" />
+    <div id="qsuggest"></div>
+  </span>
   <table>
     <thead><tr><th>ID</th><th>Kind</th><th>Name</th><th>Project</th>
     <th>Status</th><th>Last metric</th><th>Restarts</th><th>Service</th><th></th></tr></thead>
@@ -105,8 +117,14 @@ DASHBOARD_HTML = """<!doctype html>
         <th>Last metric</th></tr></thead>
         <tbody id="trials"></tbody></table>
     </div>
-    <div class="panel"><canvas id="chart" width="900" height="160"></canvas></div>
-    <div class="panel"><pre id="logs"></pre></div>
+    <div class="panel">
+      <div id="chart-legend" class="dim"></div>
+      <canvas id="chart" width="900" height="160"></canvas>
+    </div>
+    <div class="panel">
+      <h2>Logs <span id="logs-state" class="dim"></span></h2>
+      <pre id="logs"></pre>
+    </div>
   </div>
 </div>
 
@@ -269,7 +287,36 @@ async function select(id, kind) {
   document.getElementById('detail-title').textContent = `#${id} ${names[id]||''}`;
   document.getElementById('sweep-panel').style.display =
     kind === 'group' ? 'block' : 'none';
+  openLogStream(id);
   await refreshDetail();
+}
+
+// Live log tail over the existing WS channel (no polling). The bearer
+// token rides a subprotocol — the browser WebSocket API can't set an
+// Authorization header, and the token must never enter a URL.
+let logSocket = null;
+function openLogStream(id) {
+  if (logSocket) { logSocket.onclose = null; logSocket.close(); }
+  const pre = document.getElementById('logs');
+  const state = document.getElementById('logs-state');
+  pre.textContent = '';
+  state.textContent = 'connecting…';
+  const proto = location.protocol === 'https:' ? 'wss://' : 'ws://';
+  const url = `${proto}${location.host}/ws/v1/runs/${id}/logs`;
+  const ws = TOKEN ? new WebSocket(url, ['bearer.' + TOKEN]) : new WebSocket(url);
+  logSocket = ws;
+  ws.onopen = () => { state.textContent = 'live'; };
+  ws.onmessage = ev => {
+    const row = JSON.parse(ev.data);
+    if (row.event === 'done') { state.textContent = `done (${row.status})`; return; }
+    const stick = pre.scrollTop + pre.clientHeight >= pre.scrollHeight - 4;
+    const prefix = row.process_id != null ? `p${row.process_id}| ` : '';
+    pre.textContent += prefix + row.line + '\\n';
+    if (stick) pre.scrollTop = pre.scrollHeight;
+  };
+  ws.onclose = () => {
+    if (state.textContent === 'live') state.textContent = 'disconnected';
+  };
 }
 
 async function runAction(action) {
@@ -287,15 +334,14 @@ async function toggleBookmark() {
 }
 
 async function refreshDetail() {
+  // Logs stream over the WS channel (openLogStream); only metrics/
+  // statuses/trials poll here.
   const wants = [
     apiFetch(`/api/v1/runs/${selected}/metrics`).then(r=>r.json()),
-    apiFetch(`/api/v1/runs/${selected}/logs?limit=200`).then(r=>r.json()),
     apiFetch(`/api/v1/runs/${selected}/statuses`).then(r=>r.json())];
   if (selectedKind === 'group')
     wants.push(apiFetch(`/api/v1/runs?group_id=${selected}&limit=500`).then(r=>r.json()));
-  const [metrics, logs, statuses, trials] = await Promise.all(wants);
-  document.getElementById('logs').textContent =
-    logs.results.map(l=>l.line).join('\\n') || '(no logs)';
+  const [metrics, statuses, trials] = await Promise.all(wants);
   document.getElementById('statuses').textContent =
     statuses.results.map(s=>s.status).join(' → ');
   drawChart(metrics.results);
@@ -423,26 +469,121 @@ function drawCompare() {
 function drawChart(rows) {
   const c = document.getElementById('chart'), ctx = c.getContext('2d');
   ctx.clearRect(0,0,c.width,c.height);
+  // [step, value] series keyed by metric name (step falls back to index).
   const series = {};
-  rows.forEach(r => Object.entries(r.values).forEach(([k,v]) => {
+  rows.forEach((r, i) => Object.entries(r.values).forEach(([k,v]) => {
     if (typeof v==='number' && !k.startsWith('sys/'))
-      (series[k] = series[k]||[]).push(v);
+      (series[k] = series[k]||[]).push([r.step ?? i, v]);
   }));
-  Object.entries(series).slice(0,5).forEach(([name, vals], si) => {
-    if (vals.length < 2) return;
-    const min = Math.min(...vals), max = Math.max(...vals), span = (max-min)||1;
+  const entries = Object.entries(series).slice(0,6)
+    .filter(([,pts]) => pts.length > 1);
+  const legend = document.getElementById('chart-legend');
+  if (!entries.length) { legend.innerHTML = ''; return; }
+  const L = 44, R = 10, Tp = 8, Bm = 22;
+  // Shared x (steps); per-series y normalization — ranges live in the
+  // legend so mixed scales (loss vs lr) stay readable on one canvas.
+  const allx = entries.flatMap(([,pts]) => pts.map(p=>p[0]));
+  const xmin = Math.min(...allx), xspan = (Math.max(...allx)-xmin)||1;
+  ctx.strokeStyle = '#2a323c';
+  ctx.beginPath();
+  ctx.moveTo(L, Tp); ctx.lineTo(L, c.height-Bm);
+  ctx.lineTo(c.width-R, c.height-Bm); ctx.stroke();
+  ctx.fillStyle = '#8a949e';
+  ctx.fillText(String(xmin), L, c.height-8);
+  ctx.fillText(String(xmin+xspan), c.width-R-30, c.height-8);
+  ctx.fillText('step →', (c.width-L)/2, c.height-8);
+  entries.forEach(([name, pts], si) => {
+    const ys = pts.map(p=>p[1]);
+    const min = Math.min(...ys), max = Math.max(...ys), span = (max-min)||1;
     ctx.strokeStyle = COLORS[si%COLORS.length]; ctx.beginPath();
-    vals.forEach((v,i) => {
-      const x = 40 + i*(c.width-60)/(vals.length-1);
-      const y = c.height-20 - (v-min)/span*(c.height-40);
+    pts.forEach(([s,v], i) => {
+      const x = L + (s-xmin)/xspan*(c.width-L-R);
+      const y = c.height-Bm - (v-min)/span*(c.height-Tp-Bm);
       i ? ctx.lineTo(x,y) : ctx.moveTo(x,y);
     });
     ctx.stroke();
-    ctx.fillStyle = COLORS[si%COLORS.length];
-    ctx.fillText(name, 44, 14+12*si);
   });
+  legend.innerHTML = entries.map(([name, pts], si) => {
+    const ys = pts.map(p=>p[1]);
+    const last = ys[ys.length-1], min = Math.min(...ys), max = Math.max(...ys);
+    return `<span style="color:${COLORS[si%COLORS.length]}">■</span> ` +
+      `${esc(name)} <b>${esc(last.toPrecision(4))}</b> ` +
+      `<span class="dim">[${esc(min.toPrecision(3))} … ${esc(max.toPrecision(3))}]</span>`;
+  }).join(' &nbsp; ');
+}
+
+// -- query autocomplete off the backend's own grammar ------------------------
+let vocab = null;
+let suggestSel = -1;
+async function loadVocab() {
+  try {
+    const resp = await apiFetch('/api/v1/query/fields');
+    if (resp.ok) vocab = await resp.json();
+  } catch (e) { /* autocomplete stays off without the vocabulary */ }
+}
+function querySuggestions(text) {
+  if (!vocab) return [];
+  // Complete the segment after the last comma: a bare prefix completes
+  // field names; 'status:<prefix>' completes status values.
+  const seg = text.slice(text.lastIndexOf(',')+1).trimStart();
+  const colon = seg.indexOf(':');
+  if (colon >= 0) {
+    const field = seg.slice(0, colon), val = seg.slice(colon+1).replace(/^[~]/,'');
+    if (field !== 'status') return [];
+    return vocab.statuses.filter(s => s.startsWith(val))
+      .map(s => ({text: s, hint: 'status', insert: `status:${s}`}));
+  }
+  const opts = [
+    ...vocab.fields.map(f => ({text: f, hint: 'field'})),
+    ...vocab.metric_keys.map(k => ({text: `metric.${k}`, hint: 'metric'})),
+    ...vocab.param_keys.map(k => ({text: `declarations.${k}`, hint: 'param'})),
+  ];
+  return opts.filter(o => o.text.startsWith(seg) && o.text !== seg)
+    .map(o => ({...o, insert: o.text + ':'}));
+}
+function renderSuggest() {
+  const input = document.getElementById('query');
+  const box = document.getElementById('qsuggest');
+  const items = querySuggestions(input.value).slice(0, 12);
+  if (!items.length) { box.style.display = 'none'; suggestSel = -1; return; }
+  if (suggestSel >= items.length) suggestSel = items.length-1;
+  box.innerHTML = items.map((o, i) =>
+    `<div class="${i===suggestSel?'sel':''}" onmousedown="pickSuggest(${i})">` +
+    `${esc(o.text)}<span>${esc(o.hint)}</span></div>`).join('');
+  box.style.display = 'block';
+  box.dataset.items = JSON.stringify(items);
+}
+function pickSuggest(i) {
+  const box = document.getElementById('qsuggest');
+  const items = JSON.parse(box.dataset.items || '[]');
+  if (!items[i]) return;
+  const input = document.getElementById('query');
+  const cut = input.value.lastIndexOf(',')+1;
+  const lead = input.value.slice(0, cut) + (cut ? ' ' : '');
+  input.value = lead + items[i].insert;
+  box.style.display = 'none'; suggestSel = -1;
+  input.focus();
+  if (items[i].hint === 'status') refreshRuns();
+}
+{
+  const input = document.getElementById('query');
+  input.addEventListener('input', () => { suggestSel = -1; renderSuggest(); });
+  input.addEventListener('keydown', ev => {
+    const box = document.getElementById('qsuggest');
+    if (box.style.display !== 'block') return;
+    const n = JSON.parse(box.dataset.items || '[]').length;
+    if (ev.key === 'ArrowDown') { suggestSel = (suggestSel+1)%n; renderSuggest(); ev.preventDefault(); }
+    else if (ev.key === 'ArrowUp') { suggestSel = (suggestSel-1+n)%n; renderSuggest(); ev.preventDefault(); }
+    else if (ev.key === 'Tab' || (ev.key === 'Enter' && suggestSel >= 0)) {
+      pickSuggest(suggestSel < 0 ? 0 : suggestSel); ev.preventDefault();
+    }
+    else if (ev.key === 'Escape') { box.style.display = 'none'; }
+  });
+  input.addEventListener('blur', () =>
+    setTimeout(() => document.getElementById('qsuggest').style.display='none', 150));
 }
 document.getElementById('query').addEventListener('change', refreshRuns);
+loadVocab(); setInterval(loadVocab, 30000);
 refresh(); setInterval(refresh, 2000);
 </script>
 </body>

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 from polyaxon_tpu.tracking import Context
@@ -152,6 +153,165 @@ def jupyter(ctx: Context) -> None:
     rc = subprocess.call(argv, env=dict(os.environ))
     if rc != 0:
         raise RuntimeError(f"jupyter exited {rc}")
+
+
+def lm_server(ctx: Context) -> None:
+    """LM inference endpoint: the default ``kind: service`` entrypoint.
+
+    Serves autoregressive generation from a trained checkpoint over REST —
+    the platform's serving story (the reference has none; its closest
+    surfaces are the notebook/tensorboard plugin deployments).  Routes:
+
+    - ``POST /generate`` ``{"prompts": [[ids…]…], "max_new_tokens": N,
+      "temperature": t}`` → ``{"tokens": [[ids…]…], "decode_tokens_per_s"}``
+      (prompts in one request must share a length — they batch into one
+      compiled decode; the KV cache stores UNEXPANDED GQA heads).
+    - ``GET /healthz`` → model/checkpoint metadata.
+
+    Params: ``target`` (run uuid whose ``checkpoints/`` to serve — omit
+    for fresh random weights, a load-testing double), the model-shape
+    params of ``lm_train`` (must match the checkpoint), ``seq`` (max
+    prompt+generation length), ``host``.  Each distinct (batch,
+    prompt_len, max_new) triple compiles once and is cached after.
+    """
+    import json as json_mod
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from polyaxon_tpu.models import TransformerConfig, decode, init_params
+
+    cfg_fields = {
+        f: int(ctx.get_param(f))
+        for f in (
+            "vocab_size", "d_model", "n_layers", "n_heads",
+            "head_dim", "d_ff", "n_kv_heads", "n_experts",
+        )
+        if ctx.get_param(f) is not None
+    }
+    seq = int(ctx.get_param("seq", 512))
+    cfg = TransformerConfig(max_seq=seq, **cfg_fields)
+    params = init_params(jax.random.PRNGKey(ctx.seed or 0), cfg)
+    step = None
+    target = ctx.get_param("target")
+    if target is not None:
+        from polyaxon_tpu.runtime.checkpoint import CheckpointManager
+
+        ckpt_dir = (ctx.runs_root or ctx.outputs_path.parent.parent) / str(
+            target
+        ) / "checkpoints"
+        ckpt = CheckpointManager(ckpt_dir)
+        restored = ckpt.restore_params(params)
+        ckpt.close()
+        if restored is None:
+            raise RuntimeError(f"No checkpoint under {ckpt_dir}")
+        params, step = restored["params"], restored["step"]
+        ctx.log_text(f"lm_server: restored run {target} step {step}")
+
+    port = _service_port(ctx)
+    host = str(ctx.get_param("host", "0.0.0.0"))
+    # One compiled decode per (B, T, max_new, greedy?) — cached across
+    # requests; a lock serializes device access (one accelerator, one
+    # generation at a time; queued requests wait their turn).
+    compiled = {}
+    device_lock = threading.Lock()
+
+    def get_fn(b, t, max_new, temperature):
+        # temperature is part of the key: it's baked into the compiled
+        # closure, so two requests differing only in temperature must not
+        # share a cache entry.
+        key = (b, t, max_new, float(temperature))
+        if key not in compiled:
+            compiled[key] = jax.jit(
+                lambda p, prompt, k: decode.generate(
+                    p, prompt, cfg, max_new_tokens=max_new,
+                    temperature=temperature, rng=k,
+                )
+            )
+        return compiled[key]
+
+    rng_state = {"key": jax.random.PRNGKey(ctx.seed or 0)}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route into run logs, not stderr
+            ctx.log_text("lm_server: " + fmt % args)
+
+        def _json(self, code, payload):
+            body = json_mod.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path not in ("/healthz", "/"):
+                return self._json(404, {"error": "not found"})
+            self._json(
+                200,
+                {
+                    "ok": True,
+                    "model": {
+                        "n_params": cfg.n_params,
+                        "vocab_size": cfg.vocab_size,
+                        "max_seq": cfg.max_seq,
+                        "n_kv_heads": cfg.kv_heads,
+                    },
+                    "checkpoint_step": step,
+                    "target": target,
+                },
+            )
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._json(404, {"error": "not found"})
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json_mod.loads(self.rfile.read(n) or b"{}")
+                prompts = req["prompts"]
+                max_new = int(req.get("max_new_tokens", 64))
+                temperature = float(req.get("temperature", 0.0))
+                if not prompts or not isinstance(prompts[0], list):
+                    raise ValueError("prompts must be a list of id lists")
+                t = len(prompts[0])
+                if any(len(p) != t for p in prompts):
+                    raise ValueError(
+                        "prompts in one request must share a length "
+                        "(they batch into one compiled decode)"
+                    )
+                if t + max_new > cfg.max_seq:
+                    raise ValueError(
+                        f"prompt ({t}) + max_new_tokens ({max_new}) exceeds "
+                        f"max_seq ({cfg.max_seq})"
+                    )
+                arr = np.asarray(prompts, np.int32)
+                if arr.min() < 0 or arr.max() >= cfg.vocab_size:
+                    raise ValueError("token id out of vocabulary range")
+            except (KeyError, ValueError, TypeError) as e:
+                return self._json(400, {"error": str(e)})
+            fn = get_fn(arr.shape[0], t, max_new, temperature)
+            t0 = time.time()
+            with device_lock:
+                rng_state["key"], sub = jax.random.split(rng_state["key"])
+                out = np.asarray(fn(params, jnp.asarray(arr), sub))
+            dt = time.time() - t0
+            self._json(
+                200,
+                {
+                    "tokens": out.tolist(),
+                    "decode_tokens_per_s": round(out.size / max(dt, 1e-9), 1),
+                },
+            )
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    ctx.log_text(
+        f"lm_server: {cfg.n_params/1e6:.0f}M params on {host}:{port}"
+        + (f" (checkpoint step {step})" if step is not None else " (random init)")
+    )
+    server.serve_forever()
 
 
 def output_server(ctx: Context) -> None:

@@ -421,7 +421,7 @@ def lm_train(ctx: Context) -> None:
         f: int(ctx.get_param(f))
         for f in (
             "vocab_size", "d_model", "n_layers", "n_heads",
-            "head_dim", "d_ff", "n_experts", "n_kv_heads",
+            "head_dim", "d_ff", "n_experts", "n_kv_heads", "ce_chunk",
         )
         if ctx.get_param(f) is not None
     }

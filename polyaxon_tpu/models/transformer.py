@@ -104,6 +104,13 @@ class TransformerConfig:
     #: instead: flash-per-block inside shard_map — sharded long context
     #: runs the O(T_local) kernel per shard; see parallel/flash.py.)
     attention_impl: str = "auto"
+    #: Blockwise cross-entropy sequence-chunk size (0 = off).  When set
+    #: (and T divides evenly), loss_fn never materializes the full
+    #: [B,T,vocab] f32 logits — the step's single largest activation
+    #: (2.1G at the bench shape) — computing logsumexp + target logit one
+    #: [B,chunk] slice at a time under jax.checkpoint, so the backward
+    #: recomputes each chunk's logits instead of keeping them resident.
+    ce_chunk: int = 0
 
     def scaled(self, **overrides) -> "TransformerConfig":
         return replace(self, **overrides)
@@ -316,6 +323,7 @@ def forward(
     mesh=None,
     positions: Optional[jax.Array] = None,
     return_kv: bool = False,
+    return_hidden: bool = False,
 ) -> jax.Array:
     """tokens [B,T] → logits [B,T,vocab] (float32).
 
@@ -554,6 +562,15 @@ def forward(
             aux = scan_aux
 
     x = _rmsnorm(x, norm_w(params["final_norm"]))
+    if return_hidden:
+        # Pre-unembed hidden states for the blockwise cross-entropy
+        # (loss_fn's ce_chunk path): the [B,T,vocab] f32 logits tensor —
+        # the single largest activation of the whole step — is never
+        # materialized; the caller contracts x against ``unembed`` one
+        # sequence chunk at a time.
+        if c.n_experts and aux is not None:
+            return x, aux
+        return x
     logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(x.dtype))
     logits = with_logical_constraint(logits, ("batch", "seq", None), rules, cmesh)
     if c.n_experts and aux is not None:
@@ -561,6 +578,55 @@ def forward(
     if return_kv:
         return logits.astype(jnp.float32), aux
     return logits.astype(jnp.float32)
+
+
+def _blockwise_ce(
+    x: jax.Array,
+    unembed: jax.Array,
+    targets: jax.Array,
+    mask: Optional[jax.Array],
+    chunk: int,
+) -> jax.Array:
+    """Mean masked next-token NLL without materializing [B,T,V] logits.
+
+    Scans over T/chunk sequence slices; each body projects one [B,C,D]
+    slice to logits, reduces to logsumexp + the target logit, and drops
+    the logits again.  ``jax.checkpoint`` makes the backward RECOMPUTE
+    each chunk's logits rather than saving them — peak CE memory falls
+    from O(B·T·V) to O(B·chunk·V) in both passes, trading one extra
+    [B,C,D]×[D,V] matmul per chunk (MXU-shaped, cheap next to the HBM
+    traffic it saves).  The d(unembed) grads accumulate across chunks
+    inside the scan like any scanned-weight gradient.
+    """
+    B, T, D = x.shape
+    n = T // chunk
+    xs = jnp.moveaxis(x.reshape(B, n, chunk, D), 1, 0)  # [n,B,C,D]
+    ts = jnp.moveaxis(targets.reshape(B, n, chunk), 1, 0)
+    m = (
+        jnp.ones((B, T), jnp.float32)
+        if mask is None
+        else mask.astype(jnp.float32)
+    )
+    ms = jnp.moveaxis(m.reshape(B, n, chunk), 1, 0)
+
+    @jax.checkpoint
+    def body(carry, inp):
+        xc, tc, mc = inp
+        logits = jnp.einsum(
+            "bcd,dv->bcv", xc, unembed.astype(xc.dtype)
+        ).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tl = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        nll_sum, cnt = carry
+        return (
+            nll_sum + jnp.sum((lse - tl) * mc),
+            cnt + jnp.sum(mc),
+        ), None
+
+    (nll_sum, cnt), _ = lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)), (xs, ts, ms)
+    )
+    return nll_sum / jnp.maximum(cnt, 1.0)
 
 
 def loss_fn(
@@ -572,6 +638,11 @@ def loss_fn(
     aux_weight: float = 0.01,
 ) -> jax.Array:
     """Next-token cross-entropy (+ MoE balance loss when configured)."""
+    targets = batch["targets"]
+    mask = batch.get("mask")
+    chunked = bool(
+        cfg.ce_chunk and targets.shape[-1] % cfg.ce_chunk == 0
+    )
     out = forward(
         params,
         batch["tokens"],
@@ -579,19 +650,24 @@ def loss_fn(
         template=template,
         mesh=mesh,
         positions=batch.get("positions"),
+        return_hidden=chunked,
     )
     if cfg.n_experts:
-        logits, aux = out
+        hidden_or_logits, aux = out
     else:
-        logits = out
-    targets = batch["targets"]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    mask = batch.get("mask")
-    if mask is None:
-        loss = jnp.mean(nll)
+        hidden_or_logits = out
+    if chunked:
+        loss = _blockwise_ce(
+            hidden_or_logits, params["unembed"], targets, mask, cfg.ce_chunk
+        )
     else:
-        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        logits = hidden_or_logits
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        if mask is None:
+            loss = jnp.mean(nll)
+        else:
+            loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     if cfg.n_experts:
         if isinstance(aux, dict):
             # Pipeline path: already reduced inside the GPipe schedule.

@@ -31,8 +31,14 @@ class Kinds:
     BUILD = "build"
     NOTEBOOK = "notebook"
     TENSORBOARD = "tensorboard"
+    #: Generic long-running service; the built-in entrypoint is the LM
+    #: inference server (checkpoint → REST /generate).
+    SERVICE = "service"
     PIPELINE = "pipeline"
-    VALUES = (EXPERIMENT, GROUP, JOB, BUILD, NOTEBOOK, TENSORBOARD, PIPELINE)
+    VALUES = (
+        EXPERIMENT, GROUP, JOB, BUILD, NOTEBOOK, TENSORBOARD, SERVICE,
+        PIPELINE,
+    )
 
 
 _TEMPLATE_RE = re.compile(r"\{\{\s*([\w.]+)\s*\}\}")
@@ -156,8 +162,10 @@ class ServiceSpecification(BaseSpecification):
     @field_validator("kind")
     @classmethod
     def _check_kind(cls, v: str) -> str:
-        if v not in (Kinds.NOTEBOOK, Kinds.TENSORBOARD):
-            raise ValueError(f"Expected kind=notebook|tensorboard, got {v!r}")
+        if v not in (Kinds.NOTEBOOK, Kinds.TENSORBOARD, Kinds.SERVICE):
+            raise ValueError(
+                f"Expected kind=notebook|tensorboard|service, got {v!r}"
+            )
         return v
 
     def resolved_run(self) -> RunConfig:
@@ -166,13 +174,16 @@ class ServiceSpecification(BaseSpecification):
 
         A service spec with no run section gets its built-in entrypoint:
         tensorboard over a target run's outputs
-        (reference ``polypod/tensorboard.py:32``) or JupyterLab for
-        notebooks (reference ``polypod/notebook.py:35``).
+        (reference ``polypod/tensorboard.py:32``), JupyterLab for
+        notebooks (reference ``polypod/notebook.py:35``), or the LM
+        inference server for ``kind: service`` (serving is capability
+        beyond the reference).
         """
         if self.run is None:
             builtins_by_kind = {
                 Kinds.TENSORBOARD: "polyaxon_tpu.builtins.services:tensorboard",
                 Kinds.NOTEBOOK: "polyaxon_tpu.builtins.services:jupyter",
+                Kinds.SERVICE: "polyaxon_tpu.builtins.services:lm_server",
             }
             entrypoint = builtins_by_kind.get(self.kind)
             if entrypoint is None:
@@ -271,6 +282,7 @@ _KIND_TO_SPEC = {
     Kinds.BUILD: JobSpecification,
     Kinds.NOTEBOOK: ServiceSpecification,
     Kinds.TENSORBOARD: ServiceSpecification,
+    Kinds.SERVICE: ServiceSpecification,
     Kinds.PIPELINE: PipelineSpecification,
 }
 

@@ -30,6 +30,16 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """Every ``e2e`` test (real subprocess gangs) is implicitly ``slow`` —
+    the dev loop (`make test-fast`, -m 'not slow') skips them; the round
+    gate (`make gate`) runs everything."""
+    slow = pytest.mark.slow
+    for item in items:
+        if "e2e" in item.keywords:
+            item.add_marker(slow)
+
+
 @pytest.fixture()
 def tmp_registry(tmp_path):
     """A fresh sqlite run registry in a temp dir."""

@@ -81,6 +81,7 @@ STRATEGY_MESHES = [
 ]
 
 
+@pytest.mark.slow
 class TestStrategyNumerics:
     @pytest.mark.parametrize("strategy,mesh_axes", STRATEGY_MESHES)
     def test_first_step_loss_matches_single_device(
@@ -150,6 +151,7 @@ class TestStrategyNumerics:
         assert "pipeline" in spec and "tensor" in spec, spec
 
 
+@pytest.mark.slow
 class TestGQA:
     """Grouped-query attention: fewer KV heads, same numerics as the
     equivalent MHA with tied KV weights, working under every path."""
@@ -246,6 +248,7 @@ class TestGQA:
         assert gqa.n_params < CFG.n_params
 
 
+@pytest.mark.slow
 class TestUlyssesFlash:
     """Ulysses with explicit all-to-alls + the flash kernel per head
     shard — the long-context form GSPMD's dense path can't express."""
@@ -310,6 +313,7 @@ class TestUlyssesFlash:
         assert loss == pytest.approx(ref_loss, abs=2e-4)
 
 
+@pytest.mark.slow
 class TestViTStrategies:
     """The ViT family shares the LM's logical axes, so the same templates
     must shard it with identical numerics."""
@@ -374,6 +378,7 @@ class TestViTStrategies:
         assert "tensor" in spec, spec
 
 
+@pytest.mark.slow
 class TestRingAttention:
     def test_matches_dense_attention(self):
         from polyaxon_tpu.models.transformer import _dense_attention
@@ -409,6 +414,7 @@ class TestRingAttention:
             ring_out.block_until_ready()
 
 
+@pytest.mark.slow
 class TestRingFlash:
     """The sharded long-context path: pallas flash per ring block.
 

@@ -38,6 +38,7 @@ def make_state(strategy, mesh_axes):
     return ts
 
 
+@pytest.mark.slow
 class TestCheckpointManager:
     def test_roundtrip_restores_exact_state(self, tmp_path):
         ts = make_state("ddp", {"data": 8})

@@ -15,6 +15,7 @@ from polyaxon_tpu.exceptions import RuntimeLayerError
 from polyaxon_tpu.runtime.mesh import build_mesh
 
 
+@pytest.mark.slow
 class TestHybridMesh:
     def test_dcn_axes_lead_and_sizes_hold(self):
         mesh = build_mesh({"replica": 2, "data": 4}, dcn_axes={"replica": 2})
