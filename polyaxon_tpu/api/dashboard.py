@@ -287,7 +287,14 @@ async function select(id, kind) {
   document.getElementById('detail-title').textContent = `#${id} ${names[id]||''}`;
   document.getElementById('sweep-panel').style.display =
     kind === 'group' ? 'block' : 'none';
-  openLogStream(id);
+  if (kind === 'group') {
+    // Groups produce no log rows of their own — don't hold a WS tail.
+    if (logSocket) { logSocket.onclose = null; logSocket.close(); logSocket = null; }
+    document.getElementById('logs').textContent = '';
+    document.getElementById('logs-state').textContent = 'sweep (see trials)';
+  } else {
+    openLogStream(id);
+  }
   await refreshDetail();
 }
 
@@ -309,13 +316,17 @@ function openLogStream(id) {
   ws.onmessage = ev => {
     const row = JSON.parse(ev.data);
     if (row.event === 'done') { state.textContent = `done (${row.status})`; return; }
+    if (row.event === 'deleted') { state.textContent = 'run deleted'; return; }
+    if (row.event) return;  // future server frames must not render as text
     const stick = pre.scrollTop + pre.clientHeight >= pre.scrollHeight - 4;
     const prefix = row.process_id != null ? `p${row.process_id}| ` : '';
     pre.textContent += prefix + row.line + '\\n';
     if (stick) pre.scrollTop = pre.scrollHeight;
   };
   ws.onclose = () => {
+    // 'connecting…' here means the handshake failed (401/404/refused).
     if (state.textContent === 'live') state.textContent = 'disconnected';
+    else if (state.textContent === 'connecting…') state.textContent = 'unavailable';
   };
 }
 

@@ -213,24 +213,38 @@ def lm_server(ctx: Context) -> None:
 
     port = _service_port(ctx)
     host = str(ctx.get_param("host", "0.0.0.0"))
-    # One compiled decode per (B, T, max_new, greedy?) — cached across
-    # requests; a lock serializes device access (one accelerator, one
-    # generation at a time; queued requests wait their turn).
-    compiled = {}
+    # One compiled decode per (B, T, max_new, greedy?) — temperature rides
+    # as a TRACED argument (client floats must not mint compilations), and
+    # the cache is LRU-bounded so arbitrary request shapes can't grow
+    # compile memory without limit.  A lock serializes device access (one
+    # accelerator, one generation at a time) and cache mutation.
+    from collections import OrderedDict
+
+    compiled: "OrderedDict" = OrderedDict()
+    MAX_COMPILED = 32
     device_lock = threading.Lock()
 
-    def get_fn(b, t, max_new, temperature):
-        # temperature is part of the key: it's baked into the compiled
-        # closure, so two requests differing only in temperature must not
-        # share a cache entry.
-        key = (b, t, max_new, float(temperature))
+    def get_fn(b, t, max_new, greedy):
+        key = (b, t, max_new, greedy)
         if key not in compiled:
-            compiled[key] = jax.jit(
-                lambda p, prompt, k: decode.generate(
-                    p, prompt, cfg, max_new_tokens=max_new,
-                    temperature=temperature, rng=k,
+            if greedy:
+                fn = jax.jit(
+                    lambda p, prompt, k, temp: decode.generate(
+                        p, prompt, cfg, max_new_tokens=max_new,
+                        temperature=0.0, rng=k,
+                    )
                 )
-            )
+            else:
+                fn = jax.jit(
+                    lambda p, prompt, k, temp: decode.generate(
+                        p, prompt, cfg, max_new_tokens=max_new,
+                        temperature=temp, rng=k,
+                    )
+                )
+            compiled[key] = fn
+            while len(compiled) > MAX_COMPILED:
+                compiled.popitem(last=False)
+        compiled.move_to_end(key)
         return compiled[key]
 
     rng_state = {"key": jax.random.PRNGKey(ctx.seed or 0)}
@@ -276,7 +290,11 @@ def lm_server(ctx: Context) -> None:
                 temperature = float(req.get("temperature", 0.0))
                 if not prompts or not isinstance(prompts[0], list):
                     raise ValueError("prompts must be a list of id lists")
+                if max_new <= 0:
+                    raise ValueError("max_new_tokens must be positive")
                 t = len(prompts[0])
+                if t == 0:
+                    raise ValueError("prompts must be non-empty")
                 if any(len(p) != t for p in prompts):
                     raise ValueError(
                         "prompts in one request must share a length "
@@ -292,11 +310,13 @@ def lm_server(ctx: Context) -> None:
                     raise ValueError("token id out of vocabulary range")
             except (KeyError, ValueError, TypeError) as e:
                 return self._json(400, {"error": str(e)})
-            fn = get_fn(arr.shape[0], t, max_new, temperature)
             t0 = time.time()
             with device_lock:
+                fn = get_fn(arr.shape[0], t, max_new, temperature <= 0.0)
                 rng_state["key"], sub = jax.random.split(rng_state["key"])
-                out = np.asarray(fn(params, jnp.asarray(arr), sub))
+                out = np.asarray(
+                    fn(params, jnp.asarray(arr), sub, jnp.float32(temperature))
+                )
             dt = time.time() - t0
             self._json(
                 200,

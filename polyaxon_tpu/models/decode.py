@@ -145,14 +145,18 @@ def generate(
     cfg: TransformerConfig,
     *,
     max_new_tokens: int,
-    temperature: float = 0.0,
+    temperature: Any = 0.0,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """prompt [B, T] → generated tokens [B, max_new_tokens].
 
     Greedy when ``temperature == 0``; otherwise temperature sampling.
-    The whole decode loop is one ``lax.scan`` of compiled one-token
-    steps — no host round-trips between tokens.
+    ``temperature`` may be a traced array (a jitted caller can pass it as
+    an argument rather than baking each value into a fresh compilation);
+    a traced value always takes the sampling branch — greedy-vs-sampling
+    is the only Python-level fork.  The whole decode loop is one
+    ``lax.scan`` of compiled one-token steps — no host round-trips
+    between tokens.
     """
     if cfg.n_experts:
         raise NotImplementedError("MoE decoding is not supported yet")
@@ -168,8 +172,10 @@ def generate(
     cache = init_cache(cfg, B, max_len)
     logits, cache = prefill(params, prompt, cache, cfg)
 
+    greedy = isinstance(temperature, (int, float)) and temperature <= 0.0
+
     def pick(logits, key):
-        if temperature <= 0.0:
+        if greedy:
             return jnp.argmax(logits, axis=-1)
         return jax.random.categorical(key, logits / temperature, axis=-1)
 

@@ -44,6 +44,13 @@ def sample_process(pid: Optional[int] = None) -> Dict[str, float]:
 def sample_devices() -> Dict[str, float]:
     """Per-local-device HBM usage from the PJRT client, if initialized."""
     out: Dict[str, float] = {}
+    import sys
+
+    if "jax" not in sys.modules:
+        # No jax in this process yet → no PJRT client to sample, and the
+        # telemetry thread must not be the thing that pays the jax import
+        # (non-jax gang workloads boot ~2s faster without it).
+        return out
     try:
         import jax
 

@@ -26,10 +26,14 @@ from pathlib import Path
 def _configure_jax_env(info) -> None:
     """Force the jax platform to match the plan's accelerator.
 
-    Env vars alone are not enough: site plugins (e.g. a TPU PJRT plugin
-    registered from sitecustomize) may have imported jax at interpreter
-    start and pinned ``jax_platforms`` — so for the cpu accelerator we also
-    override the config explicitly after import.
+    Env-var only — jax itself is NOT imported here.  The jax import is
+    the dominant cost of a gang member's boot (~2s of CPU), and plenty of
+    gang workloads (metric probes, shell services, notebooks) never touch
+    it; deferring it to first real use is what makes hpsearch waves
+    orchestration-bound instead of import-bound.  If something imported
+    jax before us (the TPU PJRT sitecustomize pins ``jax_platforms`` at
+    interpreter start — env vars alone are ignored then), the explicit
+    config override still runs, via :func:`_force_cpu_config`.
     """
     if info.accelerator.startswith("cpu"):
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -41,23 +45,33 @@ def _configure_jax_env(info) -> None:
         ]
         flags.append(f"--xla_force_host_platform_device_count={info.devices_per_host}")
         os.environ["XLA_FLAGS"] = " ".join(flags)
-    # Deterministic partitionable PRNG across meshes (same key → same stream
-    # regardless of sharding).
-    os.environ.setdefault("JAX_THREEFRY_PARTITIONABLE", "1")
-    if info.accelerator.startswith("cpu"):
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
         if info.num_processes > 1:
             # Cross-process CPU collectives need an explicit backend; gloo
             # plays the role ICI/DCN transports play on real slices.
-            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            os.environ.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+    # Deterministic partitionable PRNG across meshes (same key → same stream
+    # regardless of sharding).
+    os.environ.setdefault("JAX_THREEFRY_PARTITIONABLE", "1")
+    if info.accelerator.startswith("cpu") and "jax" in sys.modules:
+        _force_cpu_config(info)
+
+
+def _force_cpu_config(info) -> None:
+    """Pin jax to CPU through the config API (needed when a site plugin
+    already imported jax and env vars can no longer take effect)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if info.num_processes > 1:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 
 def _init_distributed(info) -> bool:
     """Join the jax.distributed world. Returns True if initialized."""
     if info.num_processes <= 1 or not info.coordinator:
         return False
+    if info.accelerator.startswith("cpu"):
+        _force_cpu_config(info)
     import jax
 
     jax.distributed.initialize(
@@ -136,13 +150,15 @@ def main() -> int:
         # Python entrypoint path: managed distributed world + mesh.
         distributed = _init_distributed(info)
         sampler.start()
-        import jax
 
-        from polyaxon_tpu.runtime.mesh import build_mesh
-
+        # The mesh is a THUNK: entrypoints that never read ctx.mesh (metric
+        # probes, services) never pay the jax import it pulls in.
         mesh = None
         if info.mesh_axes:
-            mesh = build_mesh(info.mesh_axes, dcn_axes=info.dcn_axes)
+            def mesh(axes=info.mesh_axes, dcn=info.dcn_axes):
+                from polyaxon_tpu.runtime.mesh import build_mesh
+
+                return build_mesh(axes, dcn_axes=dcn)
 
         params = dict(spec.declarations)
         params.update(run_cfg.kwargs)
@@ -174,6 +190,8 @@ def main() -> int:
         fn(ctx)
 
         if distributed:
+            import jax
+
             jax.distributed.shutdown()
         reporter.status("succeeded")
         return 0

@@ -37,7 +37,11 @@ class Context:
         self.params = params
         self.process_id = process_id
         self.num_processes = num_processes
-        self.mesh = mesh
+        # A Mesh, or a zero-arg thunk building one on first access: the
+        # worker passes a thunk so non-jax entrypoints (metric probes,
+        # shell services) never pay the jax import — the dominant cost of
+        # a gang member's boot, and therefore of hpsearch wave throughput.
+        self._mesh = mesh
         self.strategy = strategy
         self.strategy_options = strategy_options or {}
         self.outputs_path = Path(outputs_path) if outputs_path else None
@@ -55,6 +59,17 @@ class Context:
     def is_leader(self) -> bool:
         """Process 0 — the one that should write checkpoints/summaries."""
         return self.process_id == 0
+
+    @property
+    def mesh(self) -> Any:
+        """The device mesh (built lazily on first access)."""
+        if callable(self._mesh):
+            self._mesh = self._mesh()
+        return self._mesh
+
+    @mesh.setter
+    def mesh(self, value: Any) -> None:
+        self._mesh = value
 
     # -- tracking -------------------------------------------------------------
     def log_metrics(self, step: Optional[int] = None, **values: Any) -> None:

@@ -112,6 +112,23 @@ class TestInferenceService:
         assert all(0 <= tok < 64 for t in out["tokens"] for tok in t)
         assert out["decode_tokens_per_s"] > 0
 
+        # Sampling path: temperature rides as a traced argument (same
+        # compiled fn for any non-zero value — no compile per float).
+        req = urllib.request.Request(
+            f"{url}/generate",
+            data=json.dumps(
+                {
+                    "prompts": [[1, 2, 3, 4], [5, 6, 7, 8]],
+                    "max_new_tokens": 4,
+                    "temperature": 0.8,
+                }
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            sampled = json.load(r)
+        assert len(sampled["tokens"]) == 2 and len(sampled["tokens"][0]) == 4
+
         # Bad requests are 400s, not server crashes.
         bad = urllib.request.Request(
             f"{url}/generate",
