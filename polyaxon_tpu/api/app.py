@@ -490,6 +490,60 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         )
         return web.json_response({"ok": True})
 
+    # -- CI (reference api/ci/: per-project trigger config) --------------------
+    @routes.put(f"{API_PREFIX}/projects/{{name}}/ci")
+    async def set_ci(request):
+        name = request.match_info["name"]
+        _require_project_owner(request, name)
+        body = await request.json()
+        spec = body.get("spec") or body.get("content")
+        if not spec:
+            return web.json_response(
+                {"error": "CI needs a 'spec' to run on new code"}, status=400
+            )
+        try:
+            ci = orch.set_project_ci(name, spec, actor=request.get("actor"))
+        except PolyaxonTPUError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(ci, status=201)
+
+    @routes.get(f"{API_PREFIX}/projects/{{name}}/ci")
+    async def get_ci(request):
+        name = request.match_info["name"]
+        _require_project(request, name)
+        ci = reg.get_project_ci(name)
+        if ci is None:
+            raise _json_error(web.HTTPNotFound, f"no CI configured for {name!r}")
+        return web.json_response(ci)
+
+    @routes.delete(f"{API_PREFIX}/projects/{{name}}/ci")
+    async def delete_ci(request):
+        name = request.match_info["name"]
+        _require_project_owner(request, name)
+        if not orch.delete_project_ci(name, actor=request.get("actor")):
+            raise _json_error(web.HTTPNotFound, f"no CI configured for {name!r}")
+        return web.json_response({"ok": True})
+
+    @routes.post(f"{API_PREFIX}/projects/{{name}}/ci/trigger")
+    async def trigger_ci(request):
+        """Manual code-push check (the reference repo-upload trigger).
+        ``context`` is a SERVER-side directory — owner/admin only, like
+        every surface that reads the service host's filesystem."""
+        name = request.match_info["name"]
+        _require_project_owner(request, name)
+        body = await request.json() if request.can_read_body else {}
+        try:
+            run = orch.trigger_ci(
+                name, context=body.get("context"), actor=request.get("actor")
+            )
+        except PolyaxonTPUError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if run is None:
+            return web.json_response({"triggered": False})
+        return web.json_response(
+            {"triggered": True, "run": run_to_dict(run)}, status=201
+        )
+
     # -- saved searches (reference api/searches/) -------------------------------
     @routes.post(f"{API_PREFIX}/searches")
     async def create_search(request):

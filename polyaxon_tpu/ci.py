@@ -1,0 +1,65 @@
+"""Per-project CI: new code snapshot → run the project's CI spec.
+
+Parity: the reference CI app — per-project toggle (``api/ci/views.py``),
+code-ref sync + trigger (``ci/service.py:15-117``), fired from its
+repo-upload views (``api/repos/views.py:162``).  TPU-native framing: the
+repo/commit machinery collapses into the content-addressed snapshot store
+(``stores/snapshots.py``) — a snapshot hash IS a commit, so CI fires
+whenever a project sees a hash it hasn't run yet, from either source:
+
+- automatically, when any non-CI run's build step snapshots new code
+  (``scheduler/tasks.py::_maybe_trigger_ci``);
+- explicitly, via ``POST /projects/{name}/ci/trigger`` / ``ptpu ci
+  trigger`` with a context directory (the push-equivalent for local mode).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from polyaxon_tpu.events import EventTypes, created_event_for_kind
+from polyaxon_tpu.schemas import PolyaxonFile
+from polyaxon_tpu.schemas.specifications import BaseSpecification
+
+logger = logging.getLogger(__name__)
+
+
+def submit_ci_run(
+    registry,
+    auditor,
+    project: str,
+    ci_spec: "Dict[str, Any] | BaseSpecification",
+    code_ref: str,
+    actor: Optional[str] = None,
+):
+    """Create the CI run for ``code_ref`` and announce it (the executor
+    chains build→start off the created event).  The run reuses the
+    triggering snapshot — same code hash, no second build walk.  Callers
+    must already have won ``advance_ci_code_ref``'s check-and-set.
+    ``ci_spec`` may arrive pre-parsed (manual trigger already validated
+    it to read the build section) or as the stored dict."""
+    spec = (
+        ci_spec
+        if isinstance(ci_spec, BaseSpecification)
+        else PolyaxonFile.load(ci_spec).specification
+    )
+    run = registry.create_run(
+        spec,
+        project=project,
+        name=f"ci-{code_ref[:12]}",
+        tags=["ci"],
+    )
+    registry.update_run(run.id, code_ref=code_ref)
+    event_type, key = created_event_for_kind(run.kind)
+    extra = {"actor": actor} if actor else {}
+    auditor.record(event_type, **{key: run.id}, code_ref=code_ref, **extra)
+    auditor.record(
+        EventTypes.CI_TRIGGERED,
+        project=project,
+        run_id=run.id,
+        code_ref=code_ref,
+        **extra,
+    )
+    logger.info("CI: code %s in %s -> run %s", code_ref[:12], project, run.id)
+    return registry.get_run(run.id)

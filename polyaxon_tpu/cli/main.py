@@ -162,6 +162,21 @@ class RemoteClient:
     def delete_project(self, name):
         return self._request("DELETE", f"/api/v1/projects/{name}")
 
+    def set_ci(self, project, spec):
+        return self._request("PUT", f"/api/v1/projects/{project}/ci", {"spec": spec})
+
+    def get_ci(self, project):
+        return self._request("GET", f"/api/v1/projects/{project}/ci")
+
+    def delete_ci(self, project):
+        return self._request("DELETE", f"/api/v1/projects/{project}/ci")
+
+    def trigger_ci(self, project, context=None):
+        body = {"context": context} if context else {}
+        return self._request(
+            "POST", f"/api/v1/projects/{project}/ci/trigger", body
+        )
+
     def share_project(self, name, username):
         return self._request(
             "POST", f"/api/v1/projects/{name}/collaborators", {"username": username}
@@ -343,6 +358,27 @@ class LocalClient:
             name, description=description, owner=owner
         )
 
+    def set_ci(self, project, spec):
+        return self.orch.set_project_ci(project, spec)
+
+    def get_ci(self, project):
+        ci = self.orch.registry.get_project_ci(project)
+        if ci is None:
+            raise SystemExit(f"no CI configured for {project!r}")
+        return ci
+
+    def delete_ci(self, project):
+        if not self.orch.delete_project_ci(project):
+            raise SystemExit(f"no CI configured for {project!r}")
+        return {"ok": True}
+
+    def trigger_ci(self, project, context=None):
+        run = self.orch.trigger_ci(project, context=context)
+        self.orch.pump(max_wait=1.0)
+        if run is None:
+            return {"triggered": False}
+        return {"triggered": True, "run": self._to_dict(run)}
+
     def share_project(self, name, username):
         if self.orch.registry.get_project(name) is None:
             raise SystemExit(f"no project named {name!r}")
@@ -413,6 +449,8 @@ def _client(args):
         return RemoteClient(args.host, token=getattr(args, "token", None))
     recover = args.command in _DRIVING_COMMANDS or (
         args.command == "logs" and getattr(args, "follow", False)
+    ) or (
+        args.command == "ci" and getattr(args, "ci_command", None) == "trigger"
     )
     return LocalClient(args.base_dir, recover=recover)
 
@@ -640,6 +678,25 @@ def main(argv=None) -> int:
     p_proj_unshare.add_argument("name")
     p_proj_unshare.add_argument("username")
 
+    p_ci = sub.add_parser(
+        "ci", help="per-project CI: run a spec on every new code snapshot"
+    )
+    ci_sub = p_ci.add_subparsers(dest="ci_command", required=True)
+    p_ci_set = ci_sub.add_parser("set", help="enable/replace a project's CI spec")
+    p_ci_set.add_argument("-f", "--file", required=True, help="polyaxonfile to run")
+    p_ci_set.add_argument("-p", "--project", default="default")
+    p_ci_show = ci_sub.add_parser("show", help="show a project's CI config")
+    p_ci_show.add_argument("-p", "--project", default="default")
+    p_ci_off = ci_sub.add_parser("off", help="disable a project's CI")
+    p_ci_off.add_argument("-p", "--project", default="default")
+    p_ci_trigger = ci_sub.add_parser(
+        "trigger", help="snapshot a context dir and run CI if the code is new"
+    )
+    p_ci_trigger.add_argument("-p", "--project", default="default")
+    p_ci_trigger.add_argument(
+        "--context", help="directory to snapshot (default: the CI spec's build context)"
+    )
+
     p_search = sub.add_parser("searches", help="saved run searches")
     search_sub = p_search.add_subparsers(dest="searches_command", required=True)
     p_search_add = search_sub.add_parser("add", help="save a query under a name")
@@ -837,6 +894,29 @@ def main(argv=None) -> int:
             elif args.projects_command == "unshare":
                 client.unshare_project(args.name, args.username)
                 print("removed collaborator", file=sys.stderr)
+            return 0
+        if args.command == "ci":
+            if args.ci_command == "set":
+                spec_text = Path(args.file).read_text()
+                import yaml
+
+                ci = client.set_ci(args.project, yaml.safe_load(spec_text))
+                print(json.dumps(ci, indent=2, default=str))
+            elif args.ci_command == "show":
+                print(json.dumps(client.get_ci(args.project), indent=2, default=str))
+            elif args.ci_command == "off":
+                client.delete_ci(args.project)
+                print("CI disabled", file=sys.stderr)
+            elif args.ci_command == "trigger":
+                out = client.trigger_ci(args.project, context=args.context)
+                if out.get("triggered"):
+                    run = out["run"]
+                    print(
+                        f"CI triggered run {run['id']} ({run['kind']})",
+                        file=sys.stderr,
+                    )
+                else:
+                    print("code unchanged — nothing to run", file=sys.stderr)
             return 0
         if args.command == "searches":
             if args.searches_command == "add":

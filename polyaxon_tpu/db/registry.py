@@ -161,6 +161,14 @@ CREATE TABLE IF NOT EXISTS project_collaborators (
     PRIMARY KEY (project_name, username)
 );
 
+CREATE TABLE IF NOT EXISTS project_ci (
+    project_name TEXT PRIMARY KEY,
+    spec TEXT NOT NULL,
+    last_code_ref TEXT,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+
 CREATE TABLE IF NOT EXISTS searches (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
     name TEXT UNIQUE NOT NULL,
@@ -1377,8 +1385,63 @@ class RunRegistry:
             conn.execute(
                 "DELETE FROM project_collaborators WHERE project_name = ?", (name,)
             )
+            conn.execute("DELETE FROM project_ci WHERE project_name = ?", (name,))
             cur = conn.execute("DELETE FROM projects WHERE name = ?", (name,))
             return cur.rowcount > 0, victims
+
+    # -- CI (per-project trigger config) ---------------------------------------
+    # Parity: the reference's CI app (``api/ci/`` + ``ci/service.py``) —
+    # a per-project toggle holding the spec to run whenever NEW code
+    # arrives.  There "new code" is a repo commit; here it's a new
+    # content-hashed snapshot ref (``stores/snapshots.py`` is the
+    # dockerizer replacement, so the snapshot hash IS the code ref).
+
+    def set_project_ci(self, project: str, spec: Dict[str, Any]) -> Dict[str, Any]:
+        now = time.time()
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO project_ci (project_name, spec, created_at, updated_at)
+                   VALUES (?, ?, ?, ?)
+                   ON CONFLICT (project_name) DO UPDATE
+                   SET spec = excluded.spec, updated_at = excluded.updated_at,
+                       last_code_ref = NULL""",
+                (project, json.dumps(spec), now, now),
+            )
+        return self.get_project_ci(project)
+
+    def get_project_ci(self, project: str) -> Optional[Dict[str, Any]]:
+        row = self._conn().execute(
+            "SELECT * FROM project_ci WHERE project_name = ?", (project,)
+        ).fetchone()
+        if row is None:
+            return None
+        return {
+            "project": row["project_name"],
+            "spec": json.loads(row["spec"]),
+            "last_code_ref": row["last_code_ref"],
+            "created_at": row["created_at"],
+            "updated_at": row["updated_at"],
+        }
+
+    def delete_project_ci(self, project: str) -> bool:
+        with self._lock, self._conn() as conn:
+            cur = conn.execute(
+                "DELETE FROM project_ci WHERE project_name = ?", (project,)
+            )
+        return cur.rowcount > 0
+
+    def advance_ci_code_ref(self, project: str, code_ref: str) -> bool:
+        """Record ``code_ref`` as seen; True only when it was NEW (the
+        reference's ``CIService.sync`` code-ref comparison) — the atomic
+        check-and-set is what makes concurrent triggers fire once."""
+        with self._lock, self._conn() as conn:
+            cur = conn.execute(
+                """UPDATE project_ci SET last_code_ref = ?, updated_at = ?
+                   WHERE project_name = ? AND
+                         (last_code_ref IS NULL OR last_code_ref != ?)""",
+                (code_ref, time.time(), project, code_ref),
+            )
+        return cur.rowcount > 0
 
     # -- saved searches (reference api/searches/) ------------------------------
     def create_search(

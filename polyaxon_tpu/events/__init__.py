@@ -1,3 +1,3 @@
-from polyaxon_tpu.events.registry import Event, EventTypes
+from polyaxon_tpu.events.registry import Event, EventTypes, created_event_for_kind
 
-__all__ = ["Event", "EventTypes"]
+__all__ = ["Event", "EventTypes", "created_event_for_kind"]

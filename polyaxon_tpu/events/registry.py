@@ -76,6 +76,25 @@ class EventTypes:
     BOOKMARK_ADDED = "bookmark.added"
     BOOKMARK_REMOVED = "bookmark.removed"
 
+    # CI (reference api/ci/ + ci/service.py)
+    CI_SET = "ci.set"
+    CI_DELETED = "ci.deleted"
+    CI_TRIGGERED = "ci.triggered"
+
+
+def created_event_for_kind(kind: str):
+    """(event_type, id_key) announcing a freshly created run of ``kind`` —
+    the single mapping behind orchestrator.submit and the CI trigger, so
+    a new kind can't get created-event wiring in one and not the other."""
+    table = {
+        "experiment": (EventTypes.EXPERIMENT_CREATED, "run_id"),
+        "job": (EventTypes.EXPERIMENT_CREATED, "run_id"),
+        "build": (EventTypes.EXPERIMENT_CREATED, "run_id"),
+        "group": (EventTypes.GROUP_CREATED, "group_id"),
+        "pipeline": (EventTypes.PIPELINE_CREATED, "pipeline_id"),
+    }
+    return table.get(kind, (EventTypes.EXPERIMENT_CREATED, "run_id"))
+
 
 @dataclass
 class Event:

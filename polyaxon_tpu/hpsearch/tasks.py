@@ -78,6 +78,11 @@ def register_hp_tasks(ctx: HPContext) -> None:
                 group_id=group.id,
                 tags=["trial"],
             )
+            # Trials run THEIR GROUP's code: inherit its snapshot ref so
+            # every trial tests the same bytes (and a CI-triggered group's
+            # trials can't re-snapshot the build context and fire CI again).
+            if group.code_ref:
+                reg.update_run(run.id, code_ref=group.code_ref)
             ids.append(run.id)
         return ids
 

@@ -455,16 +455,9 @@ class Orchestrator:
         if not isinstance(spec, BaseSpecification):
             spec = PolyaxonFile.load(spec).specification
         run = self.registry.create_run(spec, project=project, name=name, tags=tags)
-        created_events = {
-            Kinds.EXPERIMENT: (EventTypes.EXPERIMENT_CREATED, "run_id"),
-            Kinds.JOB: (EventTypes.EXPERIMENT_CREATED, "run_id"),
-            Kinds.BUILD: (EventTypes.EXPERIMENT_CREATED, "run_id"),
-            Kinds.GROUP: (EventTypes.GROUP_CREATED, "group_id"),
-            Kinds.PIPELINE: (EventTypes.PIPELINE_CREATED, "pipeline_id"),
-        }
-        event_type, key = created_events.get(
-            run.kind, (EventTypes.EXPERIMENT_CREATED, "run_id")
-        )
+        from polyaxon_tpu.events import created_event_for_kind
+
+        event_type, key = created_event_for_kind(run.kind)
         # Actor attribution (reference events carry actor attributes,
         # ``events/event.py:41``): who did it rides the activity feed.
         extra = {"actor": actor} if actor else {}
@@ -516,6 +509,61 @@ class Orchestrator:
 
     def get_run(self, run_id: Union[int, str]) -> Run:
         return self.registry.get_run(run_id)
+
+    # -- CI (per-project trigger; reference api/ci/ + ci/service.py) -----------
+    def set_project_ci(
+        self, project: str, spec, actor: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Enable/replace a project's CI: ``spec`` runs on every new code
+        snapshot.  Validated up front — a stored CI spec must never blow
+        up at trigger time."""
+        if not isinstance(spec, BaseSpecification):
+            spec = PolyaxonFile.load(spec).specification
+        ci = self.registry.set_project_ci(project, spec.to_dict())
+        self.auditor.record(
+            EventTypes.CI_SET,
+            project=project,
+            **({"actor": actor} if actor else {}),
+        )
+        return ci
+
+    def delete_project_ci(self, project: str, actor: Optional[str] = None) -> bool:
+        removed = self.registry.delete_project_ci(project)
+        if removed:
+            self.auditor.record(
+                EventTypes.CI_DELETED,
+                project=project,
+                **({"actor": actor} if actor else {}),
+            )
+        return removed
+
+    def trigger_ci(
+        self,
+        project: str,
+        context: Optional[str] = None,
+        actor: Optional[str] = None,
+    ) -> Optional[Run]:
+        """Manual CI check: snapshot ``context`` (default: the CI spec's
+        build context) and run the CI spec if the code hash is new.
+        Returns the created run, or None when the code was already seen —
+        the reference's repos-upload trigger, push-shaped for local mode."""
+        from polyaxon_tpu.ci import submit_ci_run
+        from polyaxon_tpu.schemas.run import BuildConfig
+        from polyaxon_tpu.stores import create_snapshot
+
+        ci = self.registry.get_project_ci(project)
+        if ci is None:
+            raise PolyaxonTPUError(f"Project {project!r} has no CI configured")
+        spec = PolyaxonFile.load(ci["spec"]).specification
+        build = getattr(spec, "build", None) or BuildConfig()
+        ref = create_snapshot(
+            build, context or build.context, self.layout.snapshots_dir
+        )
+        if not self.registry.advance_ci_code_ref(project, ref):
+            return None
+        return submit_ci_run(
+            self.registry, self.auditor, project, spec, ref, actor=actor
+        )
 
     # -- archival + deletion ---------------------------------------------------
     # Parity: reference archive/restore/delete views + the deletion tasks

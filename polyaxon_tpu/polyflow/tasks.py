@@ -63,13 +63,18 @@ def register_pipeline_tasks(ctx: PipelineContext) -> None:
             reg.set_status(pipeline_id, S.FAILED, message=str(e))
             return
         for op in spec.ops:
-            reg.create_run(
+            op_run = reg.create_run(
                 _op_spec(pipeline, op),
                 name=op["name"],
                 project=pipeline.project,
                 pipeline_id=pipeline_id,
                 tags=["operation"],
             )
+            # Ops run THEIR PIPELINE's code (same inheritance as group
+            # trials: one snapshot per submission, no per-op re-walks —
+            # and no CI self-retrigger from a CI-triggered pipeline).
+            if pipeline.code_ref:
+                reg.update_run(op_run.id, code_ref=pipeline.code_ref)
         reg.set_status(pipeline_id, S.RUNNING)
         bus.send(PipelineTasks.CHECK, {"pipeline_id": pipeline_id})
 

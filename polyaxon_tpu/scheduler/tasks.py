@@ -113,7 +113,40 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
                 _record_done(ctx, run_id, S.FAILED)
                 return
             reg.update_run(run_id, code_ref=ref)
+            _maybe_trigger_ci(reg.get_run(run_id), ref)
         ctx.auditor.record(EventTypes.EXPERIMENT_BUILD_DONE, run_id=run_id)
+
+    def _maybe_trigger_ci(run, code_ref: str) -> None:
+        """New code snapshot in a CI-enabled project → submit its CI spec.
+
+        Parity: the reference triggers ``ci.trigger(project)`` from its
+        repo-upload views (``api/repos/views.py:162``); here code arrives
+        as a content-hashed snapshot during the build step, so the hash IS
+        the commit.  The 'ci' tag guards against self-retrigger loops, and
+        ``advance_ci_code_ref``'s atomic check-and-set makes concurrent
+        builds of the same ref fire exactly one CI run.
+        """
+        # Self-retrigger guard: the CI run itself AND its descendants (a CI
+        # group's trials, a CI pipeline's ops) must not fire CI — walk up
+        # the parent chain looking for the 'ci' tag.
+        node, hops = run, 0
+        while node is not None and hops < 8:
+            if "ci" in node.tags:
+                return
+            parent_id = node.group_id or node.pipeline_id
+            node = reg.get_run(parent_id) if parent_id else None
+            hops += 1
+        ci = reg.get_project_ci(run.project)
+        if ci is None:
+            return
+        if not reg.advance_ci_code_ref(run.project, code_ref):
+            return
+        from polyaxon_tpu.ci import submit_ci_run
+
+        try:
+            submit_ci_run(reg, ctx.auditor, run.project, ci["spec"], code_ref)
+        except PolyaxonTPUError as e:
+            logger.warning("CI trigger for %s failed: %s", run.project, e)
 
     @bus.register(SchedulerTasks.EXPERIMENTS_START)
     def experiments_start(run_id: int) -> None:

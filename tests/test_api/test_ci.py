@@ -1,0 +1,86 @@
+"""REST surface for per-project CI (reference ``api/ci/views.py``)."""
+
+import asyncio
+
+import pytest
+
+from polyaxon_tpu.api.app import create_app
+from polyaxon_tpu.orchestrator import Orchestrator
+
+CI_SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:metric_probe"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(tmp_path / "plat", monitor_interval=0.05)
+    yield o
+    o.stop()
+
+
+def drive(orch, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        app = create_app(orch)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+class TestCIAPI:
+    def test_ci_crud_and_trigger(self, orch, tmp_path):
+        code = tmp_path / "code"
+        code.mkdir()
+        (code / "main.py").write_text("v1\n")
+
+        async def body(client):
+            # No CI yet.
+            assert (await client.get("/api/v1/projects/default/ci")).status == 404
+
+            resp = await client.put(
+                "/api/v1/projects/default/ci", json={"spec": CI_SPEC}
+            )
+            assert resp.status == 201
+            ci = await resp.json()
+            assert ci["spec"]["kind"] == "experiment"
+            assert ci["last_code_ref"] is None
+
+            # Missing spec is a 400.
+            resp = await client.put("/api/v1/projects/default/ci", json={})
+            assert resp.status == 400
+
+            # Trigger with new code creates a run; same code is a no-op.
+            resp = await client.post(
+                "/api/v1/projects/default/ci/trigger",
+                json={"context": str(code)},
+            )
+            assert resp.status == 201
+            out = await resp.json()
+            assert out["triggered"] and "ci" in out["run"]["tags"]
+            resp = await client.post(
+                "/api/v1/projects/default/ci/trigger",
+                json={"context": str(code)},
+            )
+            assert resp.status == 200
+            assert (await resp.json())["triggered"] is False
+
+            resp = await client.delete("/api/v1/projects/default/ci")
+            assert resp.status == 200
+            assert (await client.get("/api/v1/projects/default/ci")).status == 404
+            # Trigger without CI configured is a 400.
+            resp = await client.post("/api/v1/projects/default/ci/trigger")
+            assert resp.status == 400
+            return True
+
+        assert drive(orch, body)

@@ -1,0 +1,174 @@
+"""Per-project CI e2e: new code snapshot → the CI spec runs, tagged 'ci'.
+
+Parity: reference CI app (``api/ci/`` + ``ci/service.py`` + the
+repo-upload trigger at ``api/repos/views.py:162``) — here "a commit" is
+a new content-hashed snapshot (``stores/snapshots.py``).
+"""
+
+import pytest
+
+from polyaxon_tpu.exceptions import PolyaxonTPUError
+from polyaxon_tpu.events import EventTypes
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.orchestrator import Orchestrator
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(
+        tmp_path / "plat",
+        monitor_interval=0.1,
+        heartbeat_interval=0.5,
+    )
+    yield o
+    o.stop()
+
+
+def ci_spec():
+    return {
+        "kind": "experiment",
+        "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:metric_probe"},
+        "environment": {
+            "topology": {
+                "accelerator": "cpu-1",
+                "num_devices": 1,
+                "num_hosts": 1,
+            }
+        },
+    }
+
+
+def build_spec(context):
+    return {
+        **ci_spec(),
+        "build": {"context": str(context), "include": ["**/*.py"]},
+    }
+
+
+@pytest.mark.e2e
+class TestCIFlow:
+    def test_manual_trigger_runs_once_per_code_ref(self, orch, tmp_path):
+        code = tmp_path / "code"
+        code.mkdir()
+        (code / "train.py").write_text("print('v1')\n")
+
+        orch.set_project_ci("default", ci_spec())
+        run = orch.trigger_ci("default", context=str(code))
+        assert run is not None and "ci" in run.tags
+        assert run.code_ref is not None
+        done = orch.wait(run.id, timeout=60)
+        assert done.status == S.SUCCEEDED
+
+        # Same code again: no new run.
+        assert orch.trigger_ci("default", context=str(code)) is None
+
+        # New code: a second CI run fires.
+        (code / "train.py").write_text("print('v2')\n")
+        run2 = orch.trigger_ci("default", context=str(code))
+        assert run2 is not None and run2.id != run.id
+        assert run2.code_ref != run.code_ref
+        events = [a["event_type"] for a in orch.registry.get_activities()]
+        assert events.count(EventTypes.CI_TRIGGERED) == 2
+
+    def test_build_step_auto_triggers_ci(self, orch, tmp_path):
+        """A normal run whose build snapshots NEW code fires the project
+        CI exactly once — and the CI run itself must not re-trigger."""
+        code = tmp_path / "code"
+        code.mkdir()
+        (code / "model.py").write_text("x = 1\n")
+
+        orch.set_project_ci("default", ci_spec())
+        run = orch.submit(build_spec(code), name="dev-run")
+        done = orch.wait(run.id, timeout=60)
+        assert done.status == S.SUCCEEDED
+        # Drive the CI run the build spawned.
+        ci_runs = [
+            r
+            for r in orch.registry.list_runs(project="default")
+            if "ci" in r.tags
+        ]
+        assert len(ci_runs) == 1
+        ci_done = orch.wait(ci_runs[0].id, timeout=60)
+        assert ci_done.status == S.SUCCEEDED
+        # The CI run reused the triggering snapshot.
+        assert ci_done.code_ref == done.code_ref
+
+        # Re-running the SAME code does not trigger again.
+        run2 = orch.submit(build_spec(code), name="dev-run-2")
+        orch.wait(run2.id, timeout=60)
+        ci_runs = [
+            r
+            for r in orch.registry.list_runs(project="default")
+            if "ci" in r.tags
+        ]
+        assert len(ci_runs) == 1
+
+    def test_group_ci_spec_does_not_self_retrigger(self, orch, tmp_path):
+        """A CI spec of kind GROUP: the sweep's trials inherit the
+        triggering snapshot (same bytes under test) and never fire CI
+        themselves — the failure mode was trials re-snapshotting the
+        build context and alternating last_code_ref forever."""
+        code = tmp_path / "code"
+        code.mkdir()
+        (code / "train.py").write_text("print('v1')\n")
+        group_ci = {
+            "kind": "group",
+            "run": {
+                "entrypoint": "polyaxon_tpu.builtins.trainers:metric_probe"
+            },
+            "build": {"context": str(code), "include": ["**/*.py"]},
+            "environment": {
+                "topology": {
+                    "accelerator": "cpu-1",
+                    "num_devices": 1,
+                    "num_hosts": 1,
+                }
+            },
+            "hptuning": {
+                "matrix": {"lr": {"uniform": [0, 1]}},
+                "concurrency": 2,
+                "random_search": {"n_experiments": 2, "seed": 0},
+            },
+        }
+        orch.set_project_ci("default", group_ci)
+        run = orch.trigger_ci("default", context=str(code))
+        assert run is not None and run.kind == "group"
+        done = orch.wait(run.id, timeout=120)
+        assert done.status == S.SUCCEEDED
+        trials = orch.registry.list_runs(group_id=run.id)
+        assert len(trials) == 2
+        # Trials carry the group's snapshot, and no extra CI run fired.
+        assert all(t.code_ref == run.code_ref for t in trials)
+        ci_runs = [
+            r
+            for r in orch.registry.list_runs(project="default")
+            if "ci" in r.tags
+        ]
+        assert [r.id for r in ci_runs] == [run.id]
+        # Same code again: still nothing new.
+        assert orch.trigger_ci("default", context=str(code)) is None
+
+    def test_replacing_ci_spec_resets_code_ref(self, orch, tmp_path):
+        """A fixed CI spec must be runnable against UNCHANGED code —
+        replacing the spec clears last_code_ref."""
+        code = tmp_path / "code"
+        code.mkdir()
+        (code / "train.py").write_text("print('v1')\n")
+        orch.set_project_ci("default", ci_spec())
+        first = orch.trigger_ci("default", context=str(code))
+        assert first is not None
+        orch.wait(first.id, timeout=60)
+        assert orch.trigger_ci("default", context=str(code)) is None
+        orch.set_project_ci("default", ci_spec())  # replace (same content ok)
+        again = orch.trigger_ci("default", context=str(code))
+        assert again is not None and again.id != first.id
+
+    def test_ci_config_lifecycle(self, orch):
+        with pytest.raises(PolyaxonTPUError):
+            orch.trigger_ci("default")
+        ci = orch.set_project_ci("default", ci_spec())
+        assert ci["spec"]["kind"] == "experiment"
+        assert orch.registry.get_project_ci("default") is not None
+        assert orch.delete_project_ci("default")
+        assert orch.registry.get_project_ci("default") is None
+        assert not orch.delete_project_ci("default")
