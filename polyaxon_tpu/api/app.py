@@ -260,6 +260,43 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         clone = orch.clone_run(run.id, strategy="copy", actor=request.get("actor"))
         return web.json_response(run_to_dict(clone), status=201)
 
+    # -- chart views (reference ChartViewModel + its experiment/group views) --
+    @routes.post(f"{API_PREFIX}/runs/{{run_id}}/chart_views")
+    async def create_chart_view(request):
+        run = _run_or_404(request)
+        body = await request.json()
+        name = (body.get("name") or "").strip()
+        charts = body.get("charts")
+        if not name or charts is None:
+            return web.json_response(
+                {"error": "a chart view needs a 'name' and 'charts'"},
+                status=400,
+            )
+        view = reg.create_chart_view(
+            run.id,
+            name,
+            charts,
+            meta=body.get("meta"),
+            owner=request.get("actor"),
+        )
+        return web.json_response(view, status=201)
+
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/chart_views")
+    async def list_chart_views(request):
+        run = _run_or_404(request)
+        return web.json_response({"results": reg.list_chart_views(run.id)})
+
+    @routes.delete(f"{API_PREFIX}/runs/{{run_id}}/chart_views/{{view_id}}")
+    async def delete_chart_view(request):
+        run = _run_or_404(request)
+        try:
+            view_id = int(request.match_info["view_id"])
+        except ValueError:
+            raise _json_error(web.HTTPNotFound, "no such chart view")
+        if not reg.delete_chart_view(run.id, view_id):
+            raise _json_error(web.HTTPNotFound, "no such chart view")
+        return web.json_response({"ok": True})
+
     # -- archival + deletion (reference api/archives/ + delete views) ---------
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/archive")
     async def archive_run(request):

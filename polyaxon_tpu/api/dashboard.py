@@ -118,6 +118,12 @@ DASHBOARD_HTML = """<!doctype html>
         <tbody id="trials"></tbody></table>
     </div>
     <div class="panel">
+      <h2>Metrics
+        <select id="view-select" onchange="applyView()"><option value="">(all)</option></select>
+        <input id="view-name" placeholder="view name" style="width:110px"/>
+        <button onclick="saveView()">save view</button>
+      </h2>
+      <div id="metric-picks" class="dim"></div>
       <div id="chart-legend" class="dim"></div>
       <canvas id="chart" width="900" height="160"></canvas>
     </div>
@@ -295,6 +301,9 @@ async function select(id, kind) {
   } else {
     openLogStream(id);
   }
+  chartSelection = null;
+  document.getElementById('view-select').value = '';
+  await loadChartViews();
   await refreshDetail();
 }
 
@@ -477,7 +486,62 @@ function drawCompare() {
   ).join(' &nbsp; ');
 }
 
+// Saved chart views (reference ChartViewModel): a named metric selection
+// per run. chartSelection null = auto (first 6 series).
+let chartSelection = null;
+let lastChartRows = [];
+let chartViews = [];
+
+async function loadChartViews() {
+  const resp = await apiFetch(`/api/v1/runs/${selected}/chart_views`);
+  if (!resp.ok) { chartViews = []; return; }
+  chartViews = (await resp.json()).results;
+  const sel = document.getElementById('view-select');
+  const keep = sel.value;
+  sel.innerHTML = '<option value="">(all)</option>' + chartViews.map(v =>
+    `<option value="${Number(v.id)}">${esc(v.name)}</option>`).join('');
+  if ([...sel.options].some(o => o.value === keep)) sel.value = keep;
+}
+
+function applyView() {
+  const id = document.getElementById('view-select').value;
+  const view = chartViews.find(v => String(v.id) === id);
+  chartSelection = view ? new Set(view.charts) : null;
+  drawChart(lastChartRows);
+}
+
+async function saveView() {
+  const name = document.getElementById('view-name').value.trim();
+  if (!selected || !name) return;
+  const charts = chartSelection ? [...chartSelection]
+    : [...new Set(lastChartRows.flatMap(r => Object.keys(r.values)
+        .filter(k => !k.startsWith('sys/'))))];
+  await apiFetch(`/api/v1/runs/${selected}/chart_views`, {
+    method: 'POST',
+    body: JSON.stringify({name, charts}),
+  });
+  await loadChartViews();
+  document.getElementById('view-select').value =
+    String((chartViews.find(v => v.name === name)||{}).id ?? '');
+}
+
+// Index-addressed (same rule as runSearchIdx): metric names are arbitrary
+// user strings and must never be interpolated into inline JS.
+let chartMetricNames = [];
+function toggleMetricIdx(i) {
+  const name = chartMetricNames[i];
+  if (name === undefined) return;
+  if (!chartSelection)
+    chartSelection = new Set(lastChartRows.flatMap(r => Object.keys(r.values)
+      .filter(k => !k.startsWith('sys/'))));
+  if (chartSelection.has(name)) chartSelection.delete(name);
+  else chartSelection.add(name);
+  document.getElementById('view-select').value = '';
+  drawChart(lastChartRows);
+}
+
 function drawChart(rows) {
+  lastChartRows = rows;
   const c = document.getElementById('chart'), ctx = c.getContext('2d');
   ctx.clearRect(0,0,c.width,c.height);
   // [step, value] series keyed by metric name (step falls back to index).
@@ -486,7 +550,17 @@ function drawChart(rows) {
     if (typeof v==='number' && !k.startsWith('sys/'))
       (series[k] = series[k]||[]).push([r.step ?? i, v]);
   }));
-  const entries = Object.entries(series).slice(0,6)
+  // Per-metric toggles (the saved-view building blocks).
+  chartMetricNames = Object.keys(series);
+  const picks = document.getElementById('metric-picks');
+  picks.innerHTML = chartMetricNames.map((k, i) => {
+    const on = !chartSelection || chartSelection.has(k);
+    return `<label style="margin-right:12px"><input type="checkbox" ` +
+      `${on?'checked':''} onchange="toggleMetricIdx(${Number(i)})"/> ${esc(k)}</label>`;
+  }).join('');
+  const entries = Object.entries(series)
+    .filter(([k]) => !chartSelection || chartSelection.has(k))
+    .slice(0,6)
     .filter(([,pts]) => pts.length > 1);
   const legend = document.getElementById('chart-legend');
   if (!entries.length) { legend.innerHTML = ''; return; }

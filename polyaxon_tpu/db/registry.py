@@ -161,6 +161,17 @@ CREATE TABLE IF NOT EXISTS project_collaborators (
     PRIMARY KEY (project_name, username)
 );
 
+CREATE TABLE IF NOT EXISTS chart_views (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_id INTEGER NOT NULL,
+    name TEXT NOT NULL,
+    charts TEXT NOT NULL,
+    meta TEXT NOT NULL DEFAULT '{}',
+    owner TEXT,
+    created_at REAL NOT NULL,
+    UNIQUE (run_id, name)
+);
+
 CREATE TABLE IF NOT EXISTS project_ci (
     project_name TEXT PRIMARY KEY,
     spec TEXT NOT NULL,
@@ -588,6 +599,7 @@ class RunRegistry:
                 ("heartbeats", "run_id"),
                 ("processes", "run_id"),
                 ("bookmarks", "run_id"),
+                ("chart_views", "run_id"),
                 ("iterations", "group_id"),
                 ("runs", "id"),
             ):
@@ -1388,6 +1400,69 @@ class RunRegistry:
             conn.execute("DELETE FROM project_ci WHERE project_name = ?", (name,))
             cur = conn.execute("DELETE FROM projects WHERE name = ?", (name,))
             return cur.rowcount > 0, victims
+
+    # -- chart views (reference db/models/charts.py ChartViewModel) ------------
+    def create_chart_view(
+        self,
+        run_id: int,
+        name: str,
+        charts: Any,
+        meta: Optional[Dict[str, Any]] = None,
+        owner: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Save a named chart configuration on a run (what metric set /
+        layout the dashboard should plot).  Same-name saves replace —
+        a view is a bookmarkable way of LOOKING at a run, not history."""
+        if not self._run_exists(run_id):
+            raise RegistryError(f"No run with id={run_id}")
+        now = time.time()
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO chart_views (run_id, name, charts, meta, owner, created_at)
+                   VALUES (?, ?, ?, ?, ?, ?)
+                   ON CONFLICT (run_id, name) DO UPDATE
+                   SET charts = excluded.charts, meta = excluded.meta""",
+                (
+                    run_id,
+                    name,
+                    json.dumps(charts),
+                    json.dumps(meta or {}),
+                    owner,
+                    now,
+                ),
+            )
+        row = self._conn().execute(
+            "SELECT * FROM chart_views WHERE run_id = ? AND name = ?",
+            (run_id, name),
+        ).fetchone()
+        return self._chart_view_row(row)
+
+    @staticmethod
+    def _chart_view_row(row: sqlite3.Row) -> Dict[str, Any]:
+        return {
+            "id": row["id"],
+            "run_id": row["run_id"],
+            "name": row["name"],
+            "charts": json.loads(row["charts"]),
+            "meta": json.loads(row["meta"]),
+            "owner": row["owner"],
+            "created_at": row["created_at"],
+        }
+
+    def list_chart_views(self, run_id: int) -> List[Dict[str, Any]]:
+        rows = self._conn().execute(
+            "SELECT * FROM chart_views WHERE run_id = ? ORDER BY created_at",
+            (run_id,),
+        ).fetchall()
+        return [self._chart_view_row(r) for r in rows]
+
+    def delete_chart_view(self, run_id: int, view_id: int) -> bool:
+        with self._lock, self._conn() as conn:
+            cur = conn.execute(
+                "DELETE FROM chart_views WHERE run_id = ? AND id = ?",
+                (run_id, view_id),
+            )
+        return cur.rowcount > 0
 
     # -- CI (per-project trigger config) ---------------------------------------
     # Parity: the reference's CI app (``api/ci/`` + ``ci/service.py``) —

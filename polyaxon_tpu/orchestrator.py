@@ -555,7 +555,16 @@ class Orchestrator:
         if ci is None:
             raise PolyaxonTPUError(f"Project {project!r} has no CI configured")
         spec = PolyaxonFile.load(ci["spec"]).specification
-        build = getattr(spec, "build", None) or BuildConfig()
+        build = getattr(spec, "build", None)
+        if build is None and context is None:
+            # Without either there is nothing sensible to snapshot — the
+            # fallback would be the SERVICE HOST's cwd, which is never the
+            # project's code.
+            raise PolyaxonTPUError(
+                "CI trigger needs a context directory (or a 'build' section "
+                "in the CI spec naming one)"
+            )
+        build = build or BuildConfig()
         ref = create_snapshot(
             build, context or build.context, self.layout.snapshots_dir
         )

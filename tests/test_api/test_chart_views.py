@@ -1,0 +1,118 @@
+"""Saved chart views (reference ``db/models/charts.py`` ChartViewModel)."""
+
+import asyncio
+
+import pytest
+
+from polyaxon_tpu.api.app import create_app
+from polyaxon_tpu.orchestrator import Orchestrator
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(tmp_path / "plat", monitor_interval=0.05)
+    yield o
+    o.stop()
+
+
+def drive(orch, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        app = create_app(orch)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+class TestChartViewsAPI:
+    def test_chart_view_crud(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+
+            resp = await client.post(
+                f"/api/v1/runs/{run['id']}/chart_views",
+                json={"name": "losses", "charts": ["loss", "val_loss"]},
+            )
+            assert resp.status == 201
+            view = await resp.json()
+            assert view["charts"] == ["loss", "val_loss"]
+
+            # Same-name save replaces, not duplicates.
+            resp = await client.post(
+                f"/api/v1/runs/{run['id']}/chart_views",
+                json={"name": "losses", "charts": ["loss"]},
+            )
+            assert resp.status == 201
+            listed = await (
+                await client.get(f"/api/v1/runs/{run['id']}/chart_views")
+            ).json()
+            assert len(listed["results"]) == 1
+            assert listed["results"][0]["charts"] == ["loss"]
+
+            # Missing fields are a 400.
+            resp = await client.post(
+                f"/api/v1/runs/{run['id']}/chart_views", json={"name": "x"}
+            )
+            assert resp.status == 400
+
+            resp = await client.delete(
+                f"/api/v1/runs/{run['id']}/chart_views/{view['id']}"
+            )
+            assert resp.status == 200
+            listed = await (
+                await client.get(f"/api/v1/runs/{run['id']}/chart_views")
+            ).json()
+            assert listed["results"] == []
+            resp = await client.delete(
+                f"/api/v1/runs/{run['id']}/chart_views/{view['id']}"
+            )
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)
+
+    def test_deleting_run_removes_its_views(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            await client.post(
+                f"/api/v1/runs/{run['id']}/chart_views",
+                json={"name": "v", "charts": ["loss"]},
+            )
+            # Drive to done, then delete.
+            loop = asyncio.get_event_loop()
+            for _ in range(200):
+                await loop.run_in_executor(None, orch.pump, 0.05)
+                got = await (await client.get(f"/api/v1/runs/{run['id']}")).json()
+                if got["is_done"]:
+                    break
+            await client.delete(f"/api/v1/runs/{run['id']}")
+            assert (
+                orch.registry._conn()
+                .execute(
+                    "SELECT COUNT(*) FROM chart_views WHERE run_id = ?",
+                    (run["id"],),
+                )
+                .fetchone()[0]
+                == 0
+            )
+            return True
+
+        assert drive(orch, body)
