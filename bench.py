@@ -47,12 +47,12 @@ def main() -> None:
     # Sized to exercise the MXU on one chip; tiny fallback for CPU smoke.
     if on_tpu:
         # Shape picked by measurement on v5e: d=2048/L=8 amortizes
-        # non-matmul overhead; batch 16 beats 8/24/32 (0.526 vs 0.506/
-        # 0.498/OOM); the save_attn remat policy keeps the attention
-        # output across the bwd recompute (+0.4 MFU pt) — full sweep in
-        # bench-notes. auto attention resolves to the in-house flash
-        # kernel (1024-edge tiles), which beats XLA dense at every
-        # measured T since the round-4 block sweep.
+        # non-matmul overhead; batch 20 is the r5 sweet spot (0.566 vs
+        # 16:0.560, 18:0.564, 22:0.559, 24/32 spill/OOM); the save_attn
+        # remat policy keeps the attention output across the bwd
+        # recompute — full sweep in bench-notes. auto attention resolves
+        # to the in-house flash kernel (1024-edge tiles), which beats XLA
+        # dense at every measured T since the round-4 block sweep.
         cfg = TransformerConfig(
             vocab_size=32768,
             d_model=2048,
@@ -64,7 +64,7 @@ def main() -> None:
             remat=True,
             remat_policy="save_attn",
         )
-        batch_size, seq, steps, warmup = 16, 1024, 20, 3
+        batch_size, seq, steps, warmup = 20, 1024, 20, 3
     else:
         cfg = TransformerConfig(
             vocab_size=256,

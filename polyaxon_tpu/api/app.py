@@ -267,9 +267,14 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         body = await request.json()
         name = (body.get("name") or "").strip()
         charts = body.get("charts")
-        if not name or charts is None:
+        if not name or not isinstance(charts, list) or not all(
+            isinstance(c, str) for c in charts
+        ):
             return web.json_response(
-                {"error": "a chart view needs a 'name' and 'charts'"},
+                {
+                    "error": "a chart view needs a 'name' and 'charts' "
+                    "(a list of metric names)"
+                },
                 status=400,
             )
         view = reg.create_chart_view(
@@ -279,6 +284,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             meta=body.get("meta"),
             owner=request.get("actor"),
         )
+        _audit(request, EventTypes.CHART_VIEW_CREATED, run_id=run.id, name=name)
         return web.json_response(view, status=201)
 
     @routes.get(f"{API_PREFIX}/runs/{{run_id}}/chart_views")
@@ -295,6 +301,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             raise _json_error(web.HTTPNotFound, "no such chart view")
         if not reg.delete_chart_view(run.id, view_id):
             raise _json_error(web.HTTPNotFound, "no such chart view")
+        _audit(request, EventTypes.CHART_VIEW_DELETED, run_id=run.id, view_id=view_id)
         return web.json_response({"ok": True})
 
     # -- archival + deletion (reference api/archives/ + delete views) ---------

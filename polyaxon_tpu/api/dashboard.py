@@ -513,9 +513,7 @@ function applyView() {
 async function saveView() {
   const name = document.getElementById('view-name').value.trim();
   if (!selected || !name) return;
-  const charts = chartSelection ? [...chartSelection]
-    : [...new Set(lastChartRows.flatMap(r => Object.keys(r.values)
-        .filter(k => !k.startsWith('sys/'))))];
+  const charts = chartSelection ? [...chartSelection] : [...chartMetricNames];
   await apiFetch(`/api/v1/runs/${selected}/chart_views`, {
     method: 'POST',
     body: JSON.stringify({name, charts}),
@@ -531,9 +529,7 @@ let chartMetricNames = [];
 function toggleMetricIdx(i) {
   const name = chartMetricNames[i];
   if (name === undefined) return;
-  if (!chartSelection)
-    chartSelection = new Set(lastChartRows.flatMap(r => Object.keys(r.values)
-      .filter(k => !k.startsWith('sys/'))));
+  if (!chartSelection) chartSelection = new Set(chartMetricNames);
   if (chartSelection.has(name)) chartSelection.delete(name);
   else chartSelection.add(name);
   document.getElementById('view-select').value = '';

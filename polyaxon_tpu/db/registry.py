@@ -1431,10 +1431,12 @@ class RunRegistry:
                     now,
                 ),
             )
-        row = self._conn().execute(
-            "SELECT * FROM chart_views WHERE run_id = ? AND name = ?",
-            (run_id, name),
-        ).fetchone()
+            # Read back INSIDE the lock: a concurrent delete between the
+            # upsert and the select would hand _chart_view_row a None.
+            row = conn.execute(
+                "SELECT * FROM chart_views WHERE run_id = ? AND name = ?",
+                (run_id, name),
+            ).fetchone()
         return self._chart_view_row(row)
 
     @staticmethod

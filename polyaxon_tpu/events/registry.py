@@ -81,6 +81,10 @@ class EventTypes:
     CI_DELETED = "ci.deleted"
     CI_TRIGGERED = "ci.triggered"
 
+    # chart views (reference events/registry/chart_view.py)
+    CHART_VIEW_CREATED = "chart_view.created"
+    CHART_VIEW_DELETED = "chart_view.deleted"
+
 
 def created_event_for_kind(kind: str):
     """(event_type, id_key) announcing a freshly created run of ``kind`` —

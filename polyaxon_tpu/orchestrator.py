@@ -556,10 +556,11 @@ class Orchestrator:
             raise PolyaxonTPUError(f"Project {project!r} has no CI configured")
         spec = PolyaxonFile.load(ci["spec"]).specification
         build = getattr(spec, "build", None)
-        if build is None and context is None:
-            # Without either there is nothing sensible to snapshot — the
-            # fallback would be the SERVICE HOST's cwd, which is never the
-            # project's code.
+        # An EXPLICIT context is required from one side or the other: the
+        # default BuildConfig context '.' would snapshot the SERVICE
+        # HOST's cwd, which is never the project's code.
+        spec_has_context = build is not None and "context" in build.model_fields_set
+        if context is None and not spec_has_context:
             raise PolyaxonTPUError(
                 "CI trigger needs a context directory (or a 'build' section "
                 "in the CI spec naming one)"
