@@ -754,6 +754,17 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             )
         return web.json_response({"ok": True})
 
+    # -- usage analytics (reference tracker/, kept in-house) -------------------
+    @routes.get(f"{API_PREFIX}/analytics")
+    async def analytics(request):
+        """Platform usage rollup: event counts per day + entity summary.
+        Admin-only — aggregate usage is operator data."""
+        _require_admin(request)
+        from polyaxon_tpu.tracker import usage_rollup
+
+        days = _int_param(request, "days", 14)
+        return web.json_response(usage_rollup(reg, days=days))
+
     # -- query vocabulary (dashboard autocomplete) ----------------------------
     @routes.get(f"{API_PREFIX}/query/fields")
     async def query_fields(request):

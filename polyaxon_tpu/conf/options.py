@@ -138,6 +138,8 @@ _ALL = [
     Option("cleaning.archives_ttl_days", float, 7.0,
            "archived runs older than this are purged by the cron"),
     Option("api.page_size", int, 100, "default list page size"),
+    Option("tracker.endpoint", str, "",
+           "anonymized usage-event publish URL ('' = off; restart required)"),
     Option("stats.backend", str, "memory",
            "operational metrics sink (restart required)",
            choices=("memory", "statsd", "noop")),

@@ -1466,6 +1466,51 @@ class RunRegistry:
             )
         return cur.rowcount > 0
 
+    # -- usage analytics (reference tracker/, served at /api/v1/analytics) -----
+    def usage_rollup(
+        self, days: int = 14, now: Optional[float] = None
+    ) -> Dict[str, Any]:
+        """Event counts per day + platform summary.  Counts come from the
+        activity feed, so the window is bounded by the activity retention
+        horizon (``logs.retention_days``, default 30)."""
+        now = now or time.time()
+        cutoff = now - days * 86400.0
+        conn = self._conn()
+        per_day: Dict[str, Dict[str, int]] = {}
+        for row in conn.execute(
+            """SELECT date(created_at, 'unixepoch') AS day, event_type,
+                      COUNT(*) AS n
+               FROM activity WHERE created_at >= ?
+               GROUP BY day, event_type ORDER BY day""",
+            (cutoff,),
+        ):
+            per_day.setdefault(row["day"], {})[row["event_type"]] = row["n"]
+        runs_by_kind = {
+            r["kind"]: r["n"]
+            for r in conn.execute(
+                "SELECT kind, COUNT(*) AS n FROM runs GROUP BY kind"
+            )
+        }
+        runs_by_status = {
+            r["status"]: r["n"]
+            for r in conn.execute(
+                "SELECT status, COUNT(*) AS n FROM runs GROUP BY status"
+            )
+        }
+        return {
+            "window_days": days,
+            "events_per_day": per_day,
+            "runs_by_kind": runs_by_kind,
+            "runs_by_status": runs_by_status,
+            "num_users": conn.execute("SELECT COUNT(*) FROM users").fetchone()[0],
+            "num_projects": conn.execute(
+                "SELECT COUNT(*) FROM projects"
+            ).fetchone()[0],
+            "num_devices": conn.execute(
+                "SELECT COUNT(*) FROM devices"
+            ).fetchone()[0],
+        }
+
     # -- CI (per-project trigger config) ---------------------------------------
     # Parity: the reference's CI app (``api/ci/`` + ``ci/service.py``) —
     # a per-project toggle holding the spec to run whenever NEW code

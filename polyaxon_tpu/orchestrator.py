@@ -101,6 +101,23 @@ class Orchestrator:
         self.auditor = Auditor(self.registry)
         self.executor = ExecutorHandlers(self.bus)
         self.auditor.subscribe(self.executor)
+        # Usage analytics (reference tracker/): per-event counters on the
+        # stats backend; external publish only when explicitly configured.
+        from polyaxon_tpu.tracker import CLUSTER_ID_KEY, Tracker
+
+        cluster_id = self.registry.get_option(CLUSTER_ID_KEY)
+        if not cluster_id:
+            import uuid as _uuid
+
+            cluster_id = _uuid.uuid4().hex
+            self.registry.set_option(CLUSTER_ID_KEY, cluster_id)
+        self.auditor.subscribe(
+            Tracker(
+                self.stats,
+                endpoint=conf.get("tracker.endpoint"),
+                cluster_id=cluster_id,
+            )
+        )
         import os as _os
 
         # Opt-in done/failed notifications (reference notifier/actions +

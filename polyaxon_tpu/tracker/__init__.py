@@ -1,0 +1,3 @@
+from polyaxon_tpu.tracker.service import CLUSTER_ID_KEY, Tracker, usage_rollup
+
+__all__ = ["CLUSTER_ID_KEY", "Tracker", "usage_rollup"]

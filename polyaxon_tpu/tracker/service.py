@@ -1,0 +1,94 @@
+"""Usage analytics: per-event counters + optional anonymized publish.
+
+Parity: the reference tracker app (``tracker/publish_tracker.py`` — a
+segment-style ``analytics.track`` of every platform event keyed by
+cluster id, write-key gated, errors swallowed).  TPU-native shape:
+
+- every audited event increments a ``usage.<event_type>`` counter on the
+  configured stats backend (statsd/memory) — zero-config operational
+  analytics;
+- an OPTIONAL external publish (``tracker.endpoint`` conf option,
+  default '' = off — telemetry is opt-in, the inverse of the
+  reference's default) POSTs ``{cluster, event, created_at}`` with the
+  actor and all entity payload STRIPPED, fire-and-forget off the bus
+  thread;
+- the operator surface is ``GET /api/v1/analytics``: event counts per
+  day from the activity feed plus a platform summary (runs by
+  kind/status, users, devices) — what the reference shipped to segment,
+  kept queryable in-house instead.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.request
+from typing import Any, Dict, Optional
+
+from polyaxon_tpu.events import Event
+
+logger = logging.getLogger(__name__)
+
+#: The options key holding the stable anonymous cluster id (minted once).
+CLUSTER_ID_KEY = "platform.cluster_id"
+
+
+class Tracker:
+    """Auditor subscriber: counts every event, optionally publishes it."""
+
+    def __init__(
+        self,
+        stats,
+        *,
+        endpoint: str = "",
+        cluster_id: str = "",
+    ) -> None:
+        self.stats = stats
+        self.endpoint = endpoint
+        self.cluster_id = cluster_id
+        #: Last publish thread (tests join it; None until a publish fires).
+        self._last_publish = None
+
+    def __call__(self, event: Event) -> None:
+        self.stats.incr(f"usage.{event.event_type}")
+        if not self.endpoint:
+            return
+        payload = {
+            # Anonymized on purpose (reference serialized with
+            # include_actor_name=False): event type + timing only, no
+            # entity payloads, no actors.
+            "cluster": self.cluster_id,
+            "event": event.event_type,
+            "created_at": event.created_at,
+        }
+
+        def _publish() -> None:
+            try:
+                req = urllib.request.Request(
+                    self.endpoint,
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=5)
+            except Exception:  # noqa: BLE001 — analytics must never break the platform
+                logger.debug("tracker publish failed", exc_info=True)
+
+        # Always a dedicated thread: audits fire from API handlers (the
+        # asyncio event loop) and the bus thread alike, and a slow
+        # analytics endpoint must stall neither.  (bus.offload only
+        # detaches when called FROM the bus thread — not enough here.)
+        import threading
+
+        t = threading.Thread(target=_publish, name="tracker-publish", daemon=True)
+        self._last_publish = t
+        t.start()
+
+
+def usage_rollup(
+    registry, days: int = 14, now: Optional[float] = None
+) -> Dict[str, Any]:
+    """Event counts per day + platform summary for ``/api/v1/analytics``
+    (schema knowledge lives with the registry; this is the tracker-facing
+    name)."""
+    return registry.usage_rollup(days=days, now=now)

@@ -1,0 +1,131 @@
+"""Usage analytics (reference tracker/ analogue)."""
+
+import json
+
+import pytest
+
+from polyaxon_tpu.events import Event
+from polyaxon_tpu.stats import MemoryStats
+from polyaxon_tpu.tracker import Tracker, usage_rollup
+
+
+class TestTracker:
+    def test_counts_events_on_stats_backend(self):
+        stats = MemoryStats()
+        t = Tracker(stats)
+        t(Event(event_type="experiment.created", context={"run_id": 1}))
+        t(Event(event_type="experiment.created", context={"run_id": 2}))
+        t(Event(event_type="experiment.done", context={"run_id": 1}))
+        assert stats.counters["usage.experiment.created"] == 2
+        assert stats.counters["usage.experiment.done"] == 1
+
+    def test_no_publish_without_endpoint(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(
+            "urllib.request.urlopen", lambda *a, **k: calls.append(a)
+        )
+        Tracker(MemoryStats())(Event(event_type="x.y", context={}))
+        assert calls == []
+
+    def test_publish_is_anonymized(self, monkeypatch):
+        sent = {}
+
+        def fake_urlopen(req, timeout=None):
+            sent["url"] = req.full_url
+            sent["body"] = json.loads(req.data)
+            class R:  # noqa: N801 — minimal stand-in
+                pass
+            return R()
+
+        monkeypatch.setattr("urllib.request.urlopen", fake_urlopen)
+        t = Tracker(
+            MemoryStats(), endpoint="http://analytics.example/t", cluster_id="abc"
+        )
+        t(
+            Event(
+                event_type="experiment.created",
+                context={"run_id": 7, "actor": "alice", "secret": "s"},
+            )
+        )
+        t._last_publish.join(timeout=5)  # publish rides its own thread
+        assert sent["url"] == "http://analytics.example/t"
+        assert sent["body"]["cluster"] == "abc"
+        assert sent["body"]["event"] == "experiment.created"
+        # No context payload, no actor — event type + timing only.
+        assert "actor" not in json.dumps(sent["body"])
+        assert "run_id" not in json.dumps(sent["body"])
+
+    def test_publish_errors_are_swallowed(self, monkeypatch):
+        def boom(*a, **k):
+            raise OSError("down")
+
+        monkeypatch.setattr("urllib.request.urlopen", boom)
+        t = Tracker(MemoryStats(), endpoint="http://x/", cluster_id="c")
+        t(Event(event_type="a.b", context={}))  # must not raise
+        t._last_publish.join(timeout=5)
+
+
+class TestUsageRollup:
+    def test_rollup_shapes(self, tmp_registry):
+        reg = tmp_registry
+        spec = {
+            "kind": "experiment",
+            "run": {"entrypoint": "noop:main"},
+            "environment": {
+                "topology": {"accelerator": "cpu", "num_devices": 1}
+            },
+        }
+        reg.create_run(dict(spec))
+        reg.create_run(dict(spec))
+        reg.record_activity("experiment.created", {"run_id": 1})
+        reg.record_activity("experiment.created", {"run_id": 2})
+        reg.record_activity("experiment.done", {"run_id": 1})
+        reg.register_device("s0", "cpu-1", 1)
+        out = usage_rollup(reg, days=7)
+        assert out["runs_by_kind"] == {"experiment": 2}
+        assert out["runs_by_status"] == {"created": 2}
+        assert out["num_devices"] == 1
+        day_counts = list(out["events_per_day"].values())
+        assert day_counts and day_counts[0]["experiment.created"] == 2
+        assert day_counts[0]["experiment.done"] == 1
+
+
+class TestAnalyticsAPI:
+    def test_admin_gated_endpoint(self, tmp_path):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from polyaxon_tpu.api.app import create_app
+        from polyaxon_tpu.orchestrator import Orchestrator
+
+        orch = Orchestrator(tmp_path / "plat", monitor_interval=0.05)
+        try:
+            async def body():
+                app = create_app(orch, auth_token="root-tok")
+                client = TestClient(TestServer(app))
+                await client.start_server()
+                try:
+                    resp = await client.get("/api/v1/analytics")
+                    assert resp.status == 401
+                    resp = await client.get(
+                        "/api/v1/analytics",
+                        headers={"Authorization": "Bearer root-tok"},
+                    )
+                    assert resp.status == 200
+                    data = await resp.json()
+                    assert "events_per_day" in data and "runs_by_kind" in data
+                    # Non-admin user: 403.
+                    _, token = orch.registry.create_user("bob")
+                    resp = await client.get(
+                        "/api/v1/analytics",
+                        headers={"Authorization": f"Bearer {token}"},
+                    )
+                    assert resp.status == 403
+                    return True
+                finally:
+                    await client.close()
+
+            assert asyncio.run(body())
+        finally:
+            orch.stop()
