@@ -195,6 +195,36 @@ def lm_server(ctx: Context) -> None:
     seq = int(ctx.get_param("seq", 512))
     cfg = TransformerConfig(max_seq=seq, **cfg_fields)
     params = init_params(jax.random.PRNGKey(ctx.seed or 0), cfg)
+
+    # Multi-chip serving: shard the weights over the gang's mesh per the
+    # topology's strategy (tp shards heads over the tensor axis; GSPMD
+    # propagates through the decode scan so the KV cache lands
+    # heads-sharded too). Single-device keeps plain jit.  SINGLE-PROCESS
+    # only: a sharded decode is a collective program every process must
+    # enter, but only the process that receives the HTTP request would —
+    # a multi-host sharded /generate would wedge in the collective.
+    # Multi-host service gangs therefore keep the pre-mesh behavior:
+    # each host serves an independent local replica.
+    mesh = ctx.mesh if ctx.num_processes == 1 else None
+    if ctx.num_processes > 1:
+        ctx.log_text(
+            "lm_server: multi-host gang — serving an independent replica "
+            "per host (sharded decode needs a single-process mesh)"
+        )
+    template = None
+    param_shardings = None
+    if mesh is not None and mesh.size > 1:
+        from polyaxon_tpu.models.decode import decode_param_shardings
+        from polyaxon_tpu.parallel import template_for
+
+        template = template_for(
+            ctx.strategy, dict(mesh.shape), ctx.strategy_options
+        )
+        param_shardings = decode_param_shardings(
+            cfg, mesh, template, params=params
+        )
+        params = jax.device_put(params, param_shardings)
+
     step = None
     target = ctx.get_param("target")
     if target is not None:
@@ -204,6 +234,9 @@ def lm_server(ctx: Context) -> None:
             target
         ) / "checkpoints"
         ckpt = CheckpointManager(ckpt_dir)
+        # The (possibly sharded) init params are the restore template —
+        # orbax restores each leaf onto its sharding, so a checkpoint
+        # written under a training mesh reshards onto the serving mesh.
         restored = ckpt.restore_params(params)
         ckpt.close()
         if restored is None:
@@ -227,7 +260,12 @@ def lm_server(ctx: Context) -> None:
     def get_fn(b, t, max_new, greedy):
         key = (b, t, max_new, greedy)
         if key not in compiled:
-            if greedy:
+            if template is not None:
+                fn, _ = decode.sharded_generate_fn(
+                    cfg, mesh, template, max_new_tokens=max_new,
+                    greedy=greedy, param_shardings=param_shardings,
+                )
+            elif greedy:
                 fn = jax.jit(
                     lambda p, prompt, k, temp: decode.generate(
                         p, prompt, cfg, max_new_tokens=max_new,

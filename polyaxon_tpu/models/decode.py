@@ -139,6 +139,95 @@ def prefill(
     return logits[:, -1], {"k": ck, "v": cv}
 
 
+def decode_param_shardings(
+    cfg: TransformerConfig, mesh, template, params: Optional[Any] = None
+):
+    """NamedShardings for the weights under a template's rules (what the
+    serving path places restored checkpoints with).
+
+    When ``params`` (or any same-shaped tree) is given, axes whose mesh
+    size doesn't divide the actual dimension fall back to replication —
+    e.g. a GQA model with ``n_kv_heads: 1`` under ``tp=2`` keeps its KV
+    projections replicated while the query-side weights still shard.
+    Serving must degrade to replication, not crash, for any model the
+    spec accepts."""
+    import math
+
+    from jax.sharding import PartitionSpec
+
+    from polyaxon_tpu.models.transformer import param_axes
+    from polyaxon_tpu.parallel.axes import tree_shardings, tree_specs
+
+    mesh_shape = dict(mesh.shape)
+    specs = tree_specs(param_axes(cfg), template.rules, mesh_shape)
+    if params is not None:
+        def _fit(spec, leaf):
+            names = tuple(spec) + (None,) * (leaf.ndim - len(tuple(spec)))
+            out = []
+            for dim, name in zip(leaf.shape, names):
+                if name is None:
+                    out.append(None)
+                    continue
+                axes = name if isinstance(name, (tuple, list)) else (name,)
+                total = math.prod(mesh_shape[a] for a in axes)
+                out.append(name if total and dim % total == 0 else None)
+            return PartitionSpec(*out)
+
+        specs = jax.tree.map(
+            _fit, specs, params,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+    return tree_shardings(mesh, specs)
+
+
+def sharded_generate_fn(
+    cfg: TransformerConfig,
+    mesh,
+    template,
+    *,
+    max_new_tokens: int,
+    greedy: bool = True,
+    params: Optional[Any] = None,
+    param_shardings: Optional[Any] = None,
+):
+    """(jitted fn, param_shardings) for MULTI-CHIP decode under a template.
+
+    TP-native serving: the template's rules shard every weight (heads on
+    the tensor axis under ``tp``), and GSPMD propagates those shardings
+    through the decode scan — the KV cache lands heads-sharded, each
+    chip attending over its own head group, with one collective per
+    token for the logit reduction.  The caller places restored params
+    with the returned shardings and invokes ``fn(params, prompt, key,
+    temperature)``; prompt/key/temperature replicate (decode batches are
+    small — sharding model weights, not the batch, is what scales).
+    Sharded-vs-single-device token parity is asserted in
+    ``tests/test_parallel/test_decode_sharded.py``.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    # Callers that already placed their weights pass the shardings in —
+    # recomputing the fitted tree per compiled shape would be waste.
+    param_sh = (
+        param_shardings
+        if param_shardings is not None
+        else decode_param_shardings(cfg, mesh, template, params=params)
+    )
+    repl = NamedSharding(mesh, PartitionSpec())
+
+    def _run(p, prompt, key, temp):
+        return generate(
+            p,
+            prompt,
+            cfg,
+            max_new_tokens=max_new_tokens,
+            temperature=0.0 if greedy else temp,
+            rng=key,
+        )
+
+    fn = jax.jit(_run, in_shardings=(param_sh, repl, repl, repl))
+    return fn, param_sh
+
+
 def generate(
     params: Dict[str, Any],
     prompt: jax.Array,

@@ -143,3 +143,52 @@ class TestInferenceService:
         orch.stop_run(svc.id)
         done = orch.wait(svc.id, timeout=30)
         assert done.status == S.STOPPED
+
+    def test_tensor_parallel_service(self, orch):
+        """Multi-chip serving: the service gang shards the model over a
+        tp mesh (heads on the tensor axis); the checkpoint-free random
+        init keeps it quick — the sharded-vs-single numerics live in
+        tests/test_parallel/test_decode_sharded.py."""
+        svc = orch.submit(
+            {
+                "kind": "service",
+                "declarations": {**MODEL, "seq": 64},
+                "environment": {
+                    "topology": {
+                        "accelerator": "cpu",
+                        "num_devices": 2,
+                        "num_hosts": 1,
+                        "mesh": {"tensor": 2},
+                        "strategy": "tp",
+                    }
+                },
+            },
+            name="lm-serve-tp",
+        )
+        health = None
+        for _ in range(600):
+            orch.pump(max_wait=0.1)
+            url = orch.get_run(svc.id).service_url
+            if not url:
+                continue
+            try:
+                with urllib.request.urlopen(f"{url}/healthz", timeout=0.3) as r:
+                    health = json.load(r)
+                    break
+            except OSError:
+                continue
+        assert health is not None, orch.registry.get_logs(svc.id)
+        url = orch.get_run(svc.id).service_url
+        req = urllib.request.Request(
+            f"{url}/generate",
+            data=json.dumps(
+                {"prompts": [[1, 2, 3, 4]], "max_new_tokens": 6}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.load(r)
+        assert len(out["tokens"]) == 1 and len(out["tokens"][0]) == 6
+        assert all(0 <= t < 64 for t in out["tokens"][0])
+        orch.stop_run(svc.id)
+        assert orch.wait(svc.id, timeout=30).status == S.STOPPED
