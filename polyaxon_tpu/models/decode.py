@@ -47,6 +47,64 @@ def init_cache(
     }
 
 
+#: Block matmul weights the int8 path quantizes — ONE list shared by
+#: quantize_weights (emit) and decode_step (consume) so they can't drift.
+#: Mapped to the contraction dims of each layout: [L,D,H,k] contracts D;
+#: [L,H,k,D] contracts H,k; [L,D,F] contracts D; [L,F,D] contracts F.
+QUANTIZED_BLOCK_WEIGHTS = {
+    "wq": (1,),
+    "wk": (1,),
+    "wv": (1,),
+    "wo": (1, 2),
+    "wi": (1,),
+    "wg": (1,),
+    "wd": (1,),
+}
+
+
+def quantize_weights(params: Dict[str, Any]) -> Dict[str, Any]:
+    """int8 weight-only quantization of the decode matmul weights.
+
+    Decode is weight-HBM-bandwidth-bound (the whole parameter set streams
+    per token while the MXU idles), so halving the bytes is ~linear
+    speedup: measured 469 → 711 tok/s (+51%) GQA-8 and 295 → 419 (+42%)
+    full-MHA on the 671M bench model (v5e); single-step fidelity: 2.4%
+    relative logits error, top-1 intact (docs/bench-notes.md).
+    Symmetric per-output-channel scales over each weight's CONTRACTION
+    dims; norms and the embedding table stay full precision (tiny, and
+    the gather is not a matmul).  Returns a tree of ``(int8_q,
+    f32_scale)`` pairs the decode path consumes via :func:`_wdq`;
+    training params are untouched — prefill still rides the
+    full-precision forward.
+    """
+    import numpy as np
+
+    def q(w, axes):
+        w = np.asarray(w, np.float32)
+        amax = np.max(np.abs(w), axis=axes, keepdims=True) + 1e-12
+        scale = (amax / 127.0).astype(np.float32)
+        qi = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+        return (jnp.asarray(qi), jnp.asarray(scale))
+
+    blk = params["block"]
+    out = {
+        name: q(blk[name], axes)
+        for name, axes in QUANTIZED_BLOCK_WEIGHTS.items()
+    }
+    out["unembed"] = q(params["unembed"], (0,))  # [D, V]: contract D
+    return out
+
+
+def _wdq(w, dtype):
+    """Weight as compute dtype: dequantize ``(int8, scale)`` pairs (XLA
+    fuses the convert+scale into the consuming matmul's operand read —
+    the HBM stream stays int8) or plain astype."""
+    if isinstance(w, tuple):
+        qi, scale = w
+        return qi.astype(dtype) * scale.astype(dtype)
+    return w.astype(dtype)
+
+
 def _attend_cached(q, ck, cv, pos, group):
     """One-token attention against the cache.
 
@@ -75,22 +133,22 @@ def _block_step(x, pos, layer, ck, cv, cfg: TransformerConfig):
     """
     c = cfg
     h = _rmsnorm(x, layer["attn_norm"])
-    q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(h.dtype))
-    k = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(h.dtype))
-    v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(h.dtype))
+    q = jnp.einsum("btd,dhk->bthk", h, _wdq(layer["wq"], h.dtype))
+    k = jnp.einsum("btd,dhk->bthk", h, _wdq(layer["wk"], h.dtype))
+    v = jnp.einsum("btd,dhk->bthk", h, _wdq(layer["wv"], h.dtype))
     positions = jnp.full((x.shape[0], 1), pos)
     q = _rope(q, positions, c.rope_theta)
     k = _rope(k, positions, c.rope_theta)
     ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
     cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
     attn = _attend_cached(q, ck, cv, pos, c.n_heads // c.kv_heads)
-    x = x + jnp.einsum("bthk,hkd->btd", attn, layer["wo"].astype(h.dtype))
+    x = x + jnp.einsum("bthk,hkd->btd", attn, _wdq(layer["wo"], h.dtype))
 
     h = _rmsnorm(x, layer["mlp_norm"])
-    up = jnp.einsum("btd,df->btf", h, layer["wi"].astype(h.dtype))
-    gate = jnp.einsum("btd,df->btf", h, layer["wg"].astype(h.dtype))
+    up = jnp.einsum("btd,df->btf", h, _wdq(layer["wi"], h.dtype))
+    gate = jnp.einsum("btd,df->btf", h, _wdq(layer["wg"], h.dtype))
     y = jax.nn.silu(gate) * up
-    x = x + jnp.einsum("btf,fd->btd", y, layer["wd"].astype(h.dtype))
+    x = x + jnp.einsum("btf,fd->btd", y, _wdq(layer["wd"], h.dtype))
     return x, ck, cv
 
 
@@ -100,10 +158,28 @@ def decode_step(
     token: jax.Array,
     pos: jax.Array,
     cfg: TransformerConfig,
+    qweights: Optional[Dict[str, Any]] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """token [B] at absolute ``pos`` → (logits [B, vocab], updated cache)."""
+    """token [B] at absolute ``pos`` → (logits [B, vocab], updated cache).
+
+    With ``qweights`` (from :func:`quantize_weights`) the matmul weights
+    stream int8 from HBM, dequantized inside each contraction."""
     c = cfg
     x = params["embed"].astype(c.dtype)[token][:, None, :]  # [B,1,D]
+
+    blk = params["block"]
+    if qweights is None:
+        layers = blk
+        unembed = params["unembed"]
+    else:
+        # Quantized (q, scale) pairs are ordinary pytree leaves-of-tuples:
+        # scan slices both halves per layer and _wdq sees the pair.
+        layers = {
+            "attn_norm": blk["attn_norm"],
+            "mlp_norm": blk["mlp_norm"],
+            **{k: qweights[k] for k in QUANTIZED_BLOCK_WEIGHTS},
+        }
+        unembed = qweights["unembed"]
 
     def layer_body(carry, inputs):
         x = carry
@@ -112,10 +188,10 @@ def decode_step(
         return x, (ck, cv)
 
     x, (new_ck, new_cv) = lax.scan(
-        layer_body, x, (params["block"], cache["k"], cache["v"])
+        layer_body, x, (layers, cache["k"], cache["v"])
     )
     x = _rmsnorm(x, params["final_norm"])
-    logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(x.dtype))
+    logits = jnp.einsum("btd,dv->btv", x, _wdq(unembed, x.dtype))
     return logits[:, 0].astype(jnp.float32), {"k": new_ck, "v": new_cv}
 
 
@@ -236,6 +312,7 @@ def generate(
     max_new_tokens: int,
     temperature: Any = 0.0,
     rng: Optional[jax.Array] = None,
+    qweights: Optional[Dict[str, Any]] = None,
 ) -> jax.Array:
     """prompt [B, T] → generated tokens [B, max_new_tokens].
 
@@ -243,7 +320,10 @@ def generate(
     ``temperature`` may be a traced array (a jitted caller can pass it as
     an argument rather than baking each value into a fresh compilation);
     a traced value always takes the sampling branch — greedy-vs-sampling
-    is the only Python-level fork.  The whole decode loop is one
+    is the only Python-level fork.  ``qweights`` (precompute once with
+    :func:`quantize_weights`) switches the per-token loop to int8 weight
+    streaming (+51% measured); prefill stays full-precision — it is
+    MXU-bound, not bandwidth-bound.  The whole decode loop is one
     ``lax.scan`` of compiled one-token steps — no host round-trips
     between tokens.
     """
@@ -272,7 +352,9 @@ def generate(
         logits, cache, key = carry
         key, sub = jax.random.split(key)
         token = pick(logits, sub)
-        logits, cache = decode_step(params, cache, token, T + i, cfg)
+        logits, cache = decode_step(
+            params, cache, token, T + i, cfg, qweights=qweights
+        )
         return (logits, cache, key), token
 
     # N-1 scanned steps; the final token needs only a pick, not another

@@ -97,3 +97,33 @@ class TestDecodeMatchesForward:
         prompt = jnp.zeros((1, 30), jnp.int32)
         with pytest.raises(ValueError, match="max_seq"):
             decode.generate(params, prompt, CFG, max_new_tokens=10)
+
+    def test_int8_quantized_decode(self):
+        """Weight-only int8: same cache/prefix, one step — the quantized
+        logits must stay close and the top-1 token must match (the full
+        throughput + fidelity measurement lives in docs/bench-notes.md)."""
+        params = init_params(KEY, CFG)
+        rng = np.random.default_rng(3)
+        prompt = jnp.asarray(rng.integers(0, CFG.vocab_size, (2, 12)))
+        qweights = decode.quantize_weights(params)
+        # Quantized tree really is int8.
+        assert qweights["wq"][0].dtype == jnp.int8
+        cache = decode.init_cache(CFG, 2, 16)
+        logits, cache = decode.prefill(params, prompt, cache, CFG)
+        tok = jnp.argmax(logits, axis=-1)
+        lf, _ = decode.decode_step(params, cache, tok, 12, CFG)
+        lq, _ = decode.decode_step(params, cache, tok, 12, CFG, qweights=qweights)
+        lf, lq = np.asarray(lf), np.asarray(lq)
+        rel = np.abs(lf - lq).max() / (np.abs(lf).max() + 1e-9)
+        assert rel < 0.05, rel
+        np.testing.assert_array_equal(lf.argmax(-1), lq.argmax(-1))
+
+    def test_int8_generate_runs_end_to_end(self):
+        params = init_params(KEY, CFG)
+        qweights = decode.quantize_weights(params)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        out = decode.generate(
+            params, prompt, CFG, max_new_tokens=8, qweights=qweights
+        )
+        assert out.shape == (1, 8)
+        assert int(out.min()) >= 0 and int(out.max()) < CFG.vocab_size
