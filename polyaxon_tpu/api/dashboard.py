@@ -84,6 +84,8 @@ DASHBOARD_HTML = """<!doctype html>
   <a id="tab-projects" onclick="showTab('projects')">Projects</a>
   <a id="tab-searches" onclick="showTab('searches')">Searches</a>
   <a id="tab-activity" onclick="showTab('activity')">Activity</a>
+  <a id="tab-archives" onclick="showTab('archives')">Archives</a>
+  <a id="tab-analytics" onclick="showTab('analytics')">Analytics</a>
 </nav>
 
 <div id="view-runs">
@@ -175,6 +177,28 @@ DASHBOARD_HTML = """<!doctype html>
   </table>
 </div>
 
+<div id="view-archives" style="display:none">
+  <table>
+    <thead><tr><th>ID</th><th>Kind</th><th>Name</th><th>Project</th>
+    <th>Status</th><th>Archived</th><th></th></tr></thead>
+    <tbody id="archives"></tbody>
+  </table>
+</div>
+
+<div id="view-analytics" style="display:none">
+  <div class="panel">
+    <h2>Platform summary</h2>
+    <div id="analytics-summary"></div>
+  </div>
+  <div class="panel">
+    <h2>Events per day (14d)</h2>
+    <table><thead id="analytics-head"></thead><tbody id="analytics-rows"></tbody></table>
+  </div>
+  <div id="analytics-denied" class="dim" style="display:none">
+    analytics are admin-only
+  </div>
+</div>
+
 <script>
 let selected = null;
 let selectedKind = null;
@@ -203,7 +227,8 @@ function saveToken(ev) {
 
 function showTab(name) {
   tab = name;
-  for (const t of ['runs','compare','devices','projects','searches','activity']) {
+  for (const t of ['runs','compare','devices','projects','searches',
+                   'activity','archives','analytics']) {
     document.getElementById('view-'+t).style.display = t===name?'block':'none';
     document.getElementById('tab-'+t).className = t===name?'active':'';
   }
@@ -216,6 +241,8 @@ async function refresh() {
   const t = tab;
   if (t === 'runs') return refreshRuns();
   if (t === 'compare') return refreshCompare();
+  if (t === 'archives') return refreshArchives();
+  if (t === 'analytics') return refreshAnalytics();
   const resp = await apiFetch('/api/v1/' + (t === 'activity' ? 'activities' : t));
   if (!resp.ok) return authNote(resp);
   if (t !== tab) return;
@@ -245,6 +272,72 @@ async function refresh() {
       <td>${esc(a.context.actor||'')}</td>
       <td class="dim">${esc(Object.entries(a.context).filter(([k])=>k!=='actor')
         .map(([k,v])=>k+'='+v).join(' '))}</td></tr>`).join('');
+}
+
+async function refreshArchives() {
+  const resp = await apiFetch('/api/v1/archives');
+  if (!resp.ok) return authNote(resp);
+  if (tab !== 'archives') return;
+  const data = (await resp.json()).results;
+  document.getElementById('archives').innerHTML = data.map(r => `
+    <tr><td>${Number(r.id)}</td><td>${esc(r.kind)}</td><td>${esc(r.name||'')}</td>
+    <td>${esc(r.project)}</td>
+    <td><span class="chip ${esc(r.status)}">${esc(r.status)}</span></td>
+    <td class="dim">${new Date(r.archived_at*1000).toLocaleString()}</td>
+    <td>
+      <button onclick="archiveAction(${Number(r.id)}, 'restore')">restore</button>
+      <button onclick="archiveAction(${Number(r.id)}, 'delete')">delete</button>
+    </td></tr>`).join('')
+    || '<tr><td class="dim" colspan="7">nothing archived</td></tr>';
+}
+
+async function archiveAction(id, action) {
+  let resp;
+  if (action === 'delete') {
+    // Deletion purges rows, outputs, and store artifacts — unrecoverable.
+    if (!confirm(`Permanently delete run #${id} and all its data?`)) return;
+    resp = await apiFetch(`/api/v1/runs/${id}`, {method: 'DELETE'});
+  } else {
+    resp = await apiFetch(`/api/v1/runs/${id}/restore`, {method: 'POST'});
+  }
+  if (!resp.ok) {
+    const err = await resp.json().catch(() => ({}));
+    alert(`${action} failed: ${err.error || resp.status}`);
+  }
+  refreshArchives();
+}
+
+async function refreshAnalytics() {
+  const resp = await apiFetch('/api/v1/analytics');
+  const denied = document.getElementById('analytics-denied');
+  if (resp.status === 403) {
+    // Clear any aggregates a previously-authorized token rendered.
+    document.getElementById('analytics-summary').innerHTML = '';
+    document.getElementById('analytics-head').innerHTML = '';
+    document.getElementById('analytics-rows').innerHTML = '';
+    denied.style.display = 'block';
+    return;
+  }
+  if (!resp.ok) return authNote(resp);
+  denied.style.display = 'none';
+  if (tab !== 'analytics') return;
+  const d = await resp.json();
+  const summary = [
+    ...Object.entries(d.runs_by_kind).map(([k,v]) => `${esc(k)} runs: <b>${Number(v)}</b>`),
+    `users: <b>${Number(d.num_users)}</b>`,
+    `projects: <b>${Number(d.num_projects)}</b>`,
+    `devices: <b>${Number(d.num_devices)}</b>`,
+  ];
+  document.getElementById('analytics-summary').innerHTML =
+    summary.join(' &nbsp;·&nbsp; ');
+  const days = Object.keys(d.events_per_day).sort();
+  const types = [...new Set(days.flatMap(day => Object.keys(d.events_per_day[day])))].sort();
+  document.getElementById('analytics-head').innerHTML =
+    `<tr><th>Day</th>${types.map(t=>`<th>${esc(t)}</th>`).join('')}</tr>`;
+  document.getElementById('analytics-rows').innerHTML = days.map(day => `
+    <tr><td class="dim">${esc(day)}</td>
+    ${types.map(t => `<td>${Number(d.events_per_day[day][t]||0)||''}</td>`).join('')}
+    </tr>`).join('') || '<tr><td class="dim">no activity yet</td></tr>';
 }
 
 function authNote(resp) {
