@@ -307,7 +307,13 @@ async function archiveAction(id, action) {
   refreshArchives();
 }
 
+let analyticsLast = 0;
 async function refreshAnalytics() {
+  // Day-granularity data polled by the global 2s loop: throttle to 30s
+  // (same cadence as the query vocabulary) — and don't re-issue a 403
+  // every tick for non-admins.
+  if (Date.now() - analyticsLast < 30000) return;
+  analyticsLast = Date.now();
   const resp = await apiFetch('/api/v1/analytics');
   const denied = document.getElementById('analytics-denied');
   if (resp.status === 403) {
