@@ -203,9 +203,15 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             and request.get("role") != "admin"
         )
         # ?archived=true → archived only; ?archived=all → both; default =
-        # live runs only (the reference's default model manager).
+        # live runs only (the reference's default model manager).  A query
+        # that filters on `archived:` itself takes over — stacking the
+        # default exclusion under it would contradict the user's filter.
         archived_q = (q.get("archived") or "").lower()
         archived = {"true": True, "1": True, "all": None}.get(archived_q, False)
+        from polyaxon_tpu.query import filters_archived
+
+        if filters_archived(conds):
+            archived = None
         runs = reg.list_runs(
             kind=q.get("kind"),
             project=q.get("project"),
@@ -633,12 +639,17 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 text=json.dumps({"error": "no such search"}),
                 content_type="application/json",
             )
-        clauses, params, residual = compile_to_sql(parse_query(search["query"]))
+        from polyaxon_tpu.query import filters_archived
+
+        search_conds = parse_query(search["query"])
+        clauses, params, residual = compile_to_sql(search_conds)
         limit = _int_param(request, "limit", 100)
         runs = reg.list_runs(
             extra_where=(clauses, params) if clauses else None,
             limit=None if residual else limit,
-            archived=False,
+            # A search over `archived:` owns that dimension; otherwise
+            # the live-only default applies.
+            archived=None if filters_archived(search_conds) else False,
         )
         if residual:
             runs = apply_query(runs, conditions=residual)[:limit]
@@ -797,7 +808,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         )
         return web.json_response(
             {
-                "fields": sorted(_FIELDS) + ["tags"],
+                "fields": sorted(_FIELDS) + ["archived", "tags"],
                 "metric_keys": sorted(metric_keys),
                 "param_keys": sorted(param_keys),
                 "statuses": statuses,

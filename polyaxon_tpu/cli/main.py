@@ -233,15 +233,18 @@ class LocalClient:
         return self._to_dict(run)
 
     def list(self, **query):
+        from polyaxon_tpu.query import apply_query, filters_archived, parse_query
+
+        conds = parse_query(query.get("q"))
         runs = self.orch.registry.list_runs(
             project=query.get("project"),
             kind=query.get("kind"),
-            archived=False,
+            # A query on `archived:` owns that dimension (else its clause
+            # would contradict the live-only default and match nothing).
+            archived=None if filters_archived(conds) else False,
         )
-        if query.get("q"):
-            from polyaxon_tpu.query import apply_query
-
-            runs = apply_query(runs, query["q"])
+        if conds:
+            runs = apply_query(runs, conditions=conds)
         return [self._to_dict(r) for r in runs[: int(query.get("limit") or 100)]]
 
     def get(self, run_id):
@@ -346,10 +349,14 @@ class LocalClient:
         search = self.orch.registry.get_search(name)
         if search is None:
             raise SystemExit(f"no search named {name!r}")
-        from polyaxon_tpu.query import apply_query
+        from polyaxon_tpu.query import apply_query, filters_archived, parse_query
 
+        conds = parse_query(search["query"])
         runs = apply_query(
-            self.orch.registry.list_runs(archived=False), search["query"]
+            self.orch.registry.list_runs(
+                archived=None if filters_archived(conds) else False
+            ),
+            conditions=conds,
         )
         return [self._to_dict(r) for r in runs]
 

@@ -1,4 +1,4 @@
-from polyaxon_tpu.query.builder import apply_query, compile_to_sql
+from polyaxon_tpu.query.builder import apply_query, compile_to_sql, filters_archived
 from polyaxon_tpu.query.parser import Condition, QueryError, parse_query
 
-__all__ = ["Condition", "QueryError", "apply_query", "compile_to_sql", "parse_query"]
+__all__ = ["Condition", "QueryError", "apply_query", "compile_to_sql", "filters_archived", "parse_query"]

@@ -22,7 +22,19 @@ _FIELDS = {
 }
 
 
+def _validate_archived(cond: Condition) -> None:
+    """`archived:` is a derived boolean; both query paths (SQL pushdown
+    and in-process) must reject the same malformed shapes — a condition
+    that 400s on one surface must not silently 'work' on another."""
+    if cond.op != "eq" or not isinstance(cond.value, bool):
+        raise QueryError("archived expects true or false")
+
+
 def _resolve(run: Run, field: str) -> Any:
+    if field == "archived":
+        # Derived boolean over archived_at — `archived:true` surfaces the
+        # reference's archived-manager split inside the query DSL.
+        return run.archived_at is not None
     if field in _FIELDS:
         return getattr(run, field)
     if field.startswith("metric."):
@@ -32,12 +44,15 @@ def _resolve(run: Run, field: str) -> Any:
     if field == "tags":
         return run.tags
     raise QueryError(
-        f"Unknown query field {field!r} (plain fields: {sorted(_FIELDS)}; "
-        "JSON fields: metric.<name>, declarations.<name>, tags)"
+        f"Unknown query field {field!r} (plain fields: "
+        f"{sorted(_FIELDS) + ['archived']}; JSON fields: metric.<name>, "
+        "declarations.<name>, tags)"
     )
 
 
 def _matches(run: Run, cond: Condition) -> bool:
+    if cond.field == "archived":
+        _validate_archived(cond)
     actual = _resolve(run, cond.field)
     if cond.field == "tags":
         values = cond.value if isinstance(cond.value, list) else [cond.value]
@@ -67,6 +82,14 @@ def _matches(run: Run, cond: Condition) -> bool:
     return not result if cond.negated else result
 
 
+def filters_archived(conditions: Sequence[Condition]) -> bool:
+    """Does this query take over the archived dimension?  Listing
+    surfaces default to live-only (``list_runs(archived=False)``); a
+    query filtering on ``archived:`` must see BOTH populations or its
+    clause contradicts the default and silently returns nothing."""
+    return any(c.field == "archived" for c in conditions)
+
+
 def apply_query(
     runs: Iterable[Run], query: Optional[str] = None, conditions: Optional[Sequence[Condition]] = None
 ) -> List[Run]:
@@ -90,6 +113,14 @@ def compile_to_sql(
     params: List[Any] = []
     residual: List[Condition] = []
     for cond in conditions:
+        if cond.field == "archived":
+            # Derived boolean: pushes down as a NULL check on archived_at.
+            _validate_archived(cond)
+            want = cond.value != cond.negated
+            clauses.append(
+                "archived_at IS NOT NULL" if want else "archived_at IS NULL"
+            )
+            continue
         if cond.field not in _FIELDS:
             if not (
                 cond.field.startswith(("metric.", "declarations.", "params."))

@@ -90,6 +90,30 @@ class TestArchive:
         assert [r.id for r in due] == [old.id]
 
 
+class TestArchivedQueryField:
+    def test_dsl_filters_archived_both_ways(self, reg):
+        live = _finished(reg)
+        gone = _finished(reg)
+        reg.archive_run(gone.id)
+        from polyaxon_tpu.query import apply_query, compile_to_sql, parse_query
+
+        runs = reg.list_runs()
+        assert [r.id for r in apply_query(runs, "archived:true")] == [gone.id]
+        assert [r.id for r in apply_query(runs, "archived:false")] == [live.id]
+        # SQL pushdown form too.
+        clauses, params, residual = compile_to_sql(parse_query("archived:true"))
+        assert residual == [] and params == []
+        assert [r.id for r in reg.list_runs(extra_where=(clauses, params))] == [
+            gone.id
+        ]
+
+    def test_non_boolean_archived_rejected(self, reg):
+        from polyaxon_tpu.query import QueryError, compile_to_sql, parse_query
+
+        with pytest.raises(QueryError):
+            compile_to_sql(parse_query("archived:>1"))
+
+
 class TestDelete:
     def test_delete_purges_all_rows(self, reg):
         run = _finished(reg)
