@@ -185,6 +185,7 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             QueryError,
             apply_query,
             compile_to_sql,
+            filters_archived,
             parse_query,
         )
 
@@ -208,8 +209,6 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         # default exclusion under it would contradict the user's filter.
         archived_q = (q.get("archived") or "").lower()
         archived = {"true": True, "1": True, "all": None}.get(archived_q, False)
-        from polyaxon_tpu.query import filters_archived
-
         if filters_archived(conds):
             archived = None
         runs = reg.list_runs(
@@ -764,6 +763,23 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 content_type="application/json",
             )
         return web.json_response({"ok": True})
+
+    # -- versions (reference api/versions/: cli/platform compatibility) -------
+    @routes.get(f"{API_PREFIX}/version")
+    async def version(request):
+        from polyaxon_tpu.version import __version__
+
+        import jax as _jax
+
+        return web.json_response(
+            {
+                "platform": __version__,
+                # Clients older than this may speak an incompatible spec
+                # dialect (the reference's min/latest CLI gate).
+                "min_cli": "0.1.0",
+                "jax": _jax.__version__,
+            }
+        )
 
     # -- usage analytics (reference tracker/, kept in-house) -------------------
     @routes.get(f"{API_PREFIX}/analytics")

@@ -552,6 +552,11 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="polyaxon-tpu", description="TPU-native experiment platform CLI"
     )
+    from polyaxon_tpu.version import __version__
+
+    parser.add_argument(
+        "--version", action="version", version=f"polyaxon-tpu {__version__}"
+    )
     parser.add_argument("--host", help="API server address (remote mode)")
     parser.add_argument(
         "--token", help="API bearer token (or POLYAXON_TPU_AUTH_TOKEN)"

@@ -51,8 +51,6 @@ def _resolve(run: Run, field: str) -> Any:
 
 
 def _matches(run: Run, cond: Condition) -> bool:
-    if cond.field == "archived":
-        _validate_archived(cond)
     actual = _resolve(run, cond.field)
     if cond.field == "tags":
         values = cond.value if isinstance(cond.value, list) else [cond.value]
@@ -95,6 +93,12 @@ def apply_query(
 ) -> List[Run]:
     """Filter runs by a query string (AND of all its conditions)."""
     conds = list(conditions or []) or parse_query(query)
+    # Validate ONCE up front, not per-run: a malformed condition must
+    # error identically on an empty result set and a full one (and match
+    # compile_to_sql's validation exactly).
+    for c in conds:
+        if c.field == "archived":
+            _validate_archived(c)
     return [r for r in runs if all(_matches(r, c) for c in conds)]
 
 
@@ -130,8 +134,8 @@ def compile_to_sql(
                 # fields must 400, not silently match everything.
                 raise QueryError(
                     f"Unknown query field {cond.field!r} (plain fields: "
-                    f"{sorted(_FIELDS)}; JSON fields: metric.<name>, "
-                    "declarations.<name>, tags)"
+                    f"{sorted(_FIELDS) + ['archived']}; JSON fields: "
+                    "metric.<name>, declarations.<name>, tags)"
                 )
             residual.append(cond)
             continue

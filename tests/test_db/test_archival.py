@@ -108,10 +108,19 @@ class TestArchivedQueryField:
         ]
 
     def test_non_boolean_archived_rejected(self, reg):
-        from polyaxon_tpu.query import QueryError, compile_to_sql, parse_query
+        from polyaxon_tpu.query import (
+            QueryError,
+            apply_query,
+            compile_to_sql,
+            parse_query,
+        )
 
         with pytest.raises(QueryError):
             compile_to_sql(parse_query("archived:>1"))
+        # The in-process path rejects identically — even on an EMPTY run
+        # list (validation is once-up-front, not per-run).
+        with pytest.raises(QueryError):
+            apply_query([], "archived:>1")
 
 
 class TestDelete:
