@@ -215,6 +215,50 @@ def prefill(
     return logits[:, -1], {"k": ck, "v": cv}
 
 
+def _fit_spec(spec, leaf, mesh_shape):
+    """Drop sharding on axes whose mesh size doesn't divide the leaf's
+    actual dimension (shape-aware replication fallback)."""
+    import math
+
+    from jax.sharding import PartitionSpec
+
+    names = tuple(spec) + (None,) * (leaf.ndim - len(tuple(spec)))
+    out = []
+    for dim, name in zip(leaf.shape, names):
+        if name is None:
+            out.append(None)
+            continue
+        axes = name if isinstance(name, (tuple, list)) else (name,)
+        total = math.prod(mesh_shape[a] for a in axes)
+        out.append(name if total and dim % total == 0 else None)
+    return PartitionSpec(*out)
+
+
+def quantized_weight_shardings(cfg: TransformerConfig, mesh, template, qweights):
+    """NamedShardings for a :func:`quantize_weights` tree: each ``(q,
+    scale)`` pair inherits its source weight's logical axes — the int8
+    tensor shards exactly like the full-precision weight it replaced,
+    and the keepdims-1 scale dims fall back to replication via the
+    shape-aware fit.  This is what lets int8 and tensor-parallel serving
+    COMPOSE: every chip streams only its head-shard's int8 bytes."""
+    from polyaxon_tpu.models.transformer import param_axes
+    from polyaxon_tpu.parallel.axes import tree_shardings, tree_specs
+
+    mesh_shape = dict(mesh.shape)
+    axes = param_axes(cfg)
+    name_axes = {k: axes["block"][k] for k in QUANTIZED_BLOCK_WEIGHTS}
+    name_axes["unembed"] = axes["unembed"]
+    base_specs = tree_specs(name_axes, template.rules, mesh_shape)
+    fitted = {
+        name: tuple(
+            _fit_spec(base_specs[name], leaf, mesh_shape)
+            for leaf in qweights[name]
+        )
+        for name in qweights
+    }
+    return tree_shardings(mesh, fitted)
+
+
 def decode_param_shardings(
     cfg: TransformerConfig, mesh, template, params: Optional[Any] = None
 ):
@@ -227,8 +271,6 @@ def decode_param_shardings(
     projections replicated while the query-side weights still shard.
     Serving must degrade to replication, not crash, for any model the
     spec accepts."""
-    import math
-
     from jax.sharding import PartitionSpec
 
     from polyaxon_tpu.models.transformer import param_axes
@@ -237,20 +279,10 @@ def decode_param_shardings(
     mesh_shape = dict(mesh.shape)
     specs = tree_specs(param_axes(cfg), template.rules, mesh_shape)
     if params is not None:
-        def _fit(spec, leaf):
-            names = tuple(spec) + (None,) * (leaf.ndim - len(tuple(spec)))
-            out = []
-            for dim, name in zip(leaf.shape, names):
-                if name is None:
-                    out.append(None)
-                    continue
-                axes = name if isinstance(name, (tuple, list)) else (name,)
-                total = math.prod(mesh_shape[a] for a in axes)
-                out.append(name if total and dim % total == 0 else None)
-            return PartitionSpec(*out)
-
         specs = jax.tree.map(
-            _fit, specs, params,
+            lambda spec, leaf: _fit_spec(spec, leaf, mesh_shape),
+            specs,
+            params,
             is_leaf=lambda x: isinstance(x, PartitionSpec),
         )
     return tree_shardings(mesh, specs)
@@ -265,6 +297,7 @@ def sharded_generate_fn(
     greedy: bool = True,
     params: Optional[Any] = None,
     param_shardings: Optional[Any] = None,
+    qweights_shardings: Optional[Any] = None,
 ):
     """(jitted fn, param_shardings) for MULTI-CHIP decode under a template.
 
@@ -274,8 +307,11 @@ def sharded_generate_fn(
     chip attending over its own head group, with one collective per
     token for the logit reduction.  The caller places restored params
     with the returned shardings and invokes ``fn(params, prompt, key,
-    temperature)``; prompt/key/temperature replicate (decode batches are
-    small — sharding model weights, not the batch, is what scales).
+    temperature, qweights)``; prompt/key/temperature replicate (decode
+    batches are small — sharding model weights, not the batch, is what
+    scales).  ``qweights_shardings`` (from
+    :func:`quantized_weight_shardings`) composes int8 with the sharding:
+    pass the placed quantized tree as the 5th argument, or None.
     Sharded-vs-single-device token parity is asserted in
     ``tests/test_parallel/test_decode_sharded.py``.
     """
@@ -290,7 +326,7 @@ def sharded_generate_fn(
     )
     repl = NamedSharding(mesh, PartitionSpec())
 
-    def _run(p, prompt, key, temp):
+    def _run(p, prompt, key, temp, qw):
         return generate(
             p,
             prompt,
@@ -298,9 +334,13 @@ def sharded_generate_fn(
             max_new_tokens=max_new_tokens,
             temperature=0.0 if greedy else temp,
             rng=key,
+            qweights=qw,
         )
 
-    fn = jax.jit(_run, in_shardings=(param_sh, repl, repl, repl))
+    fn = jax.jit(
+        _run,
+        in_shardings=(param_sh, repl, repl, repl, qweights_shardings),
+    )
     return fn, param_sh
 
 

@@ -52,7 +52,7 @@ class TestShardedDecode:
         )
         placed = jax.device_put(params, param_sh)
         out = np.asarray(
-            fn(placed, prompt, jax.random.PRNGKey(0), jnp.float32(0.0))
+            fn(placed, prompt, jax.random.PRNGKey(0), jnp.float32(0.0), None)
         )
         np.testing.assert_array_equal(out, ref)
 
@@ -86,6 +86,45 @@ class TestShardedDecode:
                 prompt,
                 jax.random.PRNGKey(0),
                 jnp.float32(0.0),
+                None,
+            )
+        )
+        np.testing.assert_array_equal(out, ref)
+
+    def test_int8_composes_with_tp(self):
+        """int8 weight streaming under tensor parallelism: the (q, scale)
+        pairs shard like the weights they replaced, and the sharded+
+        quantized tokens equal the single-device quantized tokens."""
+        from polyaxon_tpu.models.decode import (
+            quantize_weights,
+            quantized_weight_shardings,
+        )
+
+        params = init_params(jax.random.PRNGKey(3), CFG)
+        qweights = quantize_weights(params)
+        prompt = jnp.asarray(
+            np.random.default_rng(3).integers(0, CFG.vocab_size, (1, 8))
+        )
+        ref = np.asarray(
+            generate(params, prompt, CFG, max_new_tokens=10, qweights=qweights)
+        )
+        mesh_axes = {"tensor": jax.local_device_count()}
+        mesh = build_mesh(mesh_axes)
+        template = template_for("tp", mesh_axes)
+        qsh = quantized_weight_shardings(CFG, mesh, template, qweights)
+        # The int8 tensor shards on the heads/tensor axis like its source.
+        assert "tensor" in str(qsh["wq"][0].spec)
+        fn, param_sh = sharded_generate_fn(
+            CFG, mesh, template, max_new_tokens=10, params=params,
+            qweights_shardings=qsh,
+        )
+        out = np.asarray(
+            fn(
+                jax.device_put(params, param_sh),
+                prompt,
+                jax.random.PRNGKey(0),
+                jnp.float32(0.0),
+                jax.device_put(qweights, qsh),
             )
         )
         np.testing.assert_array_equal(out, ref)
@@ -104,6 +143,6 @@ class TestShardedDecode:
         fn, param_sh = sharded_generate_fn(cfg, mesh, template, max_new_tokens=12)
         placed = jax.device_put(params, param_sh)
         out = np.asarray(
-            fn(placed, prompt, jax.random.PRNGKey(0), jnp.float32(0.0))
+            fn(placed, prompt, jax.random.PRNGKey(0), jnp.float32(0.0), None)
         )
         np.testing.assert_array_equal(out, ref)
