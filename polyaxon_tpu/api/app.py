@@ -836,15 +836,13 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
     async def _ws_tail(request, fetch, poll: float = 0.5):
         """Generic WS tail loop: push new rows until the run is done."""
         run = _run_or_404(request)
-        # Echo whatever subprotocol the client offered (browsers abort the
-        # handshake if the server doesn't select one they requested — the
-        # bearer.<token> auth subprotocol rides this).
-        offered = tuple(
-            p.strip()
-            for p in request.headers.get("Sec-WebSocket-Protocol", "").split(",")
-            if p.strip()
-        )
-        ws = web.WebSocketResponse(heartbeat=30, protocols=offered)
+        # Select ONLY the fixed ``bearer`` name (browsers abort the
+        # handshake if the server selects none of the offered protocols,
+        # so the dashboard offers ['bearer', 'bearer.<token>']).  Echoing
+        # the client's full offer would reflect the bearer.<token> auth
+        # subprotocol — the secret — into the Sec-WebSocket-Protocol
+        # RESPONSE header, where proxies and devtools log it.
+        ws = web.WebSocketResponse(heartbeat=30, protocols=("bearer",))
         await ws.prepare(request)
         cursor = 0
         try:

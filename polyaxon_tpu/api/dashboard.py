@@ -418,7 +418,10 @@ function openLogStream(id) {
   state.textContent = 'connecting…';
   const proto = location.protocol === 'https:' ? 'wss://' : 'ws://';
   const url = `${proto}${location.host}/ws/v1/runs/${id}/logs`;
-  const ws = TOKEN ? new WebSocket(url, ['bearer.' + TOKEN]) : new WebSocket(url);
+  // Offer the fixed 'bearer' name alongside the token-bearing one: the
+  // server selects only 'bearer', so the token never appears in the
+  // handshake RESPONSE headers.
+  const ws = TOKEN ? new WebSocket(url, ['bearer', 'bearer.' + TOKEN]) : new WebSocket(url);
   logSocket = ws;
   ws.onopen = () => { state.textContent = 'live'; };
   ws.onmessage = ev => {

@@ -155,34 +155,119 @@ def jupyter(ctx: Context) -> None:
         raise RuntimeError(f"jupyter exited {rc}")
 
 
+def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
+    """HTTP handler class over a :class:`ServingEngine` (factored out of
+    ``lm_server`` so tests can drive the exact production handler against
+    a bare engine, no platform Context required)."""
+    import json as json_mod
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route into run logs, not stderr
+            log("lm_server: " + fmt % args)
+
+        def _json(self, code, payload):
+            body = json_mod.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/v1/stats":
+                return self._json(200, engine.stats())
+            if self.path not in ("/healthz", "/"):
+                return self._json(404, {"error": "not found"})
+            stats = engine.stats()
+            self._json(
+                200,
+                {
+                    "ok": True,
+                    "model": {
+                        "n_params": cfg.n_params,
+                        "vocab_size": cfg.vocab_size,
+                        "max_seq": cfg.max_seq,
+                        "n_kv_heads": cfg.kv_heads,
+                    },
+                    "engine": {
+                        "slots": stats["slots"],
+                        "slots_active": stats["slots_active"],
+                        "queue_depth": stats["queue_depth"],
+                    },
+                    **meta,
+                },
+            )
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._json(404, {"error": "not found"})
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json_mod.loads(self.rfile.read(n) or b"{}")
+                prompts = req["prompts"]
+                max_new = int(
+                    req.get("max_new_tokens", meta.get("default_max_new", 64))
+                )
+                temperature = float(req.get("temperature", 0.0))
+                if not prompts or not isinstance(prompts[0], list):
+                    raise ValueError("prompts must be a list of id lists")
+                # Mixed lengths are fine now — each prompt is its own
+                # request; the engine batches them at the decode-step
+                # level.  Validation happens in submit() per prompt.
+                t0 = time.time()
+                reqs = [
+                    engine.submit(p, max_new, temperature) for p in prompts
+                ]
+            except (KeyError, ValueError, TypeError) as e:
+                return self._json(400, {"error": str(e)})
+            try:
+                tokens = [r.wait(timeout=600) for r in reqs]
+            except (RuntimeError, TimeoutError) as e:
+                return self._json(503, {"error": str(e)})
+            dt = time.time() - t0
+            total = sum(len(t) for t in tokens)
+            self._json(
+                200,
+                {
+                    "tokens": tokens,
+                    "decode_tokens_per_s": round(total / max(dt, 1e-9), 1),
+                },
+            )
+
+    return Handler
+
+
 def lm_server(ctx: Context) -> None:
     """LM inference endpoint: the default ``kind: service`` entrypoint.
 
-    Serves autoregressive generation from a trained checkpoint over REST —
-    the platform's serving story (the reference has none; its closest
-    surfaces are the notebook/tensorboard plugin deployments).  Routes:
+    A CONTINUOUS-BATCHING server (polyaxon_tpu/serving/engine.py): one
+    slot-addressed KV cache, one jitted decode step advancing every
+    in-flight request a token per iteration, requests admitted/retired
+    mid-flight.  Concurrent connections feed the engine queue through a
+    threaded front-end and block only on their own completion — a long
+    generation never head-of-line-blocks a short one.  Routes:
 
     - ``POST /generate`` ``{"prompts": [[ids…]…], "max_new_tokens": N,
       "temperature": t}`` → ``{"tokens": [[ids…]…], "decode_tokens_per_s"}``
-      (prompts in one request must share a length — they batch into one
-      compiled decode; the KV cache stores UNEXPANDED GQA heads).
-    - ``GET /healthz`` → model/checkpoint metadata.
+      (prompts may have DIFFERENT lengths — each is its own engine
+      request; the KV cache stores UNEXPANDED GQA heads).
+    - ``GET /healthz`` → model/checkpoint metadata + engine occupancy.
+    - ``GET /v1/stats`` → queue depth, slot occupancy, tokens/s.
 
     Params: ``target`` (run uuid whose ``checkpoints/`` to serve — omit
     for fresh random weights, a load-testing double), the model-shape
     params of ``lm_train`` (must match the checkpoint), ``seq`` (max
-    prompt+generation length), ``host``.  Each distinct (batch,
-    prompt_len, max_new) triple compiles once and is cached after.
+    prompt+generation length per slot), ``slots`` (concurrent sequences
+    the cache holds), ``max_new_tokens`` (server default when a request
+    omits it), ``eos_id`` (retire a slot early on this token), ``host``,
+    ``quantize`` (``int8`` weight-only decode).  The decode step's shapes
+    depend only on ``slots`` — steady-state serving never recompiles.
     """
-    import json as json_mod
-    import threading
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     from polyaxon_tpu.models import TransformerConfig, decode, init_params
+    from polyaxon_tpu.serving import ServingEngine
 
     cfg_fields = {
         f: int(ctx.get_param(f))
@@ -262,132 +347,36 @@ def lm_server(ctx: Context) -> None:
 
     port = _service_port(ctx)
     host = str(ctx.get_param("host", "0.0.0.0"))
-    # One compiled decode per (B, T, max_new, greedy?) — temperature rides
-    # as a TRACED argument (client floats must not mint compilations), and
-    # the cache is LRU-bounded so arbitrary request shapes can't grow
-    # compile memory without limit.  A lock serializes device access (one
-    # accelerator, one generation at a time) and cache mutation.
-    from collections import OrderedDict
+    eos_id = ctx.get_param("eos_id")
+    engine = ServingEngine(
+        params,
+        cfg,
+        slots=int(ctx.get_param("slots", 4)),
+        max_len=seq,
+        qweights=qweights,
+        mesh=mesh if template is not None else None,
+        eos_id=int(eos_id) if eos_id is not None else None,
+        seed=ctx.seed or 0,
+    ).start()
 
-    compiled: "OrderedDict" = OrderedDict()
-    MAX_COMPILED = 32
-    device_lock = threading.Lock()
+    from http.server import ThreadingHTTPServer
 
-    def get_fn(b, t, max_new, greedy):
-        key = (b, t, max_new, greedy)
-        if key not in compiled:
-            if template is not None:
-                fn, _ = decode.sharded_generate_fn(
-                    cfg, mesh, template, max_new_tokens=max_new,
-                    greedy=greedy, param_shardings=param_shardings,
-                    qweights_shardings=qweights_shardings,
-                )
-            else:
-                # greedy is fixed per cache key, so the 0.0-vs-temp pick
-                # happens at trace time inside ONE lambda.
-                fn = jax.jit(
-                    lambda p, prompt, k, temp, qw, g=greedy: decode.generate(
-                        p, prompt, cfg, max_new_tokens=max_new,
-                        temperature=0.0 if g else temp, rng=k, qweights=qw,
-                    )
-                )
-            compiled[key] = fn
-            while len(compiled) > MAX_COMPILED:
-                compiled.popitem(last=False)
-        compiled.move_to_end(key)
-        return compiled[key]
-
-    rng_state = {"key": jax.random.PRNGKey(ctx.seed or 0)}
-
-    class Handler(BaseHTTPRequestHandler):
-        def log_message(self, fmt, *args):  # route into run logs, not stderr
-            ctx.log_text("lm_server: " + fmt % args)
-
-        def _json(self, code, payload):
-            body = json_mod.dumps(payload).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def do_GET(self):
-            if self.path not in ("/healthz", "/"):
-                return self._json(404, {"error": "not found"})
-            self._json(
-                200,
-                {
-                    "ok": True,
-                    "model": {
-                        "n_params": cfg.n_params,
-                        "vocab_size": cfg.vocab_size,
-                        "max_seq": cfg.max_seq,
-                        "n_kv_heads": cfg.kv_heads,
-                    },
-                    "checkpoint_step": step,
-                    "target": target,
-                },
-            )
-
-        def do_POST(self):
-            if self.path != "/generate":
-                return self._json(404, {"error": "not found"})
-            try:
-                n = int(self.headers.get("Content-Length", 0))
-                req = json_mod.loads(self.rfile.read(n) or b"{}")
-                prompts = req["prompts"]
-                max_new = int(req.get("max_new_tokens", 64))
-                temperature = float(req.get("temperature", 0.0))
-                if not prompts or not isinstance(prompts[0], list):
-                    raise ValueError("prompts must be a list of id lists")
-                if max_new <= 0:
-                    raise ValueError("max_new_tokens must be positive")
-                t = len(prompts[0])
-                if t == 0:
-                    raise ValueError("prompts must be non-empty")
-                if any(len(p) != t for p in prompts):
-                    raise ValueError(
-                        "prompts in one request must share a length "
-                        "(they batch into one compiled decode)"
-                    )
-                if t + max_new > cfg.max_seq:
-                    raise ValueError(
-                        f"prompt ({t}) + max_new_tokens ({max_new}) exceeds "
-                        f"max_seq ({cfg.max_seq})"
-                    )
-                arr = np.asarray(prompts, np.int32)
-                if arr.min() < 0 or arr.max() >= cfg.vocab_size:
-                    raise ValueError("token id out of vocabulary range")
-            except (KeyError, ValueError, TypeError) as e:
-                return self._json(400, {"error": str(e)})
-            t0 = time.time()
-            with device_lock:
-                fn = get_fn(arr.shape[0], t, max_new, temperature <= 0.0)
-                rng_state["key"], sub = jax.random.split(rng_state["key"])
-                out = np.asarray(
-                    fn(
-                        params,
-                        jnp.asarray(arr),
-                        sub,
-                        jnp.float32(temperature),
-                        qweights,
-                    )
-                )
-            dt = time.time() - t0
-            self._json(
-                200,
-                {
-                    "tokens": out.tolist(),
-                    "decode_tokens_per_s": round(out.size / max(dt, 1e-9), 1),
-                },
-            )
-
-    server = ThreadingHTTPServer((host, port), Handler)
+    meta = {
+        "checkpoint_step": step,
+        "target": target,
+        "default_max_new": int(ctx.get_param("max_new_tokens", 64)),
+    }
+    handler = _make_lm_handler(engine, cfg, meta, log=ctx.log_text)
+    server = ThreadingHTTPServer((host, port), handler)
     ctx.log_text(
-        f"lm_server: {cfg.n_params/1e6:.0f}M params on {host}:{port}"
+        f"lm_server: {cfg.n_params/1e6:.0f}M params, {engine.slots} slots "
+        f"on {host}:{port}"
         + (f" (checkpoint step {step})" if step is not None else " (random init)")
     )
-    server.serve_forever()
+    try:
+        server.serve_forever()
+    finally:
+        engine.stop()
 
 
 def output_server(ctx: Context) -> None:

@@ -522,10 +522,11 @@ class RunRegistry:
         keeps archive symmetric with delete_run's cascade: nothing can be
         purged by the parent's retention sweep while still presenting as
         a live run in the default view."""
-        family = self._family_ids(run_id)
-        marks = ",".join("?" * len(family))
         now = time.time()
         with self._lock, self._conn() as conn:
+            conn.execute("BEGIN IMMEDIATE")
+            family = self._family_fixpoint(run_id)
+            marks = ",".join("?" * len(family))
             cur = conn.execute(
                 f"UPDATE runs SET archived_at = ?, updated_at = ?"
                 f" WHERE id IN ({marks}) AND archived_at IS NULL",
@@ -536,9 +537,10 @@ class RunRegistry:
     def restore_run(self, run_id: int) -> bool:
         """Un-archive a run and its children (the reference archives
         API's restore endpoints)."""
-        family = self._family_ids(run_id)
-        marks = ",".join("?" * len(family))
         with self._lock, self._conn() as conn:
+            conn.execute("BEGIN IMMEDIATE")
+            family = self._family_fixpoint(run_id)
+            marks = ",".join("?" * len(family))
             cur = conn.execute(
                 f"UPDATE runs SET archived_at = NULL, updated_at = ?"
                 f" WHERE id IN ({marks}) AND archived_at IS NOT NULL",
@@ -567,6 +569,20 @@ class RunRegistry:
                 frontier.append(child["id"])
         return out
 
+    def _family_fixpoint(self, run_id: int) -> List[int]:
+        """Family walk re-run until STABLE — called with the write lock
+        held (``_lock`` + ``BEGIN IMMEDIATE``), so trial/pipeline children
+        created concurrently with an archive/restore/delete cannot land
+        between the walk and the mutation and escape the cascade.  The
+        re-walk catches children inserted during the first traversal."""
+        family = self._family_ids(run_id)
+        while True:
+            seen = set(family)
+            fresh = [i for i in self._family_ids(run_id) if i not in seen]
+            if not fresh:
+                return family
+            family += fresh
+
     def _run_exists(self, run_id: int) -> bool:
         return (
             self._conn()
@@ -581,10 +597,11 @@ class RunRegistry:
         gets this from FK on_delete cascades).  Returns the deleted Run
         records (pre-delete snapshots) so the caller can GC outputs dirs
         and store artifacts — the registry never touches the filesystem."""
-        victims = [self.get_run(rid) for rid in self._family_ids(run_id)]
-        ids = [r.id for r in victims]
-        marks = ",".join("?" * len(ids))
         with self._lock, self._conn() as conn:
+            conn.execute("BEGIN IMMEDIATE")
+            victims = [self.get_run(rid) for rid in self._family_fixpoint(run_id)]
+            ids = [r.id for r in victims]
+            marks = ",".join("?" * len(ids))
             # Free any held slices before the claim rows go away.
             conn.execute(
                 f"UPDATE devices SET run_id = NULL, updated_at = ?"

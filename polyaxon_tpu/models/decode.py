@@ -215,6 +215,131 @@ def prefill(
     return logits[:, -1], {"k": ck, "v": cv}
 
 
+# -- slot-addressed cache ops (continuous batching) ------------------------
+# The serving engine (polyaxon_tpu/serving/engine.py) owns ONE fixed-shape
+# cache of ``slots`` rows and admits/retires requests at decode-step
+# granularity.  Everything below keeps the [L, S, max_len, Hkv, d] shapes
+# static — slot index, per-slot positions, and the active mask are all
+# DATA, so one compiled step serves any mix of in-flight requests with
+# zero steady-state recompilation.
+
+
+def insert_prompt(
+    cache: Dict[str, jax.Array], slot: jax.Array, k: jax.Array, v: jax.Array
+) -> Dict[str, jax.Array]:
+    """Write one prefilled prompt's KV into batch slot ``slot``.
+
+    k/v: [L, T, Hkv, d] (the ``return_kv`` stacks of a B=1 prefill);
+    ``slot`` is a traced scalar, so reusing a slot never recompiles —
+    only each distinct prompt length T mints a compilation (the engine
+    pads prompts to a small bucket set to bound that).
+    """
+    k = k.astype(cache["k"].dtype)[:, None]  # [L, 1, T, Hkv, d]
+    v = v.astype(cache["v"].dtype)[:, None]
+    return {
+        "k": lax.dynamic_update_slice(cache["k"], k, (0, slot, 0, 0, 0)),
+        "v": lax.dynamic_update_slice(cache["v"], v, (0, slot, 0, 0, 0)),
+    }
+
+
+def _attend_slots(q, ck, cv, pos, group):
+    """One-token attention where every slot is at its OWN position.
+
+    q: [S, 1, H, d]; ck/cv: [S, max_len, Hkv, d]; pos: [S] per-slot
+    absolute positions (entries > pos[s] in slot s are future/garbage —
+    masked; a freed slot's stale rows beyond a new occupant's prompt are
+    masked the same way until decode overwrites them in place).
+    """
+    S, L, Hkv, d = ck.shape
+    scale = d**-0.5
+    qg = q.reshape(S, 1, Hkv, group, d)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, ck) * scale  # [S,Hkv,g,1,L]
+    valid = (jnp.arange(L)[None, :] <= pos[:, None])[:, None, None, None, :]
+    s = jnp.where(valid, s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, cv)
+    return out.reshape(S, 1, Hkv * group, d)
+
+
+def _slot_block_step(x, pos, layer, ck, cv, cfg: TransformerConfig):
+    """One transformer block for one token PER SLOT, each at its own
+    position.  x: [S, 1, D]; ck/cv: [S, max_len, Hkv, d]; pos: [S].
+    The per-slot KV row lands via a vmapped dynamic_update_slice (XLA
+    lowers it to a batched scatter — the cache is updated in place, not
+    rewritten)."""
+    c = cfg
+    h = _rmsnorm(x, layer["attn_norm"])
+    q = jnp.einsum("btd,dhk->bthk", h, _wdq(layer["wq"], h.dtype))
+    k = jnp.einsum("btd,dhk->bthk", h, _wdq(layer["wk"], h.dtype))
+    v = jnp.einsum("btd,dhk->bthk", h, _wdq(layer["wv"], h.dtype))
+    positions = pos[:, None]  # [S, 1]
+    q = _rope(q, positions, c.rope_theta)
+    k = _rope(k, positions, c.rope_theta)
+    write = jax.vmap(
+        lambda cc, kk, p: lax.dynamic_update_slice(cc, kk, (p, 0, 0))
+    )
+    ck = write(ck, k, pos)
+    cv = write(cv, v, pos)
+    attn = _attend_slots(q, ck, cv, pos, c.n_heads // c.kv_heads)
+    x = x + jnp.einsum("bthk,hkd->btd", attn, _wdq(layer["wo"], h.dtype))
+
+    h = _rmsnorm(x, layer["mlp_norm"])
+    up = jnp.einsum("btd,df->btf", h, _wdq(layer["wi"], h.dtype))
+    gate = jnp.einsum("btd,df->btf", h, _wdq(layer["wg"], h.dtype))
+    y = jax.nn.silu(gate) * up
+    x = x + jnp.einsum("btf,fd->btd", y, _wdq(layer["wd"], h.dtype))
+    return x, ck, cv
+
+
+def slot_decode_step(
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    tokens: jax.Array,
+    pos: jax.Array,
+    active: jax.Array,
+    cfg: TransformerConfig,
+    qweights: Optional[Dict[str, Any]] = None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Advance a MIXED batch one token: slot s feeds ``tokens[s]`` at
+    absolute position ``pos[s]`` → (logits [S, vocab] f32, updated cache).
+
+    ``active`` [S] bool gates the write position: inactive slots write
+    their (garbage) row at position 0 of their own FREE slot, which the
+    next occupant's prompt insert overwrites — so idle slots cost one
+    wasted lane of compute but can never corrupt a live slot.  This is
+    the engine's one jitted hot function; its shapes depend only on the
+    slot count, so steady-state serving never recompiles.
+    """
+    c = cfg
+    pos = jnp.where(active, pos, 0)
+    x = params["embed"].astype(c.dtype)[tokens][:, None, :]  # [S,1,D]
+
+    blk = params["block"]
+    if qweights is None:
+        layers = blk
+        unembed = params["unembed"]
+    else:
+        layers = {
+            "attn_norm": blk["attn_norm"],
+            "mlp_norm": blk["mlp_norm"],
+            **{k: qweights[k] for k in QUANTIZED_BLOCK_WEIGHTS},
+        }
+        unembed = qweights["unembed"]
+
+    def layer_body(carry, inputs):
+        x = carry
+        layer, ck, cv = inputs
+        x, ck, cv = _slot_block_step(x, pos, layer, ck, cv, c)
+        return x, (ck, cv)
+
+    x, (new_ck, new_cv) = lax.scan(
+        layer_body, x, (layers, cache["k"], cache["v"])
+    )
+    x = _rmsnorm(x, params["final_norm"])
+    logits = jnp.einsum("btd,dv->btv", x, _wdq(unembed, x.dtype))
+    return logits[:, 0].astype(jnp.float32), {"k": new_ck, "v": new_cv}
+
+
 def _fit_spec(spec, leaf, mesh_shape):
     """Drop sharding on axes whose mesh size doesn't divide the leaf's
     actual dimension (shape-aware replication fallback)."""
@@ -381,7 +506,14 @@ def generate(
     cache = init_cache(cfg, B, max_len)
     logits, cache = prefill(params, prompt, cache, cfg)
 
-    greedy = isinstance(temperature, (int, float)) and temperature <= 0.0
+    # Concrete zeros of ANY scalar flavor (python float, np.float32,
+    # jnp scalar) select the greedy branch — only a TRACED temperature is
+    # forced down the sampling path (a tracer has no concrete value to
+    # fork on, and dividing by a concrete 0.0 would NaN the logits).
+    greedy = (
+        not isinstance(temperature, jax.core.Tracer)
+        and float(temperature) <= 0.0
+    )
 
     def pick(logits, key):
         if greedy:

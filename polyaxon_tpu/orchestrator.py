@@ -536,7 +536,19 @@ class Orchestrator:
         up at trigger time."""
         if not isinstance(spec, BaseSpecification):
             spec = PolyaxonFile.load(spec).specification
-        ci = self.registry.set_project_ci(project, spec.to_dict())
+        data = spec.to_dict()
+        # Persist the build section with ONLY the fields the user set:
+        # to_dict() serializes the default context '.', which after a
+        # round-trip reads as explicitly set and defeats trigger_ci's
+        # explicit-context guard (a default '.' would snapshot the
+        # service host's cwd).
+        if spec.build is not None:
+            build = spec.build.model_dump(exclude_unset=True)
+            if build:
+                data["build"] = build
+            else:
+                data.pop("build", None)
+        ci = self.registry.set_project_ci(project, data)
         self.auditor.record(
             EventTypes.CI_SET,
             project=project,

@@ -32,6 +32,8 @@ def _pp_body(
     n_micro: int,
     aux_fn: Any = None,
     batch_axis_names: Tuple[str, ...] = (),
+    stage_ids: Any = None,
+    unroll: bool = False,
 ):
     """Per-device GPipe schedule. x: [B_local, T, D]; layers: local stages.
 
@@ -39,9 +41,27 @@ def _pp_body(
     output (e.g. MoE gate statistics) to a scalar loss; the schedule
     accumulates it only on a stage's VALID ticks — bubble ticks run the
     body on stale state and must not pollute the sum.
+
+    ``stage_ids`` (optional [1] int array, P(axis)-sharded from a global
+    arange) replaces ``lax.axis_index``: under a PARTIAL-manual shard_map
+    the old jax line lowers axis_index to an XLA PartitionId op, which the
+    SPMD partitioner rejects for the remaining auto axes.
+
+    ``unroll`` statically unrolls the schedule and the per-stage layer
+    scan (Python loops, no ``while`` in the HLO), and routes the
+    stage→stage hop through ``psum`` instead of ``ppermute``.  Both are
+    required on the old jax line's PARTIAL-manual path: the transpose of
+    any while loop leaves its scalar carries ``{replicated}`` amid
+    manual-subgroup neighbors, and XLA's sharding propagation never
+    assigns a manual-subgroup sharding to a ``collective-permute`` —
+    either way the SPMD partitioner fatals on the mix.  The psum hop
+    all-reduces a one-hot-stacked send ([S, ...]) and picks slot
+    stage-1 locally: S× the ppermute payload, acceptable at real stage
+    counts.  Tick count is M + S - 1 and stages hold L/S layers, so the
+    unrolled body stays small at realistic microbatch counts.
     """
     S = lax.psum(1, axis)
-    stage = lax.axis_index(axis)
+    stage = lax.axis_index(axis) if stage_ids is None else stage_ids[0]
     B, T, D = x.shape
     mb = x.reshape(n_micro, B // n_micro, T, D)
     pos_mb = positions.reshape(n_micro, B // n_micro, T)
@@ -52,12 +72,25 @@ def _pp_body(
             out, aux = block(c, pos, layer)
             return out, (aux_fn(aux) if aux_fn is not None else 0.0)
 
-        out, layer_aux = lax.scan(scan_body, inp, layers)
+        if unroll:
+            n_local = jax.tree.leaves(layers)[0].shape[0]
+            out, auxes = inp, []
+            for li in range(n_local):
+                out, a = scan_body(out, jax.tree.map(lambda w: w[li], layers))
+                auxes.append(a)
+            layer_aux = jnp.stack(auxes) if aux_fn is not None else None
+        else:
+            out, layer_aux = lax.scan(scan_body, inp, layers)
         return out, jnp.mean(layer_aux) if aux_fn is not None else 0.0
 
     outputs = jnp.zeros_like(mb)
     state = jnp.zeros_like(mb[0])
-    aux_acc = jnp.zeros((), jnp.float32)
+    # The aux rides as shape [1], never a true scalar: old-jax shard_map
+    # mishandles rank-0 values crossing the manual boundary under AD (its
+    # scalar-residual promotion loses track through partial eval, and the
+    # transpose then stages a rank-0 cotangent with sharded out-names).
+    # A singleton axis sidesteps the whole class; callers squeeze it off.
+    aux_acc = jnp.zeros((1,), jnp.float32)
 
     def tick(i, carry):
         outputs, state, aux_acc = carry
@@ -77,12 +110,27 @@ def _pp_body(
         cur = lax.dynamic_index_in_dim(outputs, jc, 0, keepdims=False)
         val = jnp.where((stage == S - 1) & (j >= 0), out, cur)
         outputs = lax.dynamic_update_index_in_dim(outputs, val, jc, 0)
-        state = lax.ppermute(out, axis, perm)
+        if unroll:
+            basis = (jnp.arange(S) == stage).astype(jnp.float32)
+            stacked = lax.psum(
+                basis.reshape((S,) + (1,) * out.ndim)
+                * out[None].astype(jnp.float32),
+                axis,
+            )
+            state = lax.dynamic_index_in_dim(
+                stacked, (stage - 1) % S, 0, keepdims=False
+            ).astype(out.dtype)
+        else:
+            state = lax.ppermute(out, axis, perm)
         return outputs, state, aux_acc
 
-    outputs, _, aux_acc = lax.fori_loop(
-        0, n_micro + S - 1, tick, (outputs, state, aux_acc)
-    )
+    carry = (outputs, state, aux_acc)
+    if unroll:
+        for i in range(n_micro + S - 1):
+            carry = tick(i, carry)
+        outputs, _, aux_acc = carry
+    else:
+        outputs, _, aux_acc = lax.fori_loop(0, n_micro + S - 1, tick, carry)
     # Only the last stage holds real outputs; broadcast over the pipeline
     # axis so downstream (final norm + unembed) sees replicated activations.
     # The psum rides f32: a bf16 all-reduce over a manual axis inside a
@@ -100,7 +148,7 @@ def _pp_body(
     aux = lax.psum(aux_acc, axis) / (S * n_micro)
     if batch_axis_names:
         aux = lax.pmean(aux, batch_axis_names)
-    return outputs.reshape(B, T, D), aux
+    return outputs.reshape(B, T, D), aux  # aux: [1], squeezed by wrappers
 
 
 def pipeline_scan_composed(
@@ -138,8 +186,13 @@ def pipeline_scan_composed(
         )
     layer_spec = jax.tree.map(lambda _: P(axis), stacked_layers)
     x_dtype = x.dtype
+    # Old jax: the transpose of ANY while loop (fori_loop/scan) inside a
+    # partial-manual region leaves scalar loop carries {replicated} amid
+    # manual-subgroup neighbors and the SPMD partitioner fatals — unroll
+    # the schedule statically there.  New jax handles whiles fine.
+    unroll = not hasattr(jax, "shard_map")
 
-    def body_f32(x32, positions, layers):
+    def body_f32(x32, positions, layers, stage_ids):
         # The region boundary rides f32: XLA CPU hard-crashes on a bf16
         # all-reduce over a manual axis inside a PARTIAL-manual shard_map
         # ("Invalid binary instruction opcode copy") — and AD generates
@@ -156,19 +209,24 @@ def pipeline_scan_composed(
             # Auto axes are GSPMD-global inside the body: the aux scalar is
             # already a full-batch value, no pmean over data needed.
             batch_axis_names=(),
+            stage_ids=stage_ids,
+            unroll=unroll,
         )
         return out.astype(jnp.float32), aux
 
-    fn = jax.shard_map(
+    from polyaxon_tpu.parallel.shmap import shard_map
+
+    fn = shard_map(
         body_f32,
         mesh=mesh,
-        in_specs=(P(), P(), layer_spec),
+        in_specs=(P(), P(), layer_spec, P(axis)),
         out_specs=(P(), P()),
         axis_names={axis},
         check_vma=False,
     )
-    out, aux = fn(x.astype(jnp.float32), positions, stacked_layers)
-    return out.astype(x_dtype), aux
+    stage_ids = jnp.arange(n_stages, dtype=jnp.int32)
+    out, aux = fn(x.astype(jnp.float32), positions, stacked_layers, stage_ids)
+    return out.astype(x_dtype), aux[0]
 
 
 def pipeline_scan(
@@ -192,8 +250,9 @@ def pipeline_scan(
     ``aux_fn(block_aux)`` over layers and microbatches (0.0 without aux_fn),
     which is how MoE's load-balancing loss crosses the shard_map boundary.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from polyaxon_tpu.parallel.shmap import shard_map
 
     n_stages = mesh.shape[axis]
     n_layers = jax.tree.leaves(stacked_layers)[0].shape[0]
@@ -237,4 +296,5 @@ def pipeline_scan(
         out_specs=(x_spec, P()),
         check_vma=False,
     )
-    return fn(x, positions, stacked_layers)
+    out, aux = fn(x, positions, stacked_layers)
+    return out, aux[0]

@@ -79,10 +79,10 @@ def ring_attention_sharded(
     interpret mode off-TPU), ``"dense"`` the jnp blockwise body, ``"auto"``
     flash on TPU and dense elsewhere.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from polyaxon_tpu.parallel.flash import _on_tpu
+    from polyaxon_tpu.parallel.shmap import shard_map
 
     if q.shape[2] % k.shape[2]:
         raise ValueError(

@@ -1,0 +1,130 @@
+"""``shard_map`` across jax versions.
+
+Newer jax exposes ``jax.shard_map(..., axis_names=..., check_vma=...)``;
+the older line ships ``jax.experimental.shard_map.shard_map`` whose
+equivalents are ``auto`` (the COMPLEMENT of axis_names) and
+``check_rep``.  Callers use the new surface; this translates down when
+the top-level name is missing.
+
+The old line also needs three targeted repairs, applied once on first
+use (each is the fix that later landed upstream, made from outside):
+
+* identity replication rules for the ``name`` primitive (emitted by
+  ``jax.checkpoint`` save_only_these_names policies) and for
+  ``sharding_constraint`` — without them ``check_rep=True`` rejects any
+  body that remats or constrains shardings;
+* partial-eval residual out-names restricted to the MANUAL axes.  The
+  old ``_shard_map_partial_eval`` names residuals over every mesh axis,
+  so under a PARTIAL-manual region (``auto`` nonempty) residual
+  boundary shardings mention auto axes and the XLA SPMD partitioner
+  fatals on a manual-subgroup mismatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Set
+
+_OLD_JAX_PATCHED = False
+
+
+def _patch_old_shard_map() -> None:
+    """One-time repairs to jax.experimental.shard_map (old jax only)."""
+    global _OLD_JAX_PATCHED
+    if _OLD_JAX_PATCHED:
+        return
+    _OLD_JAX_PATCHED = True
+    from jax.experimental import shard_map as _sm
+
+    # ``name`` and ``sharding_constraint`` are pure pass-throughs, so the
+    # standard identity rules are exact.  setdefault semantics make
+    # re-registration a no-op.
+    try:
+        from jax._src.ad_checkpoint import name_p
+
+        _sm.register_standard_check(name_p)
+        _sm.register_standard_rewrite(name_p)
+    except ImportError:  # pragma: no cover - layout drift on other versions
+        pass
+    try:
+        from jax._src.pjit import sharding_constraint_p
+
+        _sm.register_standard_check(sharding_constraint_p)
+        _sm.register_standard_rewrite(sharding_constraint_p)
+    except ImportError:  # pragma: no cover - layout drift on other versions
+        pass
+
+    # Residual naming: _shard_map_partial_eval receives ``auto`` but
+    # computes its residual names via _all_mesh_names_except_spmd(mesh),
+    # which ignores it.  Thread the active ``auto`` through a stack so the
+    # helper can subtract it — exactly what newer jax's
+    # _all_newly_manual_mesh_names does.
+    try:
+        from jax._src.interpreters import partial_eval as _pe
+
+        _orig_pe = _sm._shard_map_partial_eval
+        _orig_names = _sm._all_mesh_names_except_spmd
+        _auto_stack: list = []
+
+        def _names_minus_auto(mesh, trace=None):
+            names = _orig_names(mesh, trace)
+            if _auto_stack and _auto_stack[-1]:
+                names = tuple(n for n in names if n not in _auto_stack[-1])
+            return names
+
+        def _partial_eval_with_auto(
+            trace, prim, f, tracers, mesh, in_names, out_names_thunk,
+            check_rep, rewrite, auto,
+        ):
+            _auto_stack.append(auto)
+            try:
+                return _orig_pe(
+                    trace, prim, f, tracers, mesh, in_names, out_names_thunk,
+                    check_rep, rewrite, auto,
+                )
+            finally:
+                _auto_stack.pop()
+
+        _sm._all_mesh_names_except_spmd = _names_minus_auto
+        _sm._shard_map_partial_eval = _partial_eval_with_auto
+        _pe.JaxprTrace.process_shard_map = _partial_eval_with_auto
+    except (ImportError, AttributeError):  # pragma: no cover - layout drift
+        pass
+
+
+def shard_map(
+    f,
+    *,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    axis_names: Optional[Set[str]] = None,
+    check_vma: bool = False,
+):
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        kwargs = {"check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental import shard_map as _sm
+
+    _patch_old_shard_map()
+    manual = set(axis_names) if axis_names is not None else set(mesh.axis_names)
+    auto = frozenset(mesh.axis_names) - manual
+    # check_rep maps from check_vma (both gate replication tracking; the
+    # old checker also rejects valid programs, e.g. scan carries mixing
+    # known/unknown replication, so callers here all pass False).  With it
+    # off the transpose takes the defensive-psum path, which is correct as
+    # long as no rank-0 value crosses the boundary — see _pp_body's
+    # rank-1 aux.
+    return _sm.shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=check_vma,
+        auto=auto,
+    )

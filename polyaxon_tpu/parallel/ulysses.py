@@ -54,8 +54,9 @@ def ulysses_attention_sharded(
 ) -> jax.Array:
     """Global-view entry: q/k/v [B, T, H, d] with T sharded on ``seq_axis``
     and H divisible by the axis size."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from polyaxon_tpu.parallel.shmap import shard_map
 
     n = mesh.shape[seq_axis]
     H = q.shape[2]

@@ -33,7 +33,9 @@ def _validate_param_shapes(init_fn, param_specs, mesh_axes) -> None:
     )
     paths = [
         "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
-        for path, _ in jax.tree.flatten_with_path(abstract)[0]
+        # tree_flatten_with_path lives in tree_util on the older jax line;
+        # jax.tree.flatten_with_path only arrived later.
+        for path, _ in jax.tree_util.tree_flatten_with_path(abstract)[0]
     ]
     for name, leaf, spec in zip(paths, flat_shapes, flat_specs):
         for dim, entry in zip(leaf.shape, spec):

@@ -1,0 +1,16 @@
+"""Continuous-batching LM serving (Orca-style iteration-level scheduling).
+
+The engine owns ONE fixed-shape, slot-addressed KV cache and admits or
+retires requests at decode-STEP granularity — a long generation never
+head-of-line-blocks a short one, and a freed slot is refilled from the
+queue mid-flight.  ``builtins/services.py:lm_server`` is the HTTP
+front-end; the engine itself is front-end-agnostic.
+"""
+
+from polyaxon_tpu.serving.engine import (
+    GenerationRequest,
+    ServingEngine,
+    SlotAllocator,
+)
+
+__all__ = ["GenerationRequest", "ServingEngine", "SlotAllocator"]

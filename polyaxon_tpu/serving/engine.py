@@ -1,0 +1,496 @@
+"""Continuous-batching generation engine over a slot-addressed KV cache.
+
+Iteration-level scheduling (Orca; the KV management popularized by
+vLLM, here slot-granular rather than paged): the engine owns one
+``[L, slots, max_len, Hkv, d]`` cache and ONE jitted
+:func:`~polyaxon_tpu.models.decode.slot_decode_step` whose shapes depend
+only on the slot count — per-slot positions, the active mask, and the
+slot index of every admission are DATA, so steady-state serving never
+recompiles.  Each scheduler iteration:
+
+1. **admit** — while a slot is free and the queue is non-empty, prefill
+   the next prompt (one B=1 forward, padded to a small bucket set so
+   prompt lengths don't mint unbounded compilations) and write its KV
+   into the free slot via ``insert_prompt``;
+2. **step** — one batched decode step advances every active slot one
+   token, each at its own position;
+3. **retire** — finished slots (max_new reached, or EOS) are freed
+   IMMEDIATELY; the next queued request takes the slot on the very next
+   iteration, while its neighbors keep decoding.
+
+Tokens stream back per-request as they land (``GenerationRequest.stream``);
+a request's latency is its own prefill + its own tokens, not the
+longest neighbor's.  Greedy outputs are token-identical to sequential
+:func:`~polyaxon_tpu.models.decode.generate` calls
+(tests/test_serving/test_engine.py asserts it per request).
+
+Sharded + quantized serving compose exactly like the request-granular
+path did: place the params (and the int8 ``(q, scale)`` tree) with
+``decode_param_shardings`` / ``quantized_weight_shardings`` and GSPMD
+propagates head-sharding through prefill and the slot step — the KV
+slots live on the gang mesh.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class GenerationRequest:
+    """One queued generation: its prompt, its budget, and its results.
+
+    ``stream`` yields token ids as they are generated (a ``None``
+    sentinel marks completion); ``done`` is set when the request has
+    finished (or failed — see ``error``).  ``tokens`` accumulates the
+    generated ids in order.
+    """
+
+    _ids = itertools.count()
+
+    def __init__(
+        self,
+        prompt: List[int],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+    ) -> None:
+        self.id = next(self._ids)
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.tokens: List[int] = []
+        self.stream: "queue.Queue[Optional[int]]" = queue.Queue()
+        self.done = threading.Event()
+        self.error: Optional[str] = None
+        self.submitted_at = time.time()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+
+    def wait(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until done; raise on engine-side failure."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"request {self.id} still running")
+        if self.error:
+            raise RuntimeError(self.error)
+        return self.tokens
+
+
+class SlotAllocator:
+    """FIFO free-list over ``n`` cache slots.
+
+    Freed slots go to the BACK of the list, so reuse order is the order
+    slots were released — the coldest slot is reused first, which keeps
+    any one slot's stale KV rows short-lived (and makes the admit/evict/
+    reuse sequence deterministic for tests).
+    """
+
+    def __init__(self, n: int) -> None:
+        if n < 1:
+            raise ValueError(f"need at least one slot, got {n}")
+        self.n = n
+        self._free: deque = deque(range(n))
+        self._held: set = set()
+
+    def alloc(self) -> Optional[int]:
+        if not self._free:
+            return None
+        slot = self._free.popleft()
+        self._held.add(slot)
+        return slot
+
+    def free(self, slot: int) -> None:
+        if slot not in self._held:
+            raise ValueError(f"slot {slot} is not allocated")
+        self._held.discard(slot)
+        self._free.append(slot)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_active(self) -> int:
+        return len(self._held)
+
+
+class ServingEngine:
+    """The continuous-batching scheduler: one thread owns the device.
+
+    Parameters
+    ----------
+    params, cfg : the model (a ``TransformerConfig`` tree).
+    slots : concurrent sequences the cache holds (the static batch dim).
+    max_len : per-slot sequence capacity (default ``cfg.max_seq``).
+    qweights : int8 tree from ``decode.quantize_weights`` — the slot
+        step streams int8 exactly like request-granular decode did.
+    mesh / param_shardings / qweights_shardings : multi-chip serving;
+        when given, params (and qweights) are placed on the mesh and
+        GSPMD propagates the sharding through prefill and the step.
+    eos_id : optional token id that retires a slot early.
+    seed : RNG seed for the sampling path (greedy ignores it).
+    """
+
+    #: Prompt-length padding buckets: powers of two bound the number of
+    #: prefill compilations at log2(max_len) regardless of traffic.
+    @staticmethod
+    def _bucket(t: int, max_len: int) -> int:
+        b = 8
+        while b < t:
+            b *= 2
+        return min(b, max_len)
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: Any,
+        *,
+        slots: int = 4,
+        max_len: Optional[int] = None,
+        qweights: Optional[Any] = None,
+        mesh: Any = None,
+        param_shardings: Optional[Any] = None,
+        qweights_shardings: Optional[Any] = None,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> None:
+        import jax
+
+        from polyaxon_tpu.models import decode
+
+        if max_len is None:
+            max_len = cfg.max_seq
+        if max_len > cfg.max_seq:
+            raise ValueError(
+                f"max_len ({max_len}) exceeds the model's max_seq "
+                f"({cfg.max_seq})"
+            )
+        self.cfg = cfg
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.eos_id = eos_id
+        self._mesh = mesh
+        if param_shardings is not None:
+            params = jax.device_put(params, param_shardings)
+        if qweights is not None and qweights_shardings is not None:
+            qweights = jax.device_put(qweights, qweights_shardings)
+        self._params = params
+        self._qweights = qweights
+        self._cache = decode.init_cache(cfg, self.slots, self.max_len)
+
+        # Host-side per-slot state: the NEXT token to feed, its absolute
+        # position, the active mask, and each slot's sampling temperature.
+        self._tok = np.zeros(self.slots, np.int32)
+        self._pos = np.zeros(self.slots, np.int32)
+        self._active = np.zeros(self.slots, bool)
+        self._temps = np.zeros(self.slots, np.float32)
+        self._slot_req: List[Optional[GenerationRequest]] = [None] * self.slots
+
+        self.allocator = SlotAllocator(self.slots)
+        self._queue: "deque[GenerationRequest]" = deque()
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        self._key = jax.random.PRNGKey(seed)
+        self._rng = np.random.default_rng(seed)
+        self._prefill_fns: Dict[int, Any] = {}
+        self._insert_fns: Dict[int, Any] = {}
+        self._step_fn = self._build_step()
+
+        # Stats: lifetime counters plus a sliding window for tokens/s.
+        self._stats_lock = threading.Lock()
+        self._n_submitted = 0
+        self._n_finished = 0
+        self._n_tokens = 0
+        self._n_steps = 0
+        self._window: "deque[tuple]" = deque()  # (t, n_tokens)
+
+    # -- compiled functions ----------------------------------------------------
+
+    def _donate(self) -> tuple:
+        # Cache donation halves peak HBM for the engine's largest buffer;
+        # CPU ignores donation with a warning, so only request it on
+        # accelerator backends.
+        import jax
+
+        return (1,) if jax.default_backend() != "cpu" else ()
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.models.decode import slot_decode_step
+
+        cfg = self.cfg
+
+        def step(params, cache, tokens, pos, active, temps, key, qweights):
+            logits, cache = slot_decode_step(
+                params, cache, tokens, pos, active, cfg, qweights=qweights
+            )
+            greedy_tok = jnp.argmax(logits, axis=-1)
+            # Per-slot keys: a slot's sample must not depend on which
+            # neighbors happen to be in flight.
+            keys = jax.random.split(key, logits.shape[0])
+            safe = jnp.where(temps > 0, temps, 1.0)
+            sampled = jax.vmap(jax.random.categorical)(
+                keys, logits / safe[:, None]
+            )
+            tok = jnp.where(temps > 0, sampled, greedy_tok)
+            return jnp.where(active, tok, 0).astype(jnp.int32), cache
+
+        return jax.jit(step, donate_argnums=self._donate())
+
+    def _get_prefill(self, t_pad: int):
+        import jax
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.models.transformer import forward
+
+        if t_pad not in self._prefill_fns:
+            cfg = self.cfg
+
+            def pre(params, tokens, last):
+                logits, (k, v) = forward(params, tokens, cfg, return_kv=True)
+                # Right-padded prompt: the real last-token logits sit at
+                # index ``last`` (causal attention keeps them independent
+                # of the pad tail).
+                return jnp.take(logits[0], last, axis=0), k[:, 0], v[:, 0]
+
+            self._prefill_fns[t_pad] = jax.jit(pre)
+        return self._prefill_fns[t_pad]
+
+    def _get_insert(self, t_pad: int):
+        import jax
+
+        from polyaxon_tpu.models.decode import insert_prompt
+
+        if t_pad not in self._insert_fns:
+            self._insert_fns[t_pad] = jax.jit(
+                lambda cache, slot, k, v: insert_prompt(cache, slot, k, v),
+                donate_argnums=(0,) if self._donate() else (),
+            )
+        return self._insert_fns[t_pad]
+
+    # -- public API ------------------------------------------------------------
+
+    def start(self) -> "ServingEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="serving-engine", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        # Fail anything still queued or in flight so waiters unblock.
+        with self._cv:
+            pending = list(self._queue)
+            self._queue.clear()
+        for req in pending + [r for r in self._slot_req if r is not None]:
+            if not req.done.is_set():
+                req.error = "engine stopped"
+                req.stream.put(None)
+                req.done.set()
+
+    def submit(
+        self,
+        prompt: List[int],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+    ) -> GenerationRequest:
+        """Validate and enqueue; returns immediately with the request."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        if any(t < 0 or t >= self.cfg.vocab_size for t in prompt):
+            raise ValueError("token id out of vocabulary range")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be positive")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the engine's max_len ({self.max_len})"
+            )
+        req = GenerationRequest(prompt, max_new_tokens, temperature)
+        with self._cv:
+            if self._stop.is_set():
+                raise RuntimeError("engine is stopped")
+            self._queue.append(req)
+            self._n_submitted += 1
+            self._cv.notify_all()
+        return req
+
+    def generate(
+        self,
+        prompt: List[int],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        timeout: Optional[float] = None,
+    ) -> List[int]:
+        """Blocking convenience: submit + wait."""
+        return self.submit(prompt, max_new_tokens, temperature).wait(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._stats_lock:
+            now = time.time()
+            while self._window and now - self._window[0][0] > 10.0:
+                self._window.popleft()
+            window_tokens = sum(n for _, n in self._window)
+            window_span = (
+                now - self._window[0][0] if len(self._window) > 1 else 0.0
+            )
+            tps = window_tokens / window_span if window_span > 0 else 0.0
+            return {
+                "slots": self.slots,
+                "slots_active": self.allocator.n_active,
+                "queue_depth": len(self._queue),
+                "requests_submitted": self._n_submitted,
+                "requests_finished": self._n_finished,
+                "tokens_generated": self._n_tokens,
+                "decode_steps": self._n_steps,
+                "tokens_per_s": round(tps, 1),
+                "max_len": self.max_len,
+            }
+
+    # -- scheduler loop --------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._admit()
+            if not self._active.any():
+                with self._cv:
+                    if not self._queue and not self._stop.is_set():
+                        self._cv.wait(timeout=0.2)
+                continue
+            try:
+                self._step_once()
+            except Exception as e:  # fail in-flight requests, keep serving
+                for slot in np.nonzero(self._active)[0]:
+                    self._fail_slot(int(slot), f"decode step failed: {e!r}")
+
+    def _admit(self) -> None:
+        """Prefill waiting requests into free slots (queue order)."""
+        while True:
+            with self._cv:
+                if not self._queue:
+                    return
+                slot = self.allocator.alloc()
+                if slot is None:
+                    return
+                req = self._queue.popleft()
+            try:
+                self._prefill_into(slot, req)
+            except Exception as e:
+                self._slot_req[slot] = None
+                self.allocator.free(slot)
+                req.error = f"prefill failed: {e!r}"
+                req.stream.put(None)
+                req.done.set()
+
+    def _prefill_into(self, slot: int, req: GenerationRequest) -> None:
+        import jax.numpy as jnp
+
+        req.started_at = time.time()
+        t = len(req.prompt)
+        t_pad = self._bucket(t, self.max_len)
+        padded = np.zeros((1, t_pad), np.int32)
+        padded[0, :t] = req.prompt
+        last_logits, k, v = self._get_prefill(t_pad)(
+            self._params, jnp.asarray(padded), jnp.int32(t - 1)
+        )
+        self._cache = self._get_insert(t_pad)(
+            self._cache, jnp.int32(slot), k, v
+        )
+        first = self._pick_first(np.asarray(last_logits), req.temperature)
+        self._slot_req[slot] = req
+        self._emit(slot, req, first)
+        if not req.done.is_set():
+            self._tok[slot] = first
+            self._pos[slot] = t
+            self._temps[slot] = req.temperature
+            self._active[slot] = True
+
+    def _pick_first(self, logits: np.ndarray, temperature: float) -> int:
+        """First generated token comes from the prefill logits (exactly
+        like ``generate()``'s post-prefill pick)."""
+        if temperature <= 0.0:
+            return int(logits.argmax())
+        z = logits.astype(np.float64) / temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    def _step_once(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self._key, sub = jax.random.split(self._key)
+        toks, self._cache = self._step_fn(
+            self._params,
+            self._cache,
+            jnp.asarray(self._tok),
+            jnp.asarray(self._pos),
+            jnp.asarray(self._active),
+            jnp.asarray(self._temps),
+            sub,
+            self._qweights,
+        )
+        toks = np.asarray(toks)  # host sync — the loop's one device read
+        n_live = int(self._active.sum())
+        for slot in np.nonzero(self._active)[0]:
+            slot = int(slot)
+            req = self._slot_req[slot]
+            tok = int(toks[slot])
+            self._pos[slot] += 1
+            self._tok[slot] = tok
+            self._emit(slot, req, tok)
+        with self._stats_lock:
+            self._n_steps += 1
+            self._window.append((time.time(), n_live))
+
+    def _emit(self, slot: int, req: GenerationRequest, tok: int) -> None:
+        """Record one generated token; retire the slot when done."""
+        req.tokens.append(tok)
+        req.stream.put(tok)
+        with self._stats_lock:
+            self._n_tokens += 1
+        hit_eos = self.eos_id is not None and tok == self.eos_id
+        if len(req.tokens) >= req.max_new_tokens or hit_eos:
+            self._retire(slot, req)
+
+    def _retire(self, slot: int, req: GenerationRequest) -> None:
+        req.finished_at = time.time()
+        req.stream.put(None)
+        req.done.set()
+        self._active[slot] = False
+        self._slot_req[slot] = None
+        self.allocator.free(slot)
+        with self._stats_lock:
+            self._n_finished += 1
+        # Waiters in submit-order take freed slots on the NEXT admit —
+        # i.e. immediately, mid-flight of every other slot.
+        with self._cv:
+            self._cv.notify_all()
+
+    def _fail_slot(self, slot: int, msg: str) -> None:
+        req = self._slot_req[slot]
+        self._active[slot] = False
+        self._slot_req[slot] = None
+        self.allocator.free(slot)
+        if req is not None and not req.done.is_set():
+            req.error = msg
+            req.stream.put(None)
+            req.done.set()

@@ -84,3 +84,64 @@ class TestCIAPI:
             return True
 
         assert drive(orch, body)
+
+    def test_build_without_context_does_not_defeat_explicit_guard(
+        self, orch, tmp_path
+    ):
+        """Regression: storing the CI spec used to serialize BuildConfig's
+        DEFAULT context '.', which read back as explicitly set — so a CI
+        spec whose build only names include-patterns silently snapshotted
+        the service host's cwd.  Now the stored build keeps only the
+        fields the user actually set, and a trigger with no context from
+        either side is a 400."""
+        code = tmp_path / "code"
+        code.mkdir()
+        (code / "main.py").write_text("v1\n")
+        spec = {
+            **CI_SPEC,
+            "build": {"include": ["**/*.py"]},  # no context — on purpose
+        }
+
+        async def body(client):
+            resp = await client.put(
+                "/api/v1/projects/default/ci", json={"spec": spec}
+            )
+            assert resp.status == 201
+            stored = (await resp.json())["spec"]
+            # The default '.' must NOT be persisted as if user-chosen.
+            assert "context" not in stored.get("build", {})
+
+            # No context from the spec, none from the trigger: refuse.
+            resp = await client.post("/api/v1/projects/default/ci/trigger")
+            assert resp.status == 400
+            assert "context" in (await resp.json())["error"]
+
+            # An explicit trigger-side context still works.
+            resp = await client.post(
+                "/api/v1/projects/default/ci/trigger",
+                json={"context": str(code)},
+            )
+            assert resp.status == 201
+            return True
+
+        assert drive(orch, body)
+
+    def test_build_with_explicit_context_triggers_without_arg(
+        self, orch, tmp_path
+    ):
+        code = tmp_path / "code"
+        code.mkdir()
+        (code / "main.py").write_text("v1\n")
+        spec = {**CI_SPEC, "build": {"context": str(code)}}
+
+        async def body(client):
+            resp = await client.put(
+                "/api/v1/projects/default/ci", json={"spec": spec}
+            )
+            assert resp.status == 201
+            assert (await resp.json())["spec"]["build"]["context"] == str(code)
+            resp = await client.post("/api/v1/projects/default/ci/trigger")
+            assert resp.status == 201
+            return True
+
+        assert drive(orch, body)

@@ -222,6 +222,57 @@ class TestAuthAndDashboard:
 
         assert asyncio.run(runner())
 
+    def test_ws_subprotocol_never_reflects_token(self, orch):
+        """Regression: the WS handshake used to echo the client's whole
+        subprotocol offer — including the ``bearer.<token>`` auth carrier —
+        back in the Sec-WebSocket-Protocol RESPONSE header, where proxies
+        and devtools log it.  The server must select only the fixed
+        ``bearer`` name (auth still reads the token from the REQUEST)."""
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def runner():
+            app = create_app(orch, auth_token="sekret")
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                run = await (
+                    await client.post(
+                        "/api/v1/runs",
+                        json={"spec": SPEC},
+                        headers={"Authorization": "Bearer sekret"},
+                    )
+                ).json()
+                ws = await client.ws_connect(
+                    f"/ws/v1/runs/{run['id']}/logs",
+                    protocols=("bearer", "bearer.sekret"),
+                )
+                try:
+                    assert ws.protocol == "bearer"
+                    hdr = ws._response.headers.get("Sec-WebSocket-Protocol", "")
+                    assert "sekret" not in hdr
+                finally:
+                    await ws.close()
+                # A bad token in the subprotocol is still rejected — the
+                # server reads auth from the request offer either way.
+                from aiohttp import WSServerHandshakeError
+
+                try:
+                    bad = await client.ws_connect(
+                        f"/ws/v1/runs/{run['id']}/logs",
+                        protocols=("bearer", "bearer.wrong"),
+                    )
+                    await bad.close()
+                    raise AssertionError("bad token accepted")
+                except WSServerHandshakeError as e:
+                    assert e.status == 401
+            finally:
+                await client.close()
+            return True
+
+        assert asyncio.run(runner())
+
     def test_dashboard_served(self, orch):
         async def body(client):
             resp = await client.get("/")

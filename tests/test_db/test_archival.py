@@ -179,6 +179,94 @@ class TestDelete:
         assert dev["run_id"] is None
 
 
+class TestCascadeRaceRegression:
+    """A child born WHILE archive/restore/delete walks the family must not
+    escape the cascade.  The family walk used to run outside the write
+    lock — a trial created between the walk and the UPDATE stayed live
+    under an archived group (and survived the group's delete).  The walk
+    now runs inside ``_lock`` + BEGIN IMMEDIATE and re-walks to fixpoint,
+    which we exercise by having the first walk itself spawn a child."""
+
+    @staticmethod
+    def _sneak_child(reg, parent_id):
+        # Raw SQL on the registry's own per-thread connection: calling
+        # create_run here would deadlock on the non-reentrant write lock
+        # the caller (archive/delete) already holds.
+        import json
+        import time as time_mod
+        import uuid as uuid_mod
+
+        now = time_mod.time()
+        cur = reg._conn().execute(
+            """INSERT INTO runs (uuid, kind, name, project, spec, status,
+                                 group_id, pipeline_id, original_id,
+                                 cloning_strategy, tags, created_at, updated_at)
+               VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)""",
+            (
+                uuid_mod.uuid4().hex,
+                "experiment",
+                None,
+                "default",
+                json.dumps(SPEC),
+                S.CREATED,
+                parent_id,
+                None,
+                None,
+                None,
+                json.dumps([]),
+                now,
+                now,
+            ),
+        )
+        return cur.lastrowid
+
+    def _race_first_walk(self, reg, monkeypatch, parent_id):
+        """Monkeypatch ``_family_ids`` so the FIRST walk triggers a
+        concurrent-looking child insert; returns the child id holder."""
+        born = {}
+        orig = reg._family_ids
+        calls = {"n": 0}
+
+        def racy(run_id):
+            out = orig(run_id)
+            calls["n"] += 1
+            if calls["n"] == 1:
+                born["id"] = self._sneak_child(reg, parent_id)
+            return out
+
+        monkeypatch.setattr(reg, "_family_ids", racy)
+        return born
+
+    def test_archive_catches_child_born_mid_walk(self, reg, monkeypatch):
+        group = reg.create_run({**SPEC, "kind": "group"})
+        t1 = reg.create_run(dict(SPEC), group_id=group.id)
+        born = self._race_first_walk(reg, monkeypatch, group.id)
+        assert reg.archive_run(group.id)
+        assert "id" in born
+        # The mid-walk child is archived WITH its family, not stranded live.
+        assert reg.get_run(born["id"]).archived_at is not None
+        assert reg.get_run(t1.id).archived_at is not None
+
+    def test_delete_catches_child_born_mid_walk(self, reg, monkeypatch):
+        group = reg.create_run({**SPEC, "kind": "group"})
+        t1 = reg.create_run(dict(SPEC), group_id=group.id)
+        born = self._race_first_walk(reg, monkeypatch, group.id)
+        victims = reg.delete_run(group.id)
+        assert {v.id for v in victims} == {group.id, t1.id, born["id"]}
+        with pytest.raises(RegistryError):
+            reg.get_run(born["id"])
+
+    def test_restore_catches_child_born_mid_walk(self, reg, monkeypatch):
+        group = reg.create_run({**SPEC, "kind": "group"})
+        reg.archive_run(group.id)
+        born = self._race_first_walk(reg, monkeypatch, group.id)
+        assert reg.restore_run(group.id)
+        # The child was born un-archived and stays so; the point is the
+        # walk inside the lock saw it without deadlocking or crashing.
+        assert reg.get_run(born["id"]).archived_at is None
+        assert reg.get_run(group.id).archived_at is None
+
+
 class TestProjectDeletion:
     def test_refuses_with_live_runs_then_cascades_archived(self, reg):
         reg.create_project("vision")

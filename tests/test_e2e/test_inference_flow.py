@@ -129,10 +129,29 @@ class TestInferenceService:
             sampled = json.load(r)
         assert len(sampled["tokens"]) == 2 and len(sampled["tokens"][0]) == 4
 
+        # Mixed-length prompts in one request are VALID now — the engine
+        # batches them per decode step (this used to be a 400).
+        mixed = urllib.request.Request(
+            f"{url}/generate",
+            data=json.dumps(
+                {"prompts": [[1, 2], [3], [4, 5, 6]], "max_new_tokens": 3}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(mixed, timeout=60) as r:
+            out = json.load(r)
+        assert [len(t) for t in out["tokens"]] == [3, 3, 3]
+
+        # The stats endpoint reports live engine occupancy.
+        with urllib.request.urlopen(f"{url}/v1/stats", timeout=30) as r:
+            stats = json.load(r)
+        assert stats["requests_finished"] >= 7
+        assert stats["slots"] >= 1 and "tokens_per_s" in stats
+
         # Bad requests are 400s, not server crashes.
         bad = urllib.request.Request(
             f"{url}/generate",
-            data=json.dumps({"prompts": [[1, 2], [3]]}).encode(),
+            data=json.dumps({"prompts": [[1, 999]]}).encode(),
         )
         try:
             urllib.request.urlopen(bad, timeout=30)

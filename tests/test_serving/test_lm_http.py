@@ -1,0 +1,161 @@
+"""The production LM HTTP front-end under concurrent load.
+
+Drives the EXACT handler ``lm_server`` installs (``_make_lm_handler``)
+over a real :class:`ServingEngine` on an ephemeral ThreadingHTTPServer —
+overlapping requests from many client threads must all come back
+correct (greedy parity per prompt) while sharing one decode loop.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.builtins.services import _make_lm_handler
+from polyaxon_tpu.models import TransformerConfig, decode, init_params
+from polyaxon_tpu.serving import ServingEngine
+
+CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    head_dim=8,
+    d_ff=64,
+    max_seq=48,
+    dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    engine = ServingEngine(params, CFG, slots=3, max_len=48).start()
+    handler = _make_lm_handler(
+        engine, CFG, {"checkpoint_step": None, "default_max_new": 8}
+    )
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield base, params
+    httpd.shutdown()
+    httpd.server_close()
+    engine.stop()
+
+
+def _post(base, payload, timeout=120):
+    req = urllib.request.Request(
+        base + "/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _ref(params, prompt, max_new):
+    out = decode.generate(
+        params, jnp.asarray([prompt]), CFG, max_new_tokens=max_new
+    )
+    return np.asarray(out)[0].tolist()
+
+
+class TestLMHttp:
+    def test_mixed_length_batch_in_one_request(self, server):
+        """One POST with mixed-length prompts — previously a 400, now the
+        whole point: each prompt is its own engine request."""
+        base, params = server
+        prompts = [[1, 2], [3], [4, 5, 6, 7]]
+        status, body = _post(
+            base, {"prompts": prompts, "max_new_tokens": 5}
+        )
+        assert status == 200
+        assert body["tokens"] == [_ref(params, p, 5) for p in prompts]
+        assert body["decode_tokens_per_s"] > 0
+
+    def test_overlapping_requests_share_the_engine(self, server):
+        """The ISSUE's concurrency bar: N client threads fire overlapping
+        requests; every response is greedy-parity correct."""
+        base, params = server
+        rng = np.random.default_rng(11)
+        jobs = [
+            ([int(x) for x in rng.integers(0, 64, t)], mn)
+            for t, mn in [(3, 9), (8, 5), (5, 12), (11, 4), (6, 7), (4, 10)]
+        ]
+        results = [None] * len(jobs)
+
+        def worker(i, prompt, mn):
+            results[i] = _post(
+                base, {"prompts": [prompt], "max_new_tokens": mn}
+            )
+
+        threads = [
+            threading.Thread(target=worker, args=(i, p, mn))
+            for i, (p, mn) in enumerate(jobs)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        for i, (prompt, mn) in enumerate(jobs):
+            status, body = results[i]
+            assert status == 200, body
+            assert body["tokens"] == [_ref(params, prompt, mn)], f"job {i}"
+
+    def test_stats_endpoint(self, server):
+        base, _ = server
+        status, body = _get(base, "/v1/stats")
+        assert status == 200
+        assert body["slots"] == 3
+        assert {"queue_depth", "slots_active", "tokens_per_s",
+                "decode_steps", "requests_finished"} <= set(body)
+
+    def test_healthz_reports_engine_occupancy(self, server):
+        base, _ = server
+        status, body = _get(base, "/healthz")
+        assert status == 200
+        assert body["ok"] is True
+        assert body["model"]["vocab_size"] == 64
+        assert body["engine"]["slots"] == 3
+
+    def test_bad_requests_are_400(self, server):
+        base, _ = server
+        for payload in (
+            {},  # missing prompts
+            {"prompts": [1, 2]},  # not a list of lists
+            {"prompts": []},  # empty
+            {"prompts": [[1, 999]]},  # out of vocab
+            {"prompts": [[1, 2]], "max_new_tokens": 0},
+            {"prompts": [[1] * 47], "max_new_tokens": 10},  # exceeds max_len
+        ):
+            status, body = _post(base, payload)
+            assert status == 400, payload
+            assert "error" in body
+
+    def test_unknown_paths_404(self, server):
+        base, _ = server
+        for make in (
+            lambda: urllib.request.Request(base + "/nope"),
+            lambda: urllib.request.Request(base + "/elsewhere", data=b"{}"),
+        ):
+            try:
+                with urllib.request.urlopen(make(), timeout=30) as resp:
+                    status = resp.status
+            except urllib.error.HTTPError as e:
+                status = e.code
+            assert status == 404
