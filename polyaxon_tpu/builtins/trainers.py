@@ -81,14 +81,26 @@ def _train_image_classifier(
       (``docs/guides/training-cifar10.md``).
     - no dataset — synthetic class-conditional images (deterministic from
       the seed), isolating compute+collectives from IO for benchmarks.
+
+    The hot loop is OVERLAPPED (see ``docs/pipeline.md``): host-side row
+    gathers run ``prefetch`` batches ahead on ``prefetch_workers`` threads,
+    the next batch's device placement is dispatched before the current
+    step is consumed, checkpoint saves are async, and loss logging drains
+    on a background thread — the device never waits on the host for any of
+    them.  ``prefetch: 0`` restores the fully synchronous loop
+    (byte-identical data stream; the A/B baseline).
     """
+    import itertools
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from polyaxon_tpu.parallel import template_for
     from polyaxon_tpu.runtime.data import global_batch_from_host_data
+    from polyaxon_tpu.runtime.pipeline import MetricsDrain, TrainPipeline
     from polyaxon_tpu.runtime.train import build_train_step
+    from polyaxon_tpu.tracking.profiling import StepClock, StepProfiler
 
     steps = int(ctx.get_param("steps", 20))
     batch_size = int(ctx.get_param("batch", 64))
@@ -96,6 +108,8 @@ def _train_image_classifier(
     n_classes = int(ctx.get_param("classes", 10))
     dataset = ctx.get_param("dataset")
     save_every = int(ctx.get_param("save_every", 0))
+    prefetch = int(ctx.get_param("prefetch", 2))
+    prefetch_workers = int(ctx.get_param("prefetch_workers", 2))
 
     mesh = ctx.mesh
     if mesh is None:
@@ -140,10 +154,8 @@ def _train_image_classifier(
             num_processes=ctx.num_processes,
             process_id=ctx.process_id,
         )
-        stream = reader.batches(start_step)
 
-        def next_batch():
-            local = next(stream)
+        def place(local):
             return global_batch_from_host_data(
                 {
                     "images": local["images"],
@@ -152,6 +164,14 @@ def _train_image_classifier(
                 ts.batch_sharding,
             )
 
+        # Host prefetch over gather thunks + device prefetch onto the
+        # step's batch sharding; each host prefetches only its own rows.
+        pipe = TrainPipeline(
+            reader.batch_tasks(start_step),
+            place,
+            prefetch=prefetch,
+            workers=prefetch_workers,
+        )
     else:
         # Synthetic class-conditional images (the fixture dataset's exact
         # recipe — shared helper so benchmark and fixture never diverge).
@@ -164,39 +184,66 @@ def _train_image_classifier(
         fixed = ts.place_batch(
             {"images": jnp.asarray(images), "labels": jnp.asarray(labels)}
         )
-
-        def next_batch():
-            return fixed
+        pipe = TrainPipeline(
+            itertools.repeat(fixed), prefetch=0, tasks=False
+        )
 
     acc_fn = jax.jit(lambda p, b: accuracy_fn(p, b, template, mesh))
-    t0 = time.time()
+    profiler = StepProfiler(
+        ctx.outputs_path or ".",
+        start_step=int(ctx.get_param("profile_start", -1)),
+        num_steps=int(ctx.get_param("profile_steps", 0)),
+    )
+    drain = MetricsDrain(lambda step, vals: ctx.log_metrics(step=step, **vals))
+    clock = StepClock()
     metrics = None
     batch = None
-    for i in range(start_step, steps):
-        batch = next_batch()
-        params, opt_state, metrics = ts.step(params, opt_state, batch, key)
-        if ctx.is_leader and (i % 10 == 0 or i == steps - 1):
-            ctx.log_metrics(step=i, loss=float(metrics["loss"]))
+    t0 = time.time()
+    clock.start()
+    try:
+        for i in range(start_step, steps):
+            profiler.on_step(i)
+            batch = next(pipe)
+            params, opt_state, metrics = ts.step(params, opt_state, batch, key)
+            if ctx.is_leader and (i % 10 == 0 or i == steps - 1):
+                # Push the device array; the drain thread does the host
+                # read — no sync in the dispatch path.
+                drain.push(i, {"loss": metrics["loss"]})
+            if ckpt is not None:
+                ckpt.save(i, params, opt_state)
+            clock.tick()
+        # Fence BEFORE timing: with async dispatch, steps are still
+        # executing when the loop exits — an unfenced clock read would
+        # overstate throughput.
+        jax.block_until_ready(params)
+        dt = time.time() - t0
+    finally:
+        profiler.close()
+        pipe.close()
+        drain.close()
         if ckpt is not None:
-            ckpt.save(i, params, opt_state)
-    if ckpt is not None:
-        ckpt.wait_until_finished()
-        ckpt.close()
+            ckpt.wait_until_finished()
+            ckpt.close()
     steps_run = steps - start_step
     if steps_run <= 0 or batch is None:
         if ctx.is_leader:
             ctx.log_text(f"{label}: nothing to do (checkpoint already at end)")
         return
-    dt = time.time() - t0
     # Every process must join the (global-array) accuracy computation —
     # leader-only dispatch would deadlock multi-host gangs.
     acc = float(acc_fn(params, batch))
     if ctx.is_leader:
         ips = steps_run * batch_size / dt
-        ctx.log_metrics(step=steps, accuracy=acc, images_per_s=ips)
+        clock.add("data_wait_s", pipe.data_wait_s)
+        if ckpt is not None:
+            clock.add("ckpt_block_s", ckpt.save_block_s)
+        stats = clock.summary()  # per-step means
+        ctx.log_metrics(step=steps, accuracy=acc, images_per_s=ips, **stats)
         ctx.log_text(
             f"{label} done: {steps} steps, strategy={template.name}, "
-            f"loss {float(metrics['loss']):.4f}, acc {acc:.3f}, {ips:.0f} img/s"
+            f"loss {float(metrics['loss']):.4f}, acc {acc:.3f}, {ips:.0f} img/s "
+            f"(data wait {pipe.data_wait_s * 1e3 / steps_run:.1f} ms/step, "
+            f"prefetch={'off' if prefetch <= 0 else prefetch})"
         )
 
 
@@ -479,45 +526,53 @@ def lm_train(ctx: Context) -> None:
         }
     )
 
-    t0 = time.time()
-    loss = None
-    from polyaxon_tpu.tracking.profiling import StepProfiler
+    from polyaxon_tpu.runtime.pipeline import MetricsDrain
+    from polyaxon_tpu.tracking.profiling import StepClock, StepProfiler
 
     profiler = StepProfiler(
         ctx.outputs_path or ".",
         start_step=int(ctx.get_param("profile_start", -1)),
         num_steps=int(ctx.get_param("profile_steps", 0)),
     )
+    # Metrics leave the loop as device arrays; a drain thread does the
+    # host reads — even logging steps no longer serialize dispatch.
+    drain = MetricsDrain(lambda step, vals: ctx.log_metrics(step=step, **vals))
+    clock = StepClock()
 
     metrics = None
-    for i in range(start_step, steps):
-        profiler.on_step(i)
-        params, opt_state, metrics = ts.step(params, opt_state, batch, key)
-        # Only sync to host on logging steps — a float() every step would
-        # serialize dispatch and understate throughput.
-        if ctx.is_leader and (i % 10 == 0 or i == steps - 1):
-            ctx.log_metrics(
-                step=i,
-                loss=float(metrics["loss"]),
-                grad_norm=float(metrics["grad_norm"]),
-            )
+    t0 = time.time()
+    clock.start()
+    try:
+        for i in range(start_step, steps):
+            profiler.on_step(i)
+            params, opt_state, metrics = ts.step(params, opt_state, batch, key)
+            if ctx.is_leader and (i % 10 == 0 or i == steps - 1):
+                drain.push(
+                    i,
+                    {"loss": metrics["loss"], "grad_norm": metrics["grad_norm"]},
+                )
+            if ckpt is not None:
+                ckpt.save(i, params, opt_state)  # async; fenced at close
+            clock.tick()
+        jax.block_until_ready(params)
+        dt = time.time() - t0
+    finally:
+        profiler.close()
+        drain.close()
         if ckpt is not None:
-            ckpt.save(i, params, opt_state)
-    profiler.close()
-    loss = float(metrics["loss"]) if metrics is not None else None
-    if ckpt is not None:
-        ckpt.wait_until_finished()
-        ckpt.close()
-    jax.block_until_ready(params)
-    dt = time.time() - t0
+            ckpt.wait_until_finished()
+            ckpt.close()
     steps_run = steps - start_step
     if steps_run <= 0:
         if ctx.is_leader:
             ctx.log_text("lm_train: nothing to do (checkpoint already at end)")
         return
+    loss = float(metrics["loss"]) if metrics is not None else None
     if ctx.is_leader:
         tps = steps_run * batch_size * seq / dt
-        ctx.log_metrics(step=steps, tokens_per_s=tps)
+        if ckpt is not None:
+            clock.add("ckpt_block_s", ckpt.save_block_s)
+        ctx.log_metrics(step=steps, tokens_per_s=tps, **clock.summary())
         ctx.log_text(
             f"lm_train done: {steps} steps, strategy={template.name}, "
             f"final loss {loss:.4f}, {tps:.0f} tokens/s"

@@ -1,4 +1,17 @@
 from polyaxon_tpu.runtime.env import EnvVars
 from polyaxon_tpu.runtime.mesh import build_mesh
+from polyaxon_tpu.runtime.pipeline import (
+    HostPrefetcher,
+    MetricsDrain,
+    TrainPipeline,
+    device_prefetch,
+)
 
-__all__ = ["EnvVars", "build_mesh"]
+__all__ = [
+    "EnvVars",
+    "build_mesh",
+    "HostPrefetcher",
+    "MetricsDrain",
+    "TrainPipeline",
+    "device_prefetch",
+]

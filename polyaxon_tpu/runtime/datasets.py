@@ -30,8 +30,9 @@ Any array names work; arrays must share a leading dim per shard.
 from __future__ import annotations
 
 import json
+import os
 from pathlib import Path
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -73,7 +74,14 @@ def register_dataset(
         "format": "npy",
         "shard_sizes": shard_sizes,
     }
-    (root / "meta.json").write_text(json.dumps(meta))
+    # meta.json is the commit record: it's written LAST (shards already on
+    # disk) and renamed into place atomically, so an interrupted
+    # registration leaves either no meta (unregistered, shard files are
+    # garbage) or a complete one — never a truncated json that readers
+    # half-accept.
+    tmp = root / "meta.json.tmp"
+    tmp.write_text(json.dumps(meta))
+    os.replace(tmp, root / "meta.json")
     return meta
 
 
@@ -84,7 +92,13 @@ def dataset_meta(data_dir: Union[str, Path], name: str) -> Dict[str, Any]:
             f"Dataset {name!r} not registered under {data_dir} "
             f"(expected {meta_path})"
         )
-    return json.loads(meta_path.read_text())
+    try:
+        return json.loads(meta_path.read_text())
+    except (OSError, json.JSONDecodeError) as exc:
+        raise PolyaxonTPUError(
+            f"Dataset {name!r} has an unreadable meta.json ({exc}) — "
+            f"re-register it"
+        ) from exc
 
 
 def list_datasets(data_dir: Union[str, Path]) -> List[Dict[str, Any]]:
@@ -93,7 +107,13 @@ def list_datasets(data_dir: Union[str, Path]) -> List[Dict[str, Any]]:
     if root.is_dir():
         for d in sorted(root.iterdir()):
             if (d / "meta.json").exists():
-                out.append({"name": d.name, **json.loads((d / "meta.json").read_text())})
+                try:
+                    out.append({"name": d.name, **dataset_meta(root, d.name)})
+                except PolyaxonTPUError:
+                    # A corrupt registration must not take down the whole
+                    # listing — skip it (dataset_meta still reports it
+                    # loudly to anyone addressing it by name).
+                    continue
     return out
 
 
@@ -165,6 +185,32 @@ class DatasetReader:
     def batches_per_epoch(self) -> int:
         return self.num_examples // self.global_batch
 
+    def _epoch_tasks(
+        self, epoch: int, start_batch: int = 0
+    ) -> Iterator[Callable[[], Dict[str, np.ndarray]]]:
+        """Zero-arg gather thunks for each batch of ``epoch``.
+
+        The cheap index arithmetic (permutation slice) runs here, on the
+        iterating thread; the expensive row gather runs when the thunk is
+        CALLED — which is what lets a prefetcher execute gathers on worker
+        threads while preserving this iterator's order.  Gathers are
+        read-only over the mmaps, so concurrent thunk calls are safe."""
+        rng = np.random.default_rng((self.seed, epoch))
+        perm = rng.permutation(self.num_examples)
+        per_host = self.global_batch // self.num_processes
+        lo = self.process_id * per_host
+        for b in range(start_batch, self.batches_per_epoch):
+            batch_idx = perm[b * self.global_batch : (b + 1) * self.global_batch]
+            local_idx = batch_idx[lo : lo + per_host]
+
+            def task(idx: np.ndarray = local_idx) -> Dict[str, np.ndarray]:
+                return {
+                    a: self._cast(a, self._gather(a, idx))
+                    for a in self.meta["arrays"]
+                }
+
+            yield task
+
     def epoch(
         self, epoch: int, start_batch: int = 0
     ) -> Iterator[Dict[str, np.ndarray]]:
@@ -172,17 +218,8 @@ class DatasetReader:
 
         Skipped batches cost only the (already computed) permutation — no
         row gathers, so a deep resume is O(1) per skipped batch."""
-        rng = np.random.default_rng((self.seed, epoch))
-        perm = rng.permutation(self.num_examples)
-        per_host = self.global_batch // self.num_processes
-        for b in range(start_batch, self.batches_per_epoch):
-            batch_idx = perm[b * self.global_batch : (b + 1) * self.global_batch]
-            lo = self.process_id * per_host
-            local_idx = batch_idx[lo : lo + per_host]
-            yield {
-                a: self._cast(a, self._gather(a, local_idx))
-                for a in self.meta["arrays"]
-            }
+        for task in self._epoch_tasks(epoch, start_batch):
+            yield task()
 
     def _gather(self, name: str, idx: np.ndarray) -> np.ndarray:
         """Rows ``idx`` (global order = shard order) of array ``name``.
@@ -200,10 +237,14 @@ class DatasetReader:
             out[mask] = shards[s][idx[mask] - self._starts[s]]
         return out
 
-    def batches(self, start_step: int = 0) -> Iterator[Dict[str, np.ndarray]]:
-        """Endless stream, resumable: ``start_step`` fast-forwards the
-        epoch/batch position without materializing skipped batches — a
-        resumed run sees exactly the data it would have seen."""
+    def batch_tasks(
+        self, start_step: int = 0
+    ) -> Iterator[Callable[[], Dict[str, np.ndarray]]]:
+        """Endless resumable stream of gather thunks (see
+        :meth:`_epoch_tasks`) — the source a :class:`~polyaxon_tpu.runtime
+        .pipeline.HostPrefetcher` consumes.  Same epoch/step arithmetic as
+        :meth:`batches`, so prefetched and synchronous streams are
+        byte-identical, including a mid-epoch resume."""
         bpe = self.batches_per_epoch
         if bpe == 0:
             raise PolyaxonTPUError(
@@ -212,9 +253,16 @@ class DatasetReader:
             )
         epoch, skip = divmod(start_step, bpe)
         while True:
-            yield from self.epoch(epoch, start_batch=skip)
+            yield from self._epoch_tasks(epoch, start_batch=skip)
             skip = 0
             epoch += 1
+
+    def batches(self, start_step: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        """Endless stream, resumable: ``start_step`` fast-forwards the
+        epoch/batch position without materializing skipped batches — a
+        resumed run sees exactly the data it would have seen."""
+        for task in self.batch_tasks(start_step):
+            yield task()
 
     def _cast(self, name: str, arr: np.ndarray) -> np.ndarray:
         want = self.dtype_overrides.get(name)

@@ -57,3 +57,46 @@ def annotate(name: str):
     import jax
 
     return jax.profiler.TraceAnnotation(name)
+
+
+class StepClock:
+    """Per-step wall/section accounting for the train hot loop.
+
+    Cheap enough to run every step (two ``perf_counter`` calls + dict
+    adds): ``tick()`` marks a step boundary and accumulates
+    ``step_wall_s``; ``add(name, seconds)`` folds in externally measured
+    sections (``data_wait_s`` from the pipeline, ``ckpt_block_s`` from the
+    checkpoint manager).  :meth:`summary` reports per-step MEANS — the
+    numbers the tracker surfaces so "where did the step go" is answerable
+    without a trace: a healthy overlapped loop shows ``data_wait_s`` and
+    ``ckpt_block_s`` ≪ ``step_wall_s``.
+    """
+
+    def __init__(self) -> None:
+        from time import perf_counter
+
+        self._clock = perf_counter
+        self.steps = 0
+        self.totals: dict = {"step_wall_s": 0.0}
+        self._last: Optional[float] = None
+
+    def start(self) -> None:
+        """Arm at loop entry (the first tick measures the first step)."""
+        self._last = self._clock()
+
+    def tick(self) -> None:
+        """Call once at the end of every step."""
+        now = self._clock()
+        if self._last is not None:
+            self.totals["step_wall_s"] += now - self._last
+            self.steps += 1
+        self._last = now
+
+    def add(self, name: str, seconds: float) -> None:
+        self.totals[name] = self.totals.get(name, 0.0) + seconds
+
+    def summary(self) -> dict:
+        """Per-step means, keyed by section name (empty if no steps ran)."""
+        if not self.steps:
+            return {}
+        return {k: v / self.steps for k, v in self.totals.items()}

@@ -89,6 +89,34 @@ class TestCheckpointManager:
         wq = restored["params"]["block"]["wq"]
         assert "tensor" in str(wq.sharding.spec)
 
+    def test_async_save_then_restore_sees_latest_step(self, tmp_path):
+        """The restore-side fence: a restore issued immediately after an
+        async save (no explicit wait) must observe that save complete."""
+        ts = make_state("ddp", {"data": 8})
+        params, opt = ts.init(jax.random.PRNGKey(0))
+        mgr = CheckpointManager(tmp_path / "ckpt", enable_async=True)
+        for step in range(3):
+            mgr.save(step, params, opt, force=True)
+        # No wait_until_finished here — restore() itself must fence.
+        fresh_params, fresh_opt = ts.init(jax.random.PRNGKey(1))
+        restored = mgr.restore(fresh_params, fresh_opt)
+        assert restored["step"] == 2
+        for a, b in zip(
+            jax.tree.leaves(params), jax.tree.leaves(restored["params"])
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # save() accounted its blocked time for the hot loop's ckpt_block_s.
+        assert mgr.saves == 3 and mgr.save_block_s > 0
+        mgr.close()
+
+    def test_latest_step_fences_inflight_saves(self, tmp_path):
+        ts = make_state("ddp", {"data": 8})
+        params, opt = ts.init(jax.random.PRNGKey(0))
+        mgr = CheckpointManager(tmp_path / "ckpt", enable_async=True)
+        mgr.save(7, params, opt, force=True)
+        assert mgr.latest_step() == 7  # visible without an explicit wait
+        mgr.close()
+
     def test_max_to_keep_prunes(self, tmp_path):
         ts = make_state("ddp", {"data": 8})
         params, opt = ts.init(jax.random.PRNGKey(0))

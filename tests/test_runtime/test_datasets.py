@@ -53,6 +53,31 @@ class TestRegistration:
         with pytest.raises(PolyaxonTPUError):
             dataset_meta(tmp_path, "nope")
 
+    def test_registration_commits_meta_atomically(self, tmp_path):
+        register_dataset(tmp_path, "toy", [{"x": np.arange(4)}])
+        # No tmp staging file left behind; the rename committed.
+        assert not (tmp_path / "toy" / "meta.json.tmp").exists()
+        assert (tmp_path / "toy" / "meta.json").exists()
+
+    def test_interrupted_registration_is_skipped_not_fatal(self, tmp_path):
+        register_dataset(tmp_path, "good", [{"x": np.arange(4)}])
+        # Simulate a crash mid-meta-write: shards on disk, truncated json.
+        bad = tmp_path / "bad"
+        bad.mkdir()
+        np.save(bad / "shard-00000.x.npy", np.arange(4))
+        (bad / "meta.json").write_text('{"num_examples": 4, "sha')
+        # Listing survives and skips the torn registration...
+        assert [d["name"] for d in list_datasets(tmp_path)] == ["good"]
+        # ...while addressing it by name fails loudly and typed.
+        with pytest.raises(PolyaxonTPUError, match="unreadable"):
+            dataset_meta(tmp_path, "bad")
+        # Re-registering over the wreckage heals it.
+        register_dataset(tmp_path, "bad", [{"x": np.arange(4)}])
+        assert sorted(d["name"] for d in list_datasets(tmp_path)) == [
+            "bad",
+            "good",
+        ]
+
 
 class TestHostShardedReads:
     def _register(self, tmp_path, n=64):
