@@ -20,6 +20,8 @@ from polyaxon_tpu.db.registry import Run, RunRegistry
 from polyaxon_tpu.events import EventTypes
 from polyaxon_tpu.exceptions import PolyaxonTPUError
 from polyaxon_tpu.orchestrator import Orchestrator
+from polyaxon_tpu.stats.metrics import PROMETHEUS_CONTENT_TYPE, render_prometheus
+from polyaxon_tpu.tracking.trace import chrome_trace
 
 logger = logging.getLogger(__name__)
 
@@ -154,6 +156,25 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 report["task_counters"] = counters
         code = 200 if report["healthy"] else 503
         return web.json_response(report, status=code)
+
+    @routes.get("/metrics")
+    async def prometheus_metrics(request):
+        # Prometheus scrape surface over the control plane's own stats
+        # backend (task throughput/latency histograms, watcher timings).
+        # Auth-gated like the rest of the API — scrape configs carry
+        # ``authorization: {credentials: <token>}``; only an in-memory
+        # backend has state to export (statsd/noop render a comment).
+        snapshot_fn = getattr(orch.stats, "snapshot", None)
+        if snapshot_fn is None:
+            body = f"# stats backend {type(orch.stats).__name__} keeps no in-process registry\n"
+        else:
+            body = render_prometheus(
+                snapshot_fn(), labels={"component": "control_plane"}
+            )
+        return web.Response(
+            body=body.encode("utf-8"),
+            headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
+        )
 
     # -- runs CRUD + actions --------------------------------------------------
     @routes.post(f"{API_PREFIX}/runs")
@@ -370,6 +391,15 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             limit=_int_param(request, "limit"),
         )
         return web.json_response({"results": rows})
+
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/timeline")
+    async def get_timeline(request):
+        # Cross-process gang timeline: tracer spans reported by every
+        # worker, assembled into Chrome-trace JSON (load in Perfetto or
+        # chrome://tracing; pid = gang process id).
+        run = _run_or_404(request)
+        spans = reg.get_spans(run.id, since_id=_int_param(request, "since_id", 0))
+        return web.json_response(chrome_trace(spans))
 
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/heartbeat")
     async def post_heartbeat(request):

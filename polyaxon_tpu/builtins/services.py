@@ -176,7 +176,30 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
 
         def do_GET(self):
             if self.path == "/v1/stats":
-                return self._json(200, engine.stats())
+                payload = engine.stats()
+                latency = engine.latency_summaries()
+                if latency:
+                    payload["latency"] = latency
+                return self._json(200, payload)
+            if self.path == "/metrics":
+                from polyaxon_tpu.stats.metrics import (
+                    PROMETHEUS_CONTENT_TYPE,
+                    render_prometheus,
+                )
+
+                snapshot_fn = getattr(engine.stats_registry, "snapshot", None)
+                if snapshot_fn is None:
+                    text = "# engine stats backend keeps no in-process registry\n"
+                else:
+                    text = render_prometheus(
+                        snapshot_fn(), labels={"component": "lm_server"}
+                    )
+                body = text.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                return self.wfile.write(body)
             if self.path not in ("/healthz", "/"):
                 return self._json(404, {"error": "not found"})
             stats = engine.stats()
@@ -253,7 +276,10 @@ def lm_server(ctx: Context) -> None:
       (prompts may have DIFFERENT lengths — each is its own engine
       request; the KV cache stores UNEXPANDED GQA heads).
     - ``GET /healthz`` → model/checkpoint metadata + engine occupancy.
-    - ``GET /v1/stats`` → queue depth, slot occupancy, tokens/s.
+    - ``GET /v1/stats`` → queue depth, slot occupancy, tokens/s, latency
+      percentiles (queue wait / TTFT / per-token decode).
+    - ``GET /metrics`` → Prometheus text exposition of the same
+      histograms (see docs/observability.md).
 
     Params: ``target`` (run uuid whose ``checkpoints/`` to serve — omit
     for fresh random weights, a load-testing double), the model-shape
@@ -266,6 +292,7 @@ def lm_server(ctx: Context) -> None:
     """
     import jax
 
+    from polyaxon_tpu import stats as stats_backends
     from polyaxon_tpu.models import TransformerConfig, decode, init_params
     from polyaxon_tpu.serving import ServingEngine
 
@@ -357,6 +384,9 @@ def lm_server(ctx: Context) -> None:
         mesh=mesh if template is not None else None,
         eos_id=int(eos_id) if eos_id is not None else None,
         seed=ctx.seed or 0,
+        # The process-wide registry: /metrics then also exports anything
+        # else this worker records (pipeline waits, task timings).
+        stats=stats_backends.get_stats(),
     ).start()
 
     from http.server import ThreadingHTTPServer

@@ -11,7 +11,21 @@ from __future__ import annotations
 
 import time
 
+from polyaxon_tpu.stats import get_stats
 from polyaxon_tpu.tracking import Context
+from polyaxon_tpu.tracking.trace import get_tracer
+
+
+def _percentile_metrics(run_stats, key: str, out_prefix: str) -> dict:
+    """Histogram percentiles for ``key`` as flat metric fields."""
+    summary = run_stats.summaries().get(key)
+    if not summary or not summary["count"]:
+        return {}
+    return {
+        f"{out_prefix}_p50": summary["p50"],
+        f"{out_prefix}_p95": summary["p95"],
+        f"{out_prefix}_p99": summary["p99"],
+    }
 
 
 def noop(ctx: Context) -> None:
@@ -196,22 +210,31 @@ def _train_image_classifier(
     )
     drain = MetricsDrain(lambda step, vals: ctx.log_metrics(step=step, **vals))
     clock = StepClock()
+    tracer = get_tracer()
+    run_stats = get_stats()
     metrics = None
     batch = None
     t0 = time.time()
     clock.start()
     try:
-        for i in range(start_step, steps):
-            profiler.on_step(i)
-            batch = next(pipe)
-            params, opt_state, metrics = ts.step(params, opt_state, batch, key)
-            if ctx.is_leader and (i % 10 == 0 or i == steps - 1):
-                # Push the device array; the drain thread does the host
-                # read — no sync in the dispatch path.
-                drain.push(i, {"loss": metrics["loss"]})
-            if ckpt is not None:
-                ckpt.save(i, params, opt_state)
-            clock.tick()
+        with tracer.span("train:loop", steps=steps - start_step):
+            for i in range(start_step, steps):
+                profiler.on_step(i)
+                with tracer.span("train:step", sample=tracer.hot_sample, step=i):
+                    batch = next(pipe)
+                    params, opt_state, metrics = ts.step(
+                        params, opt_state, batch, key
+                    )
+                if ctx.is_leader and (i % 10 == 0 or i == steps - 1):
+                    # Push the device array; the drain thread does the host
+                    # read — no sync in the dispatch path.
+                    drain.push(i, {"loss": metrics["loss"]})
+                if ckpt is not None:
+                    ckpt.save(i, params, opt_state)
+                step_dt = clock.tick()
+                if step_dt is not None:
+                    run_stats.timing("train.step_wall_s", step_dt)
+                run_stats.timing("train.data_wait_s", pipe.pop_data_wait_s())
         # Fence BEFORE timing: with async dispatch, steps are still
         # executing when the loop exits — an unfenced clock read would
         # overstate throughput.
@@ -237,7 +260,9 @@ def _train_image_classifier(
         clock.add("data_wait_s", pipe.data_wait_s)
         if ckpt is not None:
             clock.add("ckpt_block_s", ckpt.save_block_s)
+            run_stats.timing("train.ckpt_block_s", ckpt.save_block_s)
         stats = clock.summary()  # per-step means
+        stats.update(_percentile_metrics(run_stats, "train.step_wall_s", "step_wall_s"))
         ctx.log_metrics(step=steps, accuracy=acc, images_per_s=ips, **stats)
         ctx.log_text(
             f"{label} done: {steps} steps, strategy={template.name}, "
@@ -539,21 +564,29 @@ def lm_train(ctx: Context) -> None:
     drain = MetricsDrain(lambda step, vals: ctx.log_metrics(step=step, **vals))
     clock = StepClock()
 
+    tracer = get_tracer()
+    run_stats = get_stats()
     metrics = None
     t0 = time.time()
     clock.start()
     try:
-        for i in range(start_step, steps):
-            profiler.on_step(i)
-            params, opt_state, metrics = ts.step(params, opt_state, batch, key)
-            if ctx.is_leader and (i % 10 == 0 or i == steps - 1):
-                drain.push(
-                    i,
-                    {"loss": metrics["loss"], "grad_norm": metrics["grad_norm"]},
-                )
-            if ckpt is not None:
-                ckpt.save(i, params, opt_state)  # async; fenced at close
-            clock.tick()
+        with tracer.span("train:loop", steps=steps - start_step):
+            for i in range(start_step, steps):
+                profiler.on_step(i)
+                with tracer.span("train:step", sample=tracer.hot_sample, step=i):
+                    params, opt_state, metrics = ts.step(
+                        params, opt_state, batch, key
+                    )
+                if ctx.is_leader and (i % 10 == 0 or i == steps - 1):
+                    drain.push(
+                        i,
+                        {"loss": metrics["loss"], "grad_norm": metrics["grad_norm"]},
+                    )
+                if ckpt is not None:
+                    ckpt.save(i, params, opt_state)  # async; fenced at close
+                step_dt = clock.tick()
+                if step_dt is not None:
+                    run_stats.timing("train.step_wall_s", step_dt)
         jax.block_until_ready(params)
         dt = time.time() - t0
     finally:
@@ -572,7 +605,10 @@ def lm_train(ctx: Context) -> None:
         tps = steps_run * batch_size * seq / dt
         if ckpt is not None:
             clock.add("ckpt_block_s", ckpt.save_block_s)
-        ctx.log_metrics(step=steps, tokens_per_s=tps, **clock.summary())
+            run_stats.timing("train.ckpt_block_s", ckpt.save_block_s)
+        stats = clock.summary()
+        stats.update(_percentile_metrics(run_stats, "train.step_wall_s", "step_wall_s"))
+        ctx.log_metrics(step=steps, tokens_per_s=tps, **stats)
         ctx.log_text(
             f"lm_train done: {steps} steps, strategy={template.name}, "
             f"final loss {loss:.4f}, {tps:.0f} tokens/s"

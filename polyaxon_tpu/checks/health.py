@@ -83,10 +83,12 @@ def run_health_checks(orch, include_devices: bool = False) -> Dict[str, Any]:
 def task_counter_snapshot(orch, top: int = 20) -> Dict[str, int]:
     """Top task counters from an in-memory stats backend ({} otherwise).
 
-    Snapshots the dict before sorting: the bus thread inserts keys
+    Uses the backend's locked ``snapshot()``: the bus thread inserts keys
     concurrently and iterating the live mapping would race.
     """
-    counters = getattr(getattr(orch, "stats", None), "counters", None)
-    if not counters:
+    stats = getattr(orch, "stats", None)
+    snapshot = getattr(stats, "snapshot", None)
+    if snapshot is None:
         return {}
-    return dict(sorted(dict(counters).items(), key=lambda kv: -kv[1])[:top])
+    counters = snapshot().get("counters") or {}
+    return dict(sorted(counters.items(), key=lambda kv: -kv[1])[:top])

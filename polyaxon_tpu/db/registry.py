@@ -108,6 +108,22 @@ CREATE TABLE IF NOT EXISTS logs (
 );
 CREATE INDEX IF NOT EXISTS ix_logs_run ON logs (run_id);
 
+CREATE TABLE IF NOT EXISTS spans (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_id INTEGER NOT NULL,
+    process_id INTEGER,
+    trace_id TEXT,
+    span_id TEXT,
+    parent_id TEXT,
+    name TEXT NOT NULL,
+    thread TEXT,
+    start REAL NOT NULL,
+    duration REAL NOT NULL,
+    attrs TEXT,
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_spans_run ON spans (run_id);
+
 CREATE TABLE IF NOT EXISTS heartbeats (
     run_id INTEGER PRIMARY KEY,
     last_at REAL NOT NULL
@@ -613,6 +629,7 @@ class RunRegistry:
                 ("statuses", "run_id"),
                 ("metrics", "run_id"),
                 ("logs", "run_id"),
+                ("spans", "run_id"),
                 ("heartbeats", "run_id"),
                 ("processes", "run_id"),
                 ("bookmarks", "run_id"),
@@ -786,6 +803,85 @@ class RunRegistry:
             sql += f" LIMIT {int(limit)}"
         rows = self._conn().execute(sql, params).fetchall()
         return [dict(r) for r in rows]
+
+    # -- spans ----------------------------------------------------------------
+    def add_span(
+        self,
+        run_id: int,
+        span: Dict[str, Any],
+        process_id: Optional[int] = None,
+    ) -> None:
+        """Store one finished tracer span (a ``span`` report event).
+
+        ``span`` is the record shape tracking/trace.py emits — unknown
+        keys are folded into ``attrs`` so the channel can grow fields
+        without a schema change."""
+        known = {
+            "name",
+            "trace_id",
+            "span_id",
+            "parent_id",
+            "thread",
+            "start",
+            "duration",
+            "process_id",
+            "attrs",
+        }
+        attrs = dict(span.get("attrs") or {})
+        for key, value in span.items():
+            if key not in known and key not in ("type", "ts"):
+                attrs[key] = value
+        if process_id is None:
+            process_id = span.get("process_id")
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO spans
+                   (run_id, process_id, trace_id, span_id, parent_id, name,
+                    thread, start, duration, attrs, created_at)
+                   VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)""",
+                (
+                    run_id,
+                    process_id,
+                    span.get("trace_id"),
+                    span.get("span_id"),
+                    span.get("parent_id"),
+                    str(span.get("name") or "span"),
+                    span.get("thread"),
+                    float(span.get("start") or 0.0),
+                    float(span.get("duration") or 0.0),
+                    json.dumps(attrs) if attrs else None,
+                    time.time(),
+                ),
+            )
+
+    def get_spans(
+        self,
+        run_id: int,
+        *,
+        process_id: Optional[int] = None,
+        since_id: int = 0,
+        limit: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        """Spans for a run ordered by wall-clock start (timeline order)."""
+        sql = (
+            "SELECT id, process_id, trace_id, span_id, parent_id, name,"
+            " thread, start, duration, attrs, created_at"
+            " FROM spans WHERE run_id = ? AND id > ?"
+        )
+        params: List[Any] = [run_id, since_id]
+        if process_id is not None:
+            sql += " AND process_id = ?"
+            params.append(process_id)
+        sql += " ORDER BY start, id"
+        if limit is not None:
+            sql += f" LIMIT {int(limit)}"
+        rows = self._conn().execute(sql, params).fetchall()
+        out: List[Dict[str, Any]] = []
+        for r in rows:
+            span = dict(r)
+            span["attrs"] = json.loads(span["attrs"]) if span["attrs"] else {}
+            out.append(span)
+        return out
 
     # -- heartbeats -----------------------------------------------------------
     def ping_heartbeat(self, run_id: int, at: Optional[float] = None) -> None:
@@ -1265,7 +1361,12 @@ class RunRegistry:
                    (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
                 (cutoff, cutoff),
             ).rowcount
-        return {"activity": act, "logs": logs}
+            spans = conn.execute(
+                """DELETE FROM spans WHERE created_at < ? AND run_id IN
+                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
+                (cutoff, cutoff),
+            ).rowcount
+        return {"activity": act, "logs": logs, "spans": spans}
 
     # -- projects (entity metadata over runs.project) --------------------------
     def create_project(

@@ -16,17 +16,41 @@ import threading
 from typing import Any, Dict, Optional
 
 
+# psutil computes cpu_percent(interval=None) against the PREVIOUS call on
+# the same Process object — a fresh object's first call always returns
+# 0.0.  Keep one Process per sampled pid so every call after the first
+# measures a real interval; the priming call reports no cpu row at all
+# instead of a fabricated zero.
+_proc_cache: Dict[int, Any] = {}
+_proc_cache_lock = threading.Lock()
+
+
 def sample_process(pid: Optional[int] = None) -> Dict[str, float]:
     """CPU / memory of the given (default: calling) process."""
     out: Dict[str, float] = {}
+    key = -1 if pid is None else pid
     try:
         import psutil
 
-        p = psutil.Process(pid)
-        with p.oneshot():
-            out["sys/cpu_percent"] = p.cpu_percent(interval=None)
-            out["sys/rss_mb"] = p.memory_info().rss / 1e6
-            out["sys/threads"] = float(p.num_threads())
+        with _proc_cache_lock:
+            p = _proc_cache.get(key)
+            primed = p is not None
+            if p is None:
+                p = psutil.Process(pid)
+                _proc_cache[key] = p
+        try:
+            with p.oneshot():
+                cpu = p.cpu_percent(interval=None)
+                if primed:
+                    out["sys/cpu_percent"] = cpu
+                out["sys/rss_mb"] = p.memory_info().rss / 1e6
+                out["sys/threads"] = float(p.num_threads())
+        except Exception:
+            # Target gone (or pid reused): drop the cached handle so a
+            # later process with the same pid re-primes cleanly.
+            with _proc_cache_lock:
+                _proc_cache.pop(key, None)
+            raise
     except Exception:
         if pid is not None:
             return out  # target process gone; report nothing rather than self
@@ -119,6 +143,9 @@ class ResourceSampler:
     def start(self) -> None:
         if self._thread is not None or self.interval <= 0:
             return
+        # Prime the per-process cpu_percent window now (unreported), so
+        # the first row the loop emits measures a real interval.
+        sample_process(self.pid)
 
         def loop() -> None:
             while not self._stop.wait(self.interval):

@@ -20,6 +20,7 @@ from polyaxon_tpu.db.registry import RunRegistry
 from polyaxon_tpu.lifecycles import StatusOptions as S
 from polyaxon_tpu.lifecycles.registry import gang_status
 from polyaxon_tpu.spawner.local import GangHandle
+from polyaxon_tpu.tracking.trace import get_tracer
 
 logger = logging.getLogger(__name__)
 
@@ -72,6 +73,8 @@ class GangWatcher:
             self.registry.add_metric(run_id, event.get("values") or {}, step=event.get("step"))
         elif etype == "log":
             self.registry.add_log(run_id, event.get("line", ""), process_id=process_id)
+        elif etype == "span":
+            self.registry.add_span(run_id, event, process_id=process_id)
         elif etype == "heartbeat":
             self.registry.ping_heartbeat(run_id, at=event.get("ts"))
         elif etype == "service":
@@ -127,6 +130,12 @@ class GangWatcher:
 
     def observe(self, handle: GangHandle) -> Optional[str]:
         """One poll: ingest reports, reconcile liveness, return gang roll-up."""
-        self.ingest(handle)
-        statuses = self.reconcile(handle)
-        return gang_status(statuses)
+        tracer = get_tracer()
+        # Polls are frequent (per-run monitor interval) — sample like a
+        # hot-path span; control-plane spans stay in the ring buffer.
+        with tracer.span(
+            "watcher:observe", sample=tracer.hot_sample, run_id=handle.run_id
+        ):
+            self.ingest(handle)
+            statuses = self.reconcile(handle)
+            return gang_status(statuses)

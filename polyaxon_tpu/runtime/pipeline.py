@@ -31,6 +31,8 @@ from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
+from polyaxon_tpu.tracking.trace import get_tracer
+
 
 class _Done:
     """Queue sentinel: source exhausted (or raised — carries the error)."""
@@ -98,7 +100,7 @@ class HostPrefetcher:
                 if self._stop.is_set():
                     return
                 if self._tasks:
-                    fut = self._pool.submit(item)
+                    fut = self._pool.submit(self._traced(item))
                 else:
                     fut = Future()
                     fut.set_result(item)
@@ -108,6 +110,17 @@ class HostPrefetcher:
             self._put(_Done())
         except BaseException as exc:  # source itself raised mid-iteration
             self._put(_Done(error=exc))
+
+    @staticmethod
+    def _traced(task: Callable[[], Any]) -> Callable[[], Any]:
+        """Wrap a gather task in a (hot-rate-sampled) tracer span."""
+        tracer = get_tracer()
+
+        def run() -> Any:
+            with tracer.span("pipeline:gather", sample=tracer.hot_sample):
+                return task()
+
+        return run
 
     # -- consumer side --------------------------------------------------------
     def __iter__(self) -> "HostPrefetcher":
@@ -281,8 +294,10 @@ class MetricsDrain:
                 return
             step, values = got
             try:
-                host = {k: float(np.asarray(v)) for k, v in values.items()}
-                self._emit(step, host)
+                tracer = get_tracer()
+                with tracer.span("pipeline:drain", sample=tracer.hot_sample):
+                    host = {k: float(np.asarray(v)) for k, v in values.items()}
+                    self._emit(step, host)
                 self.last, self.last_step = host, step
             except BaseException as exc:
                 if self._error is None:

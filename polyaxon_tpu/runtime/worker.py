@@ -99,6 +99,16 @@ def main() -> int:
     info = GangInfo.from_env()
     paths = RunPaths(Path(info.run_dir)).ensure()
     reporter = Reporter(paths.report_file(info.process_id), info.process_id)
+    # Route this process's tracer spans through the report channel: the
+    # watcher ingests them and the control plane assembles the
+    # cross-process timeline (GET /api/v1/runs/<id>/timeline).
+    from polyaxon_tpu.tracking import trace
+
+    tracer = trace.configure(
+        sink=reporter.span,
+        process_id=info.process_id,
+        trace_id=info.run_uuid or None,
+    )
     reporter.status("starting")
     reporter.start_heartbeat(info.heartbeat_interval)
     from polyaxon_tpu.monitor.resources import ResourceSampler
@@ -135,12 +145,13 @@ def main() -> int:
             # Shell command path: the distributed bootstrap belongs to the
             # command itself (it can read the same env contract).
             reporter.status("running")
-            rc = _run_cmd(
-                run_cfg.cmd,
-                env=dict(os.environ),
-                cwd=str(code_dir if code_dir.exists() else paths.root),
-                sampler=sampler,
-            )
+            with tracer.span("worker:cmd"):
+                rc = _run_cmd(
+                    run_cfg.cmd,
+                    env=dict(os.environ),
+                    cwd=str(code_dir if code_dir.exists() else paths.root),
+                    sampler=sampler,
+                )
             if rc == 0:
                 reporter.status("succeeded")
                 return 0
@@ -148,7 +159,8 @@ def main() -> int:
             return 1
 
         # Python entrypoint path: managed distributed world + mesh.
-        distributed = _init_distributed(info)
+        with tracer.span("worker:distributed_init", hosts=info.num_processes):
+            distributed = _init_distributed(info)
         sampler.start()
 
         # The mesh is a THUNK: entrypoints that never read ctx.mesh (metric
@@ -187,7 +199,8 @@ def main() -> int:
         fn = getattr(module, fn_name)
 
         reporter.status("running")
-        fn(ctx)
+        with tracer.span("worker:entrypoint", entrypoint=run_cfg.entrypoint):
+            fn(ctx)
 
         if distributed:
             import jax

@@ -211,7 +211,12 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
                 },
             )
         try:
-            handle = ctx.spawner.start(run, plan)
+            from polyaxon_tpu.tracking.trace import get_tracer
+
+            with get_tracer().span(
+                "gang:spawn", run_id=run_id, hosts=plan.num_hosts
+            ):
+                handle = ctx.spawner.start(run, plan)
         except Exception as e:  # disk-full/permission OSErrors included —
             # anything escaping here would strand the run in SCHEDULED,
             # a status the zombie cron never scans.

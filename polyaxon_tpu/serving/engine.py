@@ -42,6 +42,9 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from polyaxon_tpu.stats import MemoryStats
+from polyaxon_tpu.tracking.trace import get_tracer
+
 
 class GenerationRequest:
     """One queued generation: its prompt, its budget, and its results.
@@ -134,6 +137,11 @@ class ServingEngine:
         GSPMD propagates the sharding through prefill and the step.
     eos_id : optional token id that retires a slot early.
     seed : RNG seed for the sampling path (greedy ignores it).
+    stats : a stats backend receiving latency histograms
+        (``serving.queue_wait_s`` / ``serving.ttft_s`` /
+        ``serving.decode_step_s`` / ``serving.batch_occupancy``);
+        defaults to a private :class:`MemoryStats` — ``lm_server`` passes
+        the process-wide registry so ``/metrics`` exports them.
     """
 
     #: Prompt-length padding buckets: powers of two bound the number of
@@ -158,6 +166,7 @@ class ServingEngine:
         qweights_shardings: Optional[Any] = None,
         eos_id: Optional[int] = None,
         seed: int = 0,
+        stats: Optional[Any] = None,
     ) -> None:
         import jax
 
@@ -203,7 +212,10 @@ class ServingEngine:
         self._insert_fns: Dict[int, Any] = {}
         self._step_fn = self._build_step()
 
-        # Stats: lifetime counters plus a sliding window for tokens/s.
+        # Stats: lifetime counters plus a sliding window for tokens/s;
+        # latency distributions go to the (possibly shared) histogram
+        # registry so /metrics can export percentiles.
+        self.stats_registry = stats if stats is not None else MemoryStats()
         self._stats_lock = threading.Lock()
         self._n_submitted = 0
         self._n_finished = 0
@@ -364,9 +376,27 @@ class ServingEngine:
                 "max_len": self.max_len,
             }
 
+    def latency_summaries(self) -> Dict[str, Dict[str, float]]:
+        """Histogram summaries (count/mean/p50/p95/p99) per latency key."""
+        summaries_fn = getattr(self.stats_registry, "summaries", None)
+        if summaries_fn is None:
+            return {}
+        wanted = {
+            "serving.queue_wait_s": "queue_wait_s",
+            "serving.ttft_s": "ttft_s",
+            "serving.decode_step_s": "decode_step_s",
+            "serving.batch_occupancy": "batch_occupancy",
+        }
+        out: Dict[str, Dict[str, float]] = {}
+        for key, summary in summaries_fn().items():
+            if key in wanted:
+                out[wanted[key]] = {k: round(v, 6) for k, v in summary.items()}
+        return out
+
     # -- scheduler loop --------------------------------------------------------
 
     def _loop(self) -> None:
+        tracer = get_tracer()
         while not self._stop.is_set():
             self._admit()
             if not self._active.any():
@@ -375,7 +405,11 @@ class ServingEngine:
                         self._cv.wait(timeout=0.2)
                 continue
             try:
-                self._step_once()
+                # Per-iteration span, sampled at the hot rate: the decode
+                # loop runs per generated token, full tracing would cost
+                # more than the histograms it duplicates.
+                with tracer.span("serving:step", sample=tracer.hot_sample):
+                    self._step_once()
             except Exception as e:  # fail in-flight requests, keep serving
                 for slot in np.nonzero(self._active)[0]:
                     self._fail_slot(int(slot), f"decode step failed: {e!r}")
@@ -391,7 +425,11 @@ class ServingEngine:
                     return
                 req = self._queue.popleft()
             try:
-                self._prefill_into(slot, req)
+                tracer = get_tracer()
+                with tracer.span(
+                    "serving:admit", sample=tracer.hot_sample, request_id=req.id
+                ):
+                    self._prefill_into(slot, req)
             except Exception as e:
                 self._slot_req[slot] = None
                 self.allocator.free(slot)
@@ -403,6 +441,9 @@ class ServingEngine:
         import jax.numpy as jnp
 
         req.started_at = time.time()
+        self.stats_registry.timing(
+            "serving.queue_wait_s", req.started_at - req.submitted_at
+        )
         t = len(req.prompt)
         t_pad = self._bucket(t, self.max_len)
         padded = np.zeros((1, t_pad), np.int32)
@@ -414,6 +455,8 @@ class ServingEngine:
             self._cache, jnp.int32(slot), k, v
         )
         first = self._pick_first(np.asarray(last_logits), req.temperature)
+        # Time-to-first-token: prefill produced it, the client can read it.
+        self.stats_registry.timing("serving.ttft_s", time.time() - req.submitted_at)
         self._slot_req[slot] = req
         self._emit(slot, req, first)
         if not req.done.is_set():
@@ -437,6 +480,7 @@ class ServingEngine:
         import jax
         import jax.numpy as jnp
 
+        t0 = time.perf_counter()
         self._key, sub = jax.random.split(self._key)
         toks, self._cache = self._step_fn(
             self._params,
@@ -460,6 +504,12 @@ class ServingEngine:
         with self._stats_lock:
             self._n_steps += 1
             self._window.append((time.time(), n_live))
+        # The step advances every live slot one token, so its wall time IS
+        # the per-token decode latency each of those requests observed.
+        self.stats_registry.timing(
+            "serving.decode_step_s", time.perf_counter() - t0
+        )
+        self.stats_registry.observe("serving.batch_occupancy", float(n_live))
 
     def _emit(self, slot: int, req: GenerationRequest, tok: int) -> None:
         """Record one generated token; retire the slot when done."""

@@ -1,3 +1,40 @@
-from polyaxon_tpu.stats.backends import MemoryStats, NoOpStats, StatsBackend, StatsdStats
+import threading
 
-__all__ = ["MemoryStats", "NoOpStats", "StatsBackend", "StatsdStats"]
+from polyaxon_tpu.stats.backends import MemoryStats, NoOpStats, StatsBackend, StatsdStats
+from polyaxon_tpu.stats.metrics import (
+    PROMETHEUS_CONTENT_TYPE,
+    Histogram,
+    default_buckets,
+    render_prometheus,
+)
+
+__all__ = [
+    "MemoryStats",
+    "NoOpStats",
+    "StatsBackend",
+    "StatsdStats",
+    "Histogram",
+    "default_buckets",
+    "render_prometheus",
+    "PROMETHEUS_CONTENT_TYPE",
+    "get_stats",
+]
+
+_default_stats = None
+_default_stats_lock = threading.Lock()
+
+
+def get_stats() -> MemoryStats:
+    """Process-wide ``MemoryStats`` registry.
+
+    Worker-side components that have no orchestrator to hand them a
+    backend (trainers, the serving engine inside ``lm_server``) record
+    here by default, so one ``/metrics`` scrape of the process sees all
+    of them.  The control plane keeps its own per-orchestrator backend.
+    """
+    global _default_stats
+    if _default_stats is None:
+        with _default_stats_lock:
+            if _default_stats is None:
+                _default_stats = MemoryStats()
+    return _default_stats

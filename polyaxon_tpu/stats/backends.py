@@ -9,10 +9,13 @@ with no dependency; the memory backend is for tests and the /status page.
 from __future__ import annotations
 
 import socket
+import threading
 import time
 from collections import defaultdict, deque
 from contextlib import contextmanager
-from typing import Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
+
+from polyaxon_tpu.stats.metrics import Histogram
 
 
 class StatsBackend:
@@ -24,6 +27,11 @@ class StatsBackend:
 
     def timing(self, key: str, seconds: float) -> None:  # pragma: no cover
         raise NotImplementedError
+
+    def observe(self, key: str, value: float) -> None:
+        """Record a distribution sample that is not a duration (e.g. batch
+        occupancy).  Default: treat like a timing so every backend sees it."""
+        self.timing(key, value)
 
     @contextmanager
     def timed(self, key: str):
@@ -44,32 +52,80 @@ class NoOpStats(StatsBackend):
     def timing(self, key: str, seconds: float) -> None:
         pass
 
+    def observe(self, key: str, value: float) -> None:
+        pass
+
 
 class MemoryStats(StatsBackend):
-    """In-process aggregation (tests + health/status introspection).
+    """In-process aggregation (tests + health/status + /metrics scrape).
 
     Timing samples are bounded per key (recent window) — this backend is
     the DEFAULT and instruments every task execution, so unbounded lists
-    would be a slow memory leak in a long-lived service.
+    would be a slow memory leak in a long-lived service.  Every timing and
+    ``observe`` also feeds a log-bucketed :class:`Histogram`, which holds
+    full-run percentiles in O(buckets) memory and renders directly as a
+    Prometheus histogram.
+
+    Mutated from many threads (bus workers, serving loop, HTTP handlers)
+    and read by iteration (health checks, the /metrics renderer) — all
+    access goes through one lock, and readers should use :meth:`snapshot`
+    rather than iterating the live dicts.
     """
 
     TIMING_WINDOW = 512
 
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self.counters: Dict[str, int] = defaultdict(int)
         self.gauges: Dict[str, float] = {}
         self.timings: Dict[str, deque] = defaultdict(
             lambda: deque(maxlen=self.TIMING_WINDOW)
         )
+        self.histograms: Dict[str, Histogram] = {}
 
     def incr(self, key: str, value: int = 1) -> None:
-        self.counters[key] += value
+        with self._lock:
+            self.counters[key] += value
 
     def gauge(self, key: str, value: float) -> None:
-        self.gauges[key] = value
+        with self._lock:
+            self.gauges[key] = value
 
     def timing(self, key: str, seconds: float) -> None:
-        self.timings[key].append(seconds)
+        with self._lock:
+            self.timings[key].append(seconds)
+            self._histogram(key).observe(seconds)
+
+    def observe(self, key: str, value: float) -> None:
+        """Histogram-only sample (no raw-window copy kept)."""
+        with self._lock:
+            self._histogram(key).observe(value)
+
+    def _histogram(self, key: str) -> Histogram:
+        hist = self.histograms.get(key)
+        if hist is None:
+            hist = self.histograms[key] = Histogram()
+        return hist
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Consistent copy of all state, safe to iterate/serialize.
+
+        The shape is what ``render_prometheus`` consumes: ``counters`` /
+        ``gauges`` as plain dicts, ``timings`` as lists, ``histograms`` as
+        ``Histogram.state()`` dicts.
+        """
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "timings": {k: list(v) for k, v in self.timings.items()},
+                "histograms": {k: h.state() for k, h in self.histograms.items()},
+            }
+
+    def summaries(self) -> Dict[str, Dict[str, float]]:
+        """Per-key histogram summaries (count/sum/mean/p50/p95/p99)."""
+        with self._lock:
+            return {k: h.summary() for k, h in self.histograms.items()}
 
 
 class StatsdStats(StatsBackend):
@@ -94,3 +150,8 @@ class StatsdStats(StatsBackend):
 
     def timing(self, key: str, seconds: float) -> None:
         self._send(f"{key}:{seconds * 1000:.2f}|ms")
+
+    def observe(self, key: str, value: float) -> None:
+        # dogstatsd histogram extension; plain statsd servers drop unknown
+        # types silently, which is the right failure mode here.
+        self._send(f"{key}:{value}|h")

@@ -1,4 +1,5 @@
 from polyaxon_tpu.tracking.context import Context
 from polyaxon_tpu.tracking.reporter import Reporter
+from polyaxon_tpu.tracking.trace import Tracer, chrome_trace, get_tracer
 
-__all__ = ["Context", "Reporter"]
+__all__ = ["Context", "Reporter", "Tracer", "chrome_trace", "get_tracer"]

@@ -84,13 +84,18 @@ class StepClock:
         """Arm at loop entry (the first tick measures the first step)."""
         self._last = self._clock()
 
-    def tick(self) -> None:
-        """Call once at the end of every step."""
+    def tick(self) -> Optional[float]:
+        """Call once at the end of every step; returns this step's wall
+        seconds (None on the unarmed first call) so callers can feed a
+        per-step histogram without a second clock read."""
         now = self._clock()
+        dt: Optional[float] = None
         if self._last is not None:
-            self.totals["step_wall_s"] += now - self._last
+            dt = now - self._last
+            self.totals["step_wall_s"] += dt
             self.steps += 1
         self._last = now
+        return dt
 
     def add(self, name: str, seconds: float) -> None:
         self.totals[name] = self.totals.get(name, 0.0) + seconds

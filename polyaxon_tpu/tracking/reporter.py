@@ -23,9 +23,22 @@ from typing import Any, Dict, Optional, Union
 class Reporter:
     """Append-only typed-line writer, safe for one writer per file."""
 
-    def __init__(self, path: Union[str, Path], process_id: int = 0) -> None:
+    # Event types that must survive a host crash: lifecycle transitions
+    # drive scheduling decisions, so they are fsynced to disk.  Everything
+    # else (metrics/logs/spans) is flushed to the OS only — losing the
+    # last few lines of telemetry on a power cut is fine, but an fsync per
+    # metric line serializes the train loop on disk latency.
+    FSYNC_TYPES = ("status",)
+
+    def __init__(
+        self,
+        path: Union[str, Path],
+        process_id: int = 0,
+        fsync_all: bool = False,
+    ) -> None:
         self.path = Path(path)
         self.process_id = process_id
+        self.fsync_all = fsync_all
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._fh = open(self.path, "a", encoding="utf-8")
         self._lock = threading.Lock()
@@ -37,7 +50,8 @@ class Reporter:
         with self._lock:
             self._fh.write(line + "\n")
             self._fh.flush()
-            os.fsync(self._fh.fileno())
+            if self.fsync_all or type_ in self.FSYNC_TYPES:
+                os.fsync(self._fh.fileno())
 
     # -- typed events ---------------------------------------------------------
     def status(self, status: str, message: Optional[str] = None) -> None:
@@ -55,6 +69,13 @@ class Reporter:
     def resources(self, values: Dict[str, Any]) -> None:
         """Telemetry samples (cpu/rss/HBM) — streamed like metrics."""
         self._emit("resources", values=values)
+
+    def span(self, record: Dict[str, Any]) -> None:
+        """Ship a finished tracer span (see tracking/trace.py) upstream.
+
+        Wired as the worker tracer's sink; the watcher ingests these into
+        the registry's ``spans`` table for the cross-process timeline."""
+        self._emit("span", **record)
 
     def service(
         self, *, url: Optional[str] = None, query: Optional[str] = None

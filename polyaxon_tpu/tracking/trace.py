@@ -1,0 +1,269 @@
+"""Lightweight structured span tracing across the gang.
+
+The reference stack stops at StatsD counters + Sentry (SURVEY §5); it has
+no way to answer "where did this step/request/trial spend its time" across
+the control plane, the gang workers, and the serving engine.  This module
+is the worker-side half of that answer:
+
+- :class:`Tracer` hands out ``span(name, **attrs)`` context managers that
+  record wall-clock start (``time.time()``, so spans from different hosts
+  line up on one timeline) and a ``perf_counter`` duration, plus
+  trace/span/parent ids maintained per thread for nesting.
+- Finished spans land in a thread-safe ring buffer and, when a ``sink`` is
+  configured (the worker wires ``Reporter.span``), ship through the
+  existing report channel as a typed ``span`` event.  ``GangWatcher``
+  ingests those into the registry, and the control plane exports the
+  cross-process timeline as Chrome-trace JSON (:func:`chrome_trace`,
+  served at ``GET /api/v1/runs/<id>/timeline``).
+- Sampling is decided *before* any ids or timestamps are taken: a
+  sampled-out ``span()`` call returns a shared no-op context manager, so
+  hot-path call sites (per step / per decode tick, gated on
+  ``tracer.hot_sample``) cost about as much as a ``perf_counter`` call.
+
+Process-wide singleton: library code calls :func:`get_tracer` and never
+configures it; the worker entrypoint calls :func:`configure` once with the
+report sink, its process id, and the run uuid.  Control-plane spans stay
+buffer-only (no sink) unless something attaches one.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+__all__ = ["Tracer", "get_tracer", "configure", "chrome_trace"]
+
+_UNSET = object()
+
+
+class _NoopSpan:
+    """Shared zero-state stand-in yielded when a span is sampled out."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+    def set(self, **attrs: Any) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    """A live (sampled-in) span; created by :meth:`Tracer.span`."""
+
+    __slots__ = ("_tracer", "name", "attrs", "span_id", "parent_id", "_t0", "_p0")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: Dict[str, Any]) -> None:
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.span_id = ""
+        self.parent_id: Optional[str] = None
+        self._t0 = 0.0
+        self._p0 = 0.0
+
+    def set(self, **attrs: Any) -> None:
+        """Attach attributes discovered while the span body runs."""
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "_Span":
+        tracer = self._tracer
+        stack = tracer._stack()
+        self.parent_id = stack[-1] if stack else None
+        self.span_id = "%d.%x" % (tracer.process_id, next(tracer._ids))
+        stack.append(self.span_id)
+        self._t0 = time.time()
+        self._p0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        duration = time.perf_counter() - self._p0
+        stack = self._tracer._stack()
+        if stack and stack[-1] == self.span_id:
+            stack.pop()
+        record: Dict[str, Any] = {
+            "name": self.name,
+            "trace_id": self._tracer.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start": self._t0,
+            "duration": duration,
+            "process_id": self._tracer.process_id,
+            "thread": threading.current_thread().name,
+        }
+        if exc_type is not None:
+            self.attrs["error"] = getattr(exc_type, "__name__", str(exc_type))
+        if self.attrs:
+            record["attrs"] = self.attrs
+        self._tracer._record(record)
+        return False
+
+
+class Tracer:
+    """Thread-safe span recorder with a bounded ring buffer.
+
+    ``sample`` gates ordinary spans, ``hot_sample`` is the conventional
+    rate call sites use for per-step/per-token spans (pass it explicitly:
+    ``tracer.span("train:step", sample=tracer.hot_sample)``).  Both are
+    env-tunable so a run can be re-launched fully traced without a code
+    change.
+    """
+
+    def __init__(
+        self,
+        *,
+        sink: Optional[Callable[[Dict[str, Any]], None]] = None,
+        sample: float = 1.0,
+        hot_sample: float = 0.05,
+        buffer: int = 2048,
+        process_id: int = 0,
+        trace_id: Optional[str] = None,
+    ) -> None:
+        self.sink = sink
+        self.sample = sample
+        self.hot_sample = hot_sample
+        self.process_id = process_id
+        self.trace_id = trace_id
+        self._buffer: deque = deque(maxlen=buffer)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._ids = itertools.count(1)
+        self._rng = random.Random()
+
+    # -- configuration ------------------------------------------------------
+
+    def configure(
+        self,
+        *,
+        sink: Any = _UNSET,
+        sample: Any = _UNSET,
+        hot_sample: Any = _UNSET,
+        process_id: Any = _UNSET,
+        trace_id: Any = _UNSET,
+    ) -> "Tracer":
+        """Update settings in place (unset arguments keep current values)."""
+        if sink is not _UNSET:
+            self.sink = sink
+        if sample is not _UNSET:
+            self.sample = float(sample)
+        if hot_sample is not _UNSET:
+            self.hot_sample = float(hot_sample)
+        if process_id is not _UNSET:
+            self.process_id = int(process_id)
+        if trace_id is not _UNSET:
+            self.trace_id = trace_id
+        return self
+
+    # -- recording ----------------------------------------------------------
+
+    def span(self, name: str, sample: Optional[float] = None, **attrs: Any):
+        """Context manager timing ``name``; sampled-out calls are ~free."""
+        rate = self.sample if sample is None else sample
+        if rate < 1.0 and (rate <= 0.0 or self._rng.random() >= rate):
+            return _NOOP
+        return _Span(self, name, attrs)
+
+    def _stack(self) -> List[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _record(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            self._buffer.append(record)
+        sink = self.sink
+        if sink is not None:
+            try:
+                sink(record)
+            except Exception:
+                pass  # a broken sink must never take down the traced code
+
+    def spans(self) -> List[Dict[str, Any]]:
+        """Snapshot of the ring buffer, oldest first."""
+        with self._lock:
+            return list(self._buffer)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buffer.clear()
+
+
+_tracer = Tracer(
+    sample=float(os.environ.get("POLYAXON_TPU_TRACE_SAMPLE", "1.0")),
+    hot_sample=float(os.environ.get("POLYAXON_TPU_TRACE_HOT_SAMPLE", "0.05")),
+)
+
+
+def get_tracer() -> Tracer:
+    """The process-wide tracer (unconfigured: buffer-only, no sink)."""
+    return _tracer
+
+
+def configure(**kwargs: Any) -> Tracer:
+    """Configure the process-wide tracer (see :meth:`Tracer.configure`)."""
+    return _tracer.configure(**kwargs)
+
+
+def chrome_trace(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Render span records as Chrome-trace / Perfetto JSON.
+
+    Each span becomes a complete ("ph": "X") event; timestamps are the
+    original wall-clock epoch in microseconds, so spans reported by
+    different gang processes land on one shared timeline.  Rows are keyed
+    (pid=process_id, tid=per-process thread index) with thread_name
+    metadata so the viewer labels each track.
+    """
+    events: List[Dict[str, Any]] = []
+    tids: Dict[Any, int] = {}
+    per_pid: Dict[int, int] = {}
+    for span in spans:
+        pid = int(span.get("process_id") or 0)
+        thread = str(span.get("thread") or "main")
+        key = (pid, thread)
+        tid = tids.get(key)
+        if tid is None:
+            tid = per_pid.get(pid, 0) + 1
+            per_pid[pid] = tid
+            tids[key] = tid
+            events.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": thread},
+                }
+            )
+        args: Dict[str, Any] = {}
+        attrs = span.get("attrs")
+        if isinstance(attrs, dict):
+            args.update(attrs)
+        for field in ("trace_id", "span_id", "parent_id"):
+            value = span.get(field)
+            if value:
+                args[field] = value
+        event: Dict[str, Any] = {
+            "name": str(span.get("name") or "span"),
+            "ph": "X",
+            "cat": "span",
+            "pid": pid,
+            "tid": tid,
+            "ts": float(span.get("start") or 0.0) * 1e6,
+            "dur": float(span.get("duration") or 0.0) * 1e6,
+        }
+        if args:
+            event["args"] = args
+        events.append(event)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -108,11 +108,16 @@ class TaskBus:
 
     # -- execution ------------------------------------------------------------
     def _run_one(self, name: str, kwargs: Dict[str, Any], retries: int) -> None:
+        from polyaxon_tpu.tracking.trace import get_tracer
+
         fn = self._tasks[name]
         t0 = time.perf_counter()
         outcome = "ok"
         try:
-            fn(**kwargs)
+            # Control-plane spans stay in the tracer's ring buffer (no
+            # sink) — a cheap flight recorder of recent task executions.
+            with get_tracer().span(f"task:{name}"):
+                fn(**kwargs)
         except Retry as r:
             outcome = "retry"
             if retries + 1 > self.max_retries:

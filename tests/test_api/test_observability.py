@@ -1,0 +1,176 @@
+"""The two new observability surfaces: ``GET /metrics`` (Prometheus text
+exposition over the control plane's stats registry) and
+``GET /api/v1/runs/<id>/timeline`` (Chrome-trace JSON over ingested
+tracer spans) — including the end-to-end path through a real gang.
+"""
+
+import asyncio
+import re
+
+import pytest
+
+from polyaxon_tpu.api.app import create_app
+from polyaxon_tpu.orchestrator import Orchestrator
+from polyaxon_tpu.stats import PROMETHEUS_CONTENT_TYPE
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(
+        tmp_path / "plat",
+        monitor_interval=0.05,
+        heartbeat_interval=0.2,
+        heartbeat_ttl=30.0,
+    )
+    yield o
+    o.stop()
+
+
+def drive(orch, coro_fn, auth_token=None):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        app = create_app(orch, auth_token=auth_token)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+async def _wait_done(orch, client, run_id, timeout=60.0):
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        await loop.run_in_executor(None, orch.pump, 0.05)
+        resp = await client.get(f"/api/v1/runs/{run_id}")
+        data = await resp.json()
+        if data["is_done"]:
+            return data
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"run {run_id} not done after {timeout}s")
+
+
+def _histogram_series(text, name):
+    """(bucket values in order, count, sum) for one histogram metric."""
+    buckets = [
+        float(m.group(1))
+        for m in re.finditer(rf"^{name}_bucket\{{[^}}]*\}} (\S+)$", text, re.M)
+    ]
+    count = float(re.search(rf"^{name}_count\S* (\S+)$", text, re.M).group(1))
+    total = float(re.search(rf"^{name}_sum\S* (\S+)$", text, re.M).group(1))
+    return buckets, count, total
+
+
+class TestMetricsEndpoint:
+    def test_prometheus_exposition_with_histograms(self, orch):
+        orch.stats.incr("tasks.succeeded", 2)
+        orch.stats.gauge("scheduler.queue_depth", 3)
+        for v in (0.002, 0.004, 0.02, 1.3):
+            orch.stats.timing("task.wall_s", v)
+
+        async def body(client):
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == PROMETHEUS_CONTENT_TYPE
+            return await resp.text()
+
+        text = drive(orch, body)
+        assert 'component="control_plane"' in text
+        assert re.search(
+            r"^polyaxon_tpu_tasks_succeeded_total\{[^}]*\} 2$", text, re.M
+        )
+        assert "# TYPE polyaxon_tpu_task_wall_s histogram" in text
+        buckets, count, total = _histogram_series(text, "polyaxon_tpu_task_wall_s")
+        assert buckets == sorted(buckets), "le buckets must be cumulative"
+        assert buckets[-1] == count == 4
+        assert total == pytest.approx(0.002 + 0.004 + 0.02 + 1.3)
+
+    def test_metrics_requires_auth_when_enabled(self, orch):
+        orch.stats.incr("tasks.succeeded")
+
+        async def body(client):
+            resp = await client.get("/metrics")
+            assert resp.status == 401
+            ok = await client.get(
+                "/metrics", headers={"Authorization": "Bearer sekrit"}
+            )
+            assert ok.status == 200
+            assert "polyaxon_tpu_tasks_succeeded_total" in await ok.text()
+            return True
+
+        assert drive(orch, body, auth_token="sekrit")
+
+
+class TestTimelineEndpoint:
+    def test_timeline_renders_spans_from_two_processes(self, orch):
+        async def body(client):
+            run = await (await client.post("/api/v1/runs", json={"spec": SPEC})).json()
+            for pid, (name, start) in enumerate(
+                [("worker:entrypoint", 10.0), ("worker:entrypoint", 10.5)]
+            ):
+                orch.registry.add_span(
+                    run["id"],
+                    {
+                        "name": name,
+                        "trace_id": run["uuid"],
+                        "span_id": f"{pid}.1",
+                        "parent_id": None,
+                        "start": start,
+                        "duration": 2.0,
+                        "process_id": pid,
+                        "thread": "MainThread",
+                        "attrs": {"entrypoint": "m:f"},
+                    },
+                )
+            resp = await client.get(f"/api/v1/runs/{run['id']}/timeline")
+            assert resp.status == 200
+            doc = await resp.json()
+            xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+            assert {e["pid"] for e in xs} == {0, 1}
+            assert all(e["dur"] == pytest.approx(2e6) for e in xs)
+            assert doc["displayTimeUnit"] == "ms"
+            return True
+
+        assert drive(orch, body)
+
+    def test_timeline_404_for_unknown_run(self, orch):
+        async def body(client):
+            resp = await client.get("/api/v1/runs/999/timeline")
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)
+
+    def test_end_to_end_gang_spans_reach_timeline(self, orch):
+        """A real (noop) gang run: the worker's tracer ships spans through
+        the reporter file, the watcher ingests them, and the timeline
+        endpoint serves them back as Chrome-trace events."""
+
+        async def body(client):
+            run = await (await client.post("/api/v1/runs", json={"spec": SPEC})).json()
+            await _wait_done(orch, client, run["id"])
+            doc = await (
+                await client.get(f"/api/v1/runs/{run['id']}/timeline")
+            ).json()
+            xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+            names = {e["name"] for e in xs}
+            assert "worker:entrypoint" in names, names
+            # Spans from the worker carry the run uuid as trace id.
+            entry = next(e for e in xs if e["name"] == "worker:entrypoint")
+            assert entry["args"]["trace_id"] == run["uuid"]
+            assert entry["dur"] > 0
+            return True
+
+        assert drive(orch, body)

@@ -1,0 +1,131 @@
+"""Serving latency observability: the engine's scalar stats become
+histograms in a shared registry, summarized by ``latency_summaries()``
+and scraped through the lm handler's ``/metrics`` route.
+"""
+
+import json
+import re
+import threading
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.builtins.services import _make_lm_handler
+from polyaxon_tpu.models import TransformerConfig, init_params
+from polyaxon_tpu.serving import ServingEngine
+from polyaxon_tpu.stats import MemoryStats, PROMETHEUS_CONTENT_TYPE
+
+CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    head_dim=8,
+    d_ff=64,
+    max_seq=48,
+    dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _run_requests(engine, n=3):
+    rng = np.random.default_rng(7)
+    reqs = [
+        engine.submit(list(rng.integers(0, CFG.vocab_size, 4)), 5)
+        for _ in range(n)
+    ]
+    for r in reqs:
+        r.wait(timeout=120)
+
+
+class TestEngineLatencyHistograms:
+    def test_histograms_populated_per_request_and_step(self, params):
+        registry = MemoryStats()
+        engine = ServingEngine(
+            params, CFG, slots=2, max_len=48, stats=registry
+        ).start()
+        try:
+            _run_requests(engine, n=3)
+        finally:
+            engine.stop()
+        snap = registry.snapshot()
+        hists = snap["histograms"]
+        # One observation per admitted request...
+        assert hists["serving.queue_wait_s"]["count"] == 3
+        assert hists["serving.ttft_s"]["count"] == 3
+        # ...and one per decode step, matching the engine's own counter.
+        steps = engine.stats()["decode_steps"]
+        assert steps > 0
+        assert hists["serving.decode_step_s"]["count"] == steps
+        assert hists["serving.batch_occupancy"]["count"] == steps
+        assert hists["serving.ttft_s"]["sum"] > 0
+
+    def test_latency_summaries_shape(self, params):
+        registry = MemoryStats()
+        engine = ServingEngine(
+            params, CFG, slots=2, max_len=48, stats=registry
+        ).start()
+        try:
+            _run_requests(engine, n=2)
+            summaries = engine.latency_summaries()
+        finally:
+            engine.stop()
+        for key in ("queue_wait_s", "ttft_s", "decode_step_s", "batch_occupancy"):
+            assert key in summaries, summaries.keys()
+            s = summaries[key]
+            assert s["count"] > 0
+            assert s["p50"] <= s["p95"] <= s["p99"]
+
+    def test_private_registry_by_default(self, params):
+        engine = ServingEngine(params, CFG, slots=2, max_len=48)
+        assert isinstance(engine.stats_registry, MemoryStats)
+
+
+class TestLmMetricsRoute:
+    @pytest.fixture()
+    def server(self, params):
+        engine = ServingEngine(params, CFG, slots=2, max_len=48).start()
+        handler = _make_lm_handler(
+            engine, CFG, {"checkpoint_step": None, "default_max_new": 8}
+        )
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        yield f"http://127.0.0.1:{httpd.server_address[1]}", engine
+        httpd.shutdown()
+        httpd.server_close()
+        engine.stop()
+
+    def test_metrics_route_serves_prometheus_text(self, server):
+        base, engine = server
+        _run_requests(engine, n=2)
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == PROMETHEUS_CONTENT_TYPE
+            text = resp.read().decode("utf-8")
+        assert 'component="lm_server"' in text
+        assert "# TYPE polyaxon_tpu_serving_ttft_s histogram" in text
+        buckets = [
+            float(m.group(1))
+            for m in re.finditer(
+                r"^polyaxon_tpu_serving_ttft_s_bucket\{[^}]*\} (\S+)$", text, re.M
+            )
+        ]
+        assert buckets and buckets == sorted(buckets)
+        assert buckets[-1] == 2.0  # +Inf bucket == request count
+
+    def test_stats_payload_gains_latency_block(self, server):
+        base, engine = server
+        _run_requests(engine, n=1)
+        with urllib.request.urlopen(base + "/v1/stats", timeout=30) as resp:
+            payload = json.loads(resp.read())
+        assert "latency" in payload
+        assert payload["latency"]["ttft_s"]["count"] >= 1

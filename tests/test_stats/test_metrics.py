@@ -1,0 +1,243 @@
+"""Histogram math + Prometheus text-exposition correctness.
+
+The exposition format is a wire contract (scraped by real Prometheus
+servers), so the tests pin the parts a sloppy renderer gets wrong:
+bucket cumulativity, ``+Inf`` == ``_count``, ``_sum`` consistency, and
+label-value escaping.
+"""
+
+import math
+import re
+import threading
+
+import pytest
+
+from polyaxon_tpu.stats import (
+    Histogram,
+    MemoryStats,
+    default_buckets,
+    render_prometheus,
+)
+
+
+class TestHistogram:
+    def test_bucket_assignment_le_semantics(self):
+        h = Histogram(edges=[1.0, 2.0, 4.0])
+        for v in (0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 100.0):
+            h.observe(v)
+        # le semantics: a value equal to an edge lands IN that bucket.
+        assert h.counts == [2, 2, 2, 1]  # last slot = +Inf overflow
+        assert h.count == 7
+        assert h.sum == pytest.approx(0.5 + 1.0 + 1.5 + 2.0 + 3.0 + 4.0 + 100.0)
+
+    def test_cumulative_is_monotone_and_ends_at_count_minus_overflow(self):
+        h = Histogram(edges=[1.0, 2.0, 4.0])
+        for v in (0.5, 3.0, 9.0, 9.0):
+            h.observe(v)
+        cum = h.cumulative()
+        assert cum == [1, 1, 2]
+        assert all(a <= b for a, b in zip(cum, cum[1:]))
+        # +Inf bucket (== count) holds the overflow observations too.
+        assert h.count == 4
+
+    def test_quantiles_bracket_the_data(self):
+        h = Histogram()
+        for _ in range(100):
+            h.observe(0.01)
+        s = h.summary()
+        assert s["count"] == 100
+        # 0.01 lives in the (0.0064, 0.0128] bucket: the estimate must
+        # land inside it.
+        assert 0.0064 <= s["p50"] <= 0.0128
+        assert 0.0064 <= s["p99"] <= 0.0128
+        assert s["mean"] == pytest.approx(0.01)
+
+    def test_quantile_ordering(self):
+        h = Histogram()
+        for i in range(1, 1001):
+            h.observe(i / 1000.0)  # 1ms .. 1s spread
+        s = h.summary()
+        assert s["p50"] <= s["p95"] <= s["p99"]
+        assert s["p50"] > 0
+
+    def test_empty_histogram_summary(self):
+        s = Histogram().summary()
+        assert s["count"] == 0 and s["sum"] == 0.0 and s["p99"] == 0.0
+
+    def test_bad_edges_rejected(self):
+        with pytest.raises(ValueError):
+            Histogram(edges=[])
+        with pytest.raises(ValueError):
+            Histogram(edges=[1.0, 1.0])
+        with pytest.raises(ValueError):
+            Histogram(edges=[2.0, 1.0])
+
+    def test_default_buckets_geometric(self):
+        edges = default_buckets()
+        assert len(edges) == 20
+        assert edges[0] == pytest.approx(1e-4)
+        for a, b in zip(edges, edges[1:]):
+            assert b == pytest.approx(a * 2.0)
+
+    def test_state_is_a_copy(self):
+        h = Histogram(edges=[1.0])
+        h.observe(0.5)
+        state = h.state()
+        state["counts"][0] = 999
+        state["edges"][0] = 999.0
+        assert h.counts[0] == 1 and h.edges[0] == 1.0
+
+
+def _parse_samples(text):
+    """name -> [(labels_str, float value)] for non-comment lines."""
+    out = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (.+)$", line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, labels, value = m.groups()
+        out.setdefault(name, []).append((labels or "", float(value)))
+    return out
+
+
+class TestRenderPrometheus:
+    def test_counter_gauge_histogram_sections(self):
+        stats = MemoryStats()
+        stats.incr("tasks.succeeded", 3)
+        stats.gauge("queue.depth", 7)
+        for v in (0.5, 1.5, 9.0):
+            stats.timing("step.wall", v)
+        text = render_prometheus(stats.snapshot())
+        samples = _parse_samples(text)
+        assert samples["polyaxon_tpu_tasks_succeeded_total"] == [("", 3.0)]
+        assert samples["polyaxon_tpu_queue_depth"] == [("", 7.0)]
+        assert "# TYPE polyaxon_tpu_step_wall histogram" in text
+        assert samples["polyaxon_tpu_step_wall_count"] == [("", 3.0)]
+        assert samples["polyaxon_tpu_step_wall_sum"][0][1] == pytest.approx(11.0)
+
+    def test_histogram_buckets_cumulative_and_inf_equals_count(self):
+        stats = MemoryStats()
+        for v in (1e-4, 0.01, 0.5, 60.0, 120.0):  # 60/120 overflow defaults
+            stats.timing("lat", v)
+        text = render_prometheus(stats.snapshot(), prefix="p")
+        buckets = _parse_samples(text)["p_lat_bucket"]
+        values = [v for _, v in buckets]
+        assert values == sorted(values), "buckets must be cumulative"
+        inf = [v for labels, v in buckets if 'le="+Inf"' in labels]
+        assert inf == [5.0]
+        count = _parse_samples(text)["p_lat_count"][0][1]
+        assert inf[0] == count
+
+    def test_count_sum_consistent_with_observations(self):
+        stats = MemoryStats()
+        obs = [0.001, 0.002, 0.004, 1.0]
+        for v in obs:
+            stats.observe("h", v)
+        samples = _parse_samples(render_prometheus(stats.snapshot(), prefix="x"))
+        assert samples["x_h_count"][0][1] == len(obs)
+        assert samples["x_h_sum"][0][1] == pytest.approx(sum(obs))
+        # Largest finite bucket <= +Inf bucket == _count.
+        finite = [v for labels, v in samples["x_h_bucket"] if "+Inf" not in labels]
+        assert max(finite) <= samples["x_h_count"][0][1]
+
+    def test_label_value_escaping(self):
+        stats = MemoryStats()
+        stats.incr("c")
+        text = render_prometheus(
+            stats.snapshot(),
+            prefix="p",
+            labels={"weird": 'a\\b"c\nd'},
+        )
+        assert '\\\\b' in text and '\\"c' in text and "\\nd" in text
+        assert "\nd" not in text.replace("\\nd", "")  # no raw newline leaks
+
+    def test_metric_name_sanitization(self):
+        stats = MemoryStats()
+        stats.incr("task.noop-run/latency")
+        text = render_prometheus(stats.snapshot(), prefix="p")
+        assert "p_task_noop_run_latency_total" in text
+        # All exposed names must be valid Prometheus identifiers.
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            name = line.split("{")[0].split(" ")[0]
+            assert re.match(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$", name), name
+
+    def test_value_formatting(self):
+        stats = MemoryStats()
+        stats.gauge("inf", float("inf"))
+        stats.gauge("whole", 4.0)
+        text = render_prometheus(stats.snapshot(), prefix="p")
+        assert "p_inf +Inf" in text
+        assert "p_whole 4" in text  # integral floats collapse
+
+    def test_labels_on_every_sample(self):
+        stats = MemoryStats()
+        stats.incr("a")
+        stats.gauge("b", 1)
+        stats.timing("c", 0.1)
+        text = render_prometheus(
+            stats.snapshot(), prefix="p", labels={"component": "lm_server"}
+        )
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            assert 'component="lm_server"' in line, line
+
+
+class TestMemoryStatsRegistry:
+    def test_timing_feeds_both_window_and_histogram(self):
+        stats = MemoryStats()
+        stats.timing("k", 0.25)
+        snap = stats.snapshot()
+        assert snap["timings"]["k"] == [0.25]
+        assert snap["histograms"]["k"]["count"] == 1
+
+    def test_observe_is_histogram_only(self):
+        stats = MemoryStats()
+        stats.observe("occupancy", 3.0)
+        snap = stats.snapshot()
+        assert "occupancy" not in snap["timings"]
+        assert snap["histograms"]["occupancy"]["count"] == 1
+
+    def test_snapshot_isolated_from_later_mutation(self):
+        stats = MemoryStats()
+        stats.incr("n")
+        stats.timing("t", 0.1)
+        snap = stats.snapshot()
+        stats.incr("n")
+        stats.timing("t", 0.2)
+        assert snap["counters"]["n"] == 1
+        assert snap["histograms"]["t"]["count"] == 1
+
+    def test_summaries_shape(self):
+        stats = MemoryStats()
+        for v in (0.01, 0.02, 0.04):
+            stats.timing("lat", v)
+        s = stats.summaries()["lat"]
+        assert s["count"] == 3
+        assert s["p50"] <= s["p95"] <= s["p99"]
+
+    def test_concurrent_mutation_loses_nothing(self):
+        stats = MemoryStats()
+        n_threads, n_iter = 8, 500
+
+        def work():
+            for _ in range(n_iter):
+                stats.incr("hits")
+                stats.timing("lat", 0.001)
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = stats.snapshot()
+        assert snap["counters"]["hits"] == n_threads * n_iter
+        assert snap["histograms"]["lat"]["count"] == n_threads * n_iter
+        assert sum(snap["histograms"]["lat"]["counts"]) == n_threads * n_iter
+        # The render must survive a live registry too.
+        text = render_prometheus(snap)
+        assert "polyaxon_tpu_hits_total" in text
+        assert not math.isnan(snap["histograms"]["lat"]["sum"])

@@ -1,0 +1,100 @@
+"""Reporter durability policy + the ``span`` event shape.
+
+fsync policy is the PR's train-loop latency fix: only lifecycle statuses
+pay the disk sync; telemetry (metrics/logs/spans) is flush-only unless
+``fsync_all`` opts back in.
+"""
+
+import json
+
+import pytest
+
+import polyaxon_tpu.tracking.reporter as reporter_mod
+from polyaxon_tpu.tracking.reporter import Reporter
+
+
+@pytest.fixture()
+def fsync_calls(monkeypatch):
+    calls = []
+    real = reporter_mod.os.fsync
+
+    def spy(fd):
+        calls.append(fd)
+        return real(fd)
+
+    monkeypatch.setattr(reporter_mod.os, "fsync", spy)
+    return calls
+
+
+def _lines(path):
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+class TestFsyncPolicy:
+    def test_status_fsyncs(self, tmp_path, fsync_calls):
+        r = Reporter(tmp_path / "p0.jsonl")
+        r.status("running")
+        assert len(fsync_calls) == 1
+        r.close()
+
+    def test_telemetry_does_not_fsync(self, tmp_path, fsync_calls):
+        r = Reporter(tmp_path / "p0.jsonl")
+        r.metric({"loss": 1.0}, step=1)
+        r.log("hello")
+        r.heartbeat()
+        r.resources({"cpu": 0.5})
+        r.span({"name": "s", "start": 1.0, "duration": 0.1})
+        assert fsync_calls == []
+        # ... but the lines are still flushed and readable immediately.
+        assert len(_lines(r.path)) == 5
+        r.close()
+
+    def test_error_status_fsyncs(self, tmp_path, fsync_calls):
+        r = Reporter(tmp_path / "p0.jsonl")
+        try:
+            raise RuntimeError("boom")
+        except RuntimeError as exc:
+            r.error(exc)
+        assert len(fsync_calls) == 1  # error() emits a status event
+        r.close()
+
+    def test_fsync_all_escape_hatch(self, tmp_path, fsync_calls):
+        r = Reporter(tmp_path / "p0.jsonl", fsync_all=True)
+        r.metric({"loss": 1.0})
+        r.log("x")
+        r.span({"name": "s"})
+        r.status("running")
+        assert len(fsync_calls) == 4
+        r.close()
+
+
+class TestSpanEvent:
+    def test_span_line_shape(self, tmp_path):
+        r = Reporter(tmp_path / "p0.jsonl", process_id=2)
+        record = {
+            "name": "worker:entrypoint",
+            "trace_id": "abc",
+            "span_id": "2.1",
+            "parent_id": None,
+            "start": 123.0,
+            "duration": 0.5,
+            "process_id": 2,
+            "thread": "MainThread",
+            "attrs": {"entrypoint": "m:f"},
+        }
+        r.span(record)
+        r.close()
+        (line,) = _lines(tmp_path / "p0.jsonl")
+        assert line["type"] == "span"
+        assert "ts" in line  # _emit stamps emission time alongside
+        for key, value in record.items():
+            assert line[key] == value
+
+    def test_span_rides_the_same_file_as_other_events(self, tmp_path):
+        r = Reporter(tmp_path / "p0.jsonl")
+        r.status("running")
+        r.span({"name": "s", "start": 1.0, "duration": 0.1})
+        r.metric({"loss": 2.0}, step=1)
+        r.close()
+        types = [l["type"] for l in _lines(tmp_path / "p0.jsonl")]
+        assert types == ["status", "span", "metric"]

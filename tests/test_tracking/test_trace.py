@@ -1,0 +1,219 @@
+"""Span tracer semantics: nesting, per-thread stacks, sampling, sinks.
+
+Every test builds its own :class:`Tracer` — the process-global one (from
+``get_tracer``) is shared with live instrumentation and must not be
+reconfigured by tests.
+"""
+
+import threading
+
+from polyaxon_tpu.tracking.trace import Tracer, chrome_trace, get_tracer
+
+
+def _spans_by_name(tracer):
+    return {s["name"]: s for s in tracer.spans()}
+
+
+class TestNesting:
+    def test_parent_child_ids(self):
+        t = Tracer(process_id=3)
+        with t.span("outer"):
+            with t.span("inner"):
+                pass
+        by_name = _spans_by_name(t)
+        outer, inner = by_name["outer"], by_name["inner"]
+        assert outer["parent_id"] is None
+        assert inner["parent_id"] == outer["span_id"]
+        assert inner["span_id"] != outer["span_id"]
+        # Ids carry the process id so they stay unique across the gang.
+        assert outer["span_id"].startswith("3.")
+        assert outer["process_id"] == 3
+
+    def test_children_close_before_parent(self):
+        t = Tracer()
+        with t.span("parent"):
+            with t.span("a"):
+                pass
+            with t.span("b"):
+                pass
+        names = [s["name"] for s in t.spans()]
+        assert names == ["a", "b", "parent"]  # completion order
+        by_name = _spans_by_name(t)
+        assert by_name["a"]["parent_id"] == by_name["parent"]["span_id"]
+        assert by_name["b"]["parent_id"] == by_name["parent"]["span_id"]
+
+    def test_siblings_after_child_pops(self):
+        """The second sibling must parent to the outer span, not to the
+        first sibling (the stack must actually pop)."""
+        t = Tracer()
+        with t.span("root"):
+            with t.span("s1"):
+                pass
+            with t.span("s2"):
+                pass
+        by_name = _spans_by_name(t)
+        assert by_name["s2"]["parent_id"] == by_name["root"]["span_id"]
+
+    def test_duration_and_start_recorded(self):
+        t = Tracer()
+        with t.span("timed"):
+            pass
+        span = t.spans()[0]
+        assert span["duration"] >= 0.0
+        assert span["start"] > 1e9  # epoch seconds, not perf_counter
+
+    def test_attrs_and_set(self):
+        t = Tracer()
+        with t.span("op", run_id=7) as sp:
+            sp.set(rows=42)
+        attrs = t.spans()[0]["attrs"]
+        assert attrs == {"run_id": 7, "rows": 42}
+
+    def test_exception_recorded_and_propagated(self):
+        t = Tracer()
+        try:
+            with t.span("boom"):
+                raise ValueError("nope")
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("span swallowed the exception")
+        assert t.spans()[0]["attrs"]["error"] == "ValueError"
+
+
+class TestThreads:
+    def test_per_thread_parent_stacks(self):
+        """Spans opened on different threads must not parent to each
+        other; nesting is tracked per thread."""
+        t = Tracer()
+        ready = threading.Barrier(2)
+
+        def work(label):
+            with t.span(f"outer-{label}"):
+                ready.wait(timeout=10)  # both outers open simultaneously
+                with t.span(f"inner-{label}"):
+                    pass
+
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(2)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        by_name = _spans_by_name(t)
+        for i in range(2):
+            inner, outer = by_name[f"inner-{i}"], by_name[f"outer-{i}"]
+            assert inner["parent_id"] == outer["span_id"]
+            assert outer["parent_id"] is None
+            assert inner["thread"] == outer["thread"]
+        assert by_name["inner-0"]["thread"] != by_name["inner-1"]["thread"]
+
+    def test_concurrent_recording_keeps_every_span(self):
+        t = Tracer(buffer=10_000)
+        n_threads, n_iter = 8, 200
+
+        def work():
+            for _ in range(n_iter):
+                with t.span("w"):
+                    pass
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        spans = t.spans()
+        assert len(spans) == n_threads * n_iter
+        assert len({s["span_id"] for s in spans}) == len(spans)
+
+
+class TestSamplingAndBuffer:
+    def test_sample_zero_is_noop(self):
+        t = Tracer(sample=0.0)
+        with t.span("dropped") as sp:
+            sp.set(ignored=True)  # no-op span still honours the API
+        assert t.spans() == []
+
+    def test_hot_sample_rate_is_per_call(self):
+        t = Tracer(sample=1.0, hot_sample=0.0)
+        with t.span("hot", sample=t.hot_sample):
+            pass
+        with t.span("cold"):
+            pass
+        assert [s["name"] for s in t.spans()] == ["cold"]
+
+    def test_ring_buffer_bounded(self):
+        t = Tracer(buffer=4)
+        for i in range(10):
+            with t.span(f"s{i}"):
+                pass
+        names = [s["name"] for s in t.spans()]
+        assert names == ["s6", "s7", "s8", "s9"]  # oldest evicted
+
+    def test_sink_receives_records(self):
+        got = []
+        t = Tracer(sink=got.append, trace_id="abc")
+        with t.span("shipped"):
+            pass
+        assert len(got) == 1
+        assert got[0]["name"] == "shipped" and got[0]["trace_id"] == "abc"
+
+    def test_broken_sink_never_raises(self):
+        def sink(_):
+            raise RuntimeError("sink down")
+
+        t = Tracer(sink=sink)
+        with t.span("survives"):
+            pass
+        # Record still lands in the buffer despite the sink exploding.
+        assert t.spans()[0]["name"] == "survives"
+
+    def test_configure_in_place(self):
+        t = Tracer()
+        t.configure(sample=0.0, process_id=5, trace_id="run-1")
+        assert t.sample == 0.0 and t.process_id == 5 and t.trace_id == "run-1"
+        t.configure(sample=1.0)  # unset args keep current values
+        assert t.process_id == 5 and t.trace_id == "run-1"
+
+    def test_global_tracer_singleton(self):
+        assert get_tracer() is get_tracer()
+
+
+class TestChromeTrace:
+    def test_events_and_thread_metadata(self):
+        t = Tracer(process_id=1)
+        with t.span("step", step=3):
+            pass
+        doc = chrome_trace(t.spans())
+        assert doc["displayTimeUnit"] == "ms"
+        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(metas) == 1 and metas[0]["name"] == "thread_name"
+        assert len(xs) == 1
+        x = xs[0]
+        assert x["name"] == "step" and x["pid"] == 1
+        assert x["tid"] == metas[0]["tid"]
+        assert x["ts"] > 1e15  # epoch µs
+        assert x["args"]["step"] == 3 and "span_id" in x["args"]
+
+    def test_multi_process_rows(self):
+        spans = []
+        for pid in (0, 1):
+            t = Tracer(process_id=pid)
+            with t.span("work"):
+                pass
+            spans.extend(t.spans())
+        doc = chrome_trace(spans)
+        pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert pids == {0, 1}
+
+    def test_tids_stable_per_thread(self):
+        t = Tracer()
+        with t.span("a"):
+            pass
+        with t.span("b"):
+            pass
+        doc = chrome_trace(t.spans())
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert xs[0]["tid"] == xs[1]["tid"]  # same thread, one row
+        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert len(metas) == 1

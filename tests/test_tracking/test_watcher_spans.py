@@ -1,0 +1,156 @@
+"""Watcher ingestion of ``span`` report events, interleaved with metrics.
+
+Fabricates the on-disk report files two gang processes would write and
+drives :meth:`GangWatcher.ingest` over them — the control-plane half of
+the tracing pipeline, without spawning real subprocesses.
+"""
+
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from polyaxon_tpu.db.registry import RunRegistry
+from polyaxon_tpu.monitor.watcher import GangWatcher
+from polyaxon_tpu.stores.layout import RunPaths
+from polyaxon_tpu.tracking.reporter import Reporter
+from polyaxon_tpu.tracking.trace import chrome_trace
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+}
+
+
+@pytest.fixture()
+def rig(tmp_path):
+    registry = RunRegistry(tmp_path / "registry.sqlite")
+    run = registry.create_run(SPEC, name="traced")
+    paths = RunPaths(tmp_path / "run").ensure()
+    handle = SimpleNamespace(
+        run_id=run.id,
+        run_uuid=run.uuid,
+        plan=SimpleNamespace(num_hosts=2),
+        paths=paths,
+        report_offsets={},
+    )
+    yield registry, GangWatcher(registry), handle
+    registry.close()
+
+
+def _append(paths, process_id, events):
+    with open(paths.report_file(process_id), "a", encoding="utf-8") as fh:
+        for event in events:
+            fh.write(json.dumps(event) + "\n")
+
+
+def _span_event(name, pid, start, **extra):
+    return {
+        "type": "span",
+        "ts": start,
+        "name": name,
+        "trace_id": "t1",
+        "span_id": f"{pid}.{int(start * 10)}",
+        "parent_id": None,
+        "start": start,
+        "duration": 0.25,
+        "process_id": pid,
+        "thread": "MainThread",
+        **extra,
+    }
+
+
+class TestSpanIngestion:
+    def test_spans_interleaved_with_metrics_from_two_processes(self, rig):
+        registry, watcher, handle = rig
+        _append(handle.paths, 0, [
+            {"type": "status", "ts": 1.0, "status": "running", "message": None},
+            _span_event("train:step", 0, 10.0, attrs={"step": 1}),
+            {"type": "metric", "ts": 2.0, "values": {"loss": 0.5}, "step": 1},
+            _span_event("train:step", 0, 12.0, attrs={"step": 2}),
+        ])
+        _append(handle.paths, 1, [
+            _span_event("worker:entrypoint", 1, 9.0),
+            {"type": "metric", "ts": 2.5, "values": {"loss": 0.6}, "step": 1},
+        ])
+        watcher.ingest(handle)
+
+        spans = registry.get_spans(handle.run_id)
+        assert len(spans) == 3
+        # Timeline order = wall-clock start, across processes.
+        assert [s["start"] for s in spans] == [9.0, 10.0, 12.0]
+        assert {s["process_id"] for s in spans} == {0, 1}
+        assert spans[0]["name"] == "worker:entrypoint"
+        assert spans[1]["attrs"] == {"step": 1}
+        # Metrics ingested alongside, not displaced by the span lines.
+        metrics = registry.get_metrics(handle.run_id)
+        assert len(metrics) == 2
+
+    def test_reingest_does_not_duplicate(self, rig):
+        registry, watcher, handle = rig
+        _append(handle.paths, 0, [_span_event("a", 0, 1.0)])
+        watcher.ingest(handle)
+        watcher.ingest(handle)  # nothing new: tail cursor must hold
+        _append(handle.paths, 0, [_span_event("b", 0, 2.0)])
+        watcher.ingest(handle)
+        names = [s["name"] for s in registry.get_spans(handle.run_id)]
+        assert names == ["a", "b"]
+
+    def test_unknown_keys_fold_into_attrs(self, rig):
+        registry, watcher, handle = rig
+        event = _span_event("gang:spawn", 0, 1.0, hosts=4)
+        _append(handle.paths, 0, [event])
+        watcher.ingest(handle)
+        (span,) = registry.get_spans(handle.run_id)
+        assert span["attrs"]["hosts"] == 4  # forward-compatible channel
+
+    def test_since_id_pagination(self, rig):
+        registry, watcher, handle = rig
+        _append(handle.paths, 0, [_span_event("a", 0, 1.0), _span_event("b", 0, 2.0)])
+        watcher.ingest(handle)
+        first = registry.get_spans(handle.run_id, limit=1)
+        rest = registry.get_spans(handle.run_id, since_id=first[-1]["id"])
+        assert [s["name"] for s in rest] == ["b"]
+
+    def test_reporter_to_registry_roundtrip(self, rig):
+        """The real writer on one end, the real reader on the other."""
+        registry, watcher, handle = rig
+        reporter = Reporter(handle.paths.report_file(0), process_id=0)
+        reporter.span(
+            {
+                "name": "worker:cmd",
+                "trace_id": handle.run_uuid,
+                "span_id": "0.1",
+                "parent_id": None,
+                "start": 100.0,
+                "duration": 1.5,
+                "process_id": 0,
+                "thread": "MainThread",
+            }
+        )
+        reporter.close()
+        watcher.ingest(handle)
+        (span,) = registry.get_spans(handle.run_id)
+        assert span["name"] == "worker:cmd"
+        assert span["trace_id"] == handle.run_uuid
+        assert span["duration"] == 1.5
+        doc = chrome_trace([span])
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert xs[0]["dur"] == pytest.approx(1.5e6)
+
+    def test_partial_tail_line_deferred(self, rig):
+        """A half-flushed span line is left for the next poll, and the
+        complete lines before it are not re-ingested."""
+        registry, watcher, handle = rig
+        path = handle.paths.report_file(0)
+        full = json.dumps(_span_event("done", 0, 1.0))
+        partial = json.dumps(_span_event("torn", 0, 2.0))[:20]
+        path.write_text(full + "\n" + partial)
+        watcher.ingest(handle)
+        assert [s["name"] for s in registry.get_spans(handle.run_id)] == ["done"]
+        # The write completes; only the torn line is picked up.
+        with open(path, "a") as fh:
+            fh.write(json.dumps(_span_event("torn", 0, 2.0))[20:] + "\n")
+        watcher.ingest(handle)
+        names = [s["name"] for s in registry.get_spans(handle.run_id)]
+        assert names == ["done", "torn"]
