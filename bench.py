@@ -122,27 +122,33 @@ def main() -> None:
     trace_overhead_ok = None
     try:
         from polyaxon_tpu.stats import MemoryStats
+        from polyaxon_tpu.tracking.flightrec import Progress
         from polyaxon_tpu.tracking.profiling import StepClock
         from polyaxon_tpu.tracking.trace import get_tracer
 
         tracer = get_tracer()
         treg = MemoryStats()
+        beacon = Progress()
         n_tr = min(steps, 10)
 
         # ts.step donates (params, opt_state), so every loop consumes the
-        # state it is given and returns the live replacement.
+        # state it is given and returns the live replacement.  The
+        # instrumented side mirrors the built-in trainers exactly: span +
+        # StepClock tick + histogram observe + stall-beacon beat, so the
+        # watchdog's per-step cost is charged against the same budget.
         def _overhead_loop(n: int, instrumented: bool, p, o):
             clock = StepClock()
             clock.start()
             t0 = time.perf_counter()
             m = None
-            for _ in range(n):
+            for i in range(n):
                 if instrumented:
                     with tracer.span("train:step", sample=tracer.hot_sample):
                         p, o, m = ts.step(p, o, batch, key)
                     d = clock.tick()
                     if d is not None:
                         treg.timing("train.step_wall_s", d)
+                    beacon.beat(step=i)
                 else:
                     p, o, m = ts.step(p, o, batch, key)
             float(m["loss"])
@@ -319,6 +325,94 @@ def main() -> None:
             orch.stop()
     except Exception:
         pass
+
+    # Stall-detection latency: a CPU-smoke gang whose train loop goes
+    # silent mid-run (builtins stalling probe), measured through the REAL
+    # path — worker beacon → progress report line → watcher ingest →
+    # gang detector → anomaly row.  stall_detect_s is (anomaly row
+    # created_at − last progress beat), i.e. injection→detection; the
+    # budget is the detector threshold plus ingest/poll slack.
+    stall_detect_s = None
+    stall_detect_ok = None
+    try:
+        import os
+        import sys
+        import tempfile
+
+        from polyaxon_tpu.orchestrator import Orchestrator
+
+        stall_after_s = 0.6
+        knobs = {
+            "POLYAXON_TPU_STALL_AFTER_S": str(stall_after_s),
+            "POLYAXON_TPU_PROGRESS_INTERVAL_S": "0.05",
+            "POLYAXON_TPU_WATCHDOG_INTERVAL_S": "0.05",
+            "POLYAXON_TPU_WATCHDOG_FLOOR_S": "0.6",
+            "POLYAXON_TPU_WATCHDOG_CEILING_S": "2.0",
+        }
+        saved_env = {k: os.environ.get(k) for k in knobs}
+        os.environ.update(knobs)
+        orch = Orchestrator(
+            tempfile.mkdtemp(), monitor_interval=0.05, heartbeat_interval=0.2
+        )
+        try:
+            run = orch.submit(
+                {
+                    "kind": "experiment",
+                    "run": {
+                        "entrypoint": "polyaxon_tpu.builtins.trainers:stalling"
+                    },
+                    "declarations": {
+                        "warm_steps": 10,
+                        "beat_interval": 0.02,
+                        "stall_s": 3.0,
+                    },
+                    "environment": {
+                        "topology": {
+                            "accelerator": "cpu-1",
+                            "num_devices": 1,
+                            "num_hosts": 1,
+                        }
+                    },
+                }
+            )
+            orch.wait(run.id, timeout=120)
+            stalls = orch.registry.get_anomalies(run.id, kind="stall")
+            prog = orch.registry.get_progress(run.id)
+            beats = [r["at"] for r in prog if r.get("at")]
+            if stalls and beats:
+                # First stall row from either detector (worker watchdog or
+                # gang-level), whichever landed first.
+                stall_detect_s = stalls[0]["created_at"] - max(
+                    b for b in beats if b <= stalls[0]["created_at"]
+                )
+        finally:
+            orch.stop()
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        if stall_detect_s is not None:
+            # Threshold + generous poll/ingest slack; must also fire while
+            # the 3s stall is still in progress (else detection is moot).
+            stall_detect_ok = 0.0 < stall_detect_s < stall_after_s + 2.5
+            if not stall_detect_ok:
+                print(
+                    f"bench: stall_detect_s={stall_detect_s:.2f} outside "
+                    f"budget ({stall_after_s} + 2.5s slack) — stall "
+                    "detection is too slow",
+                    file=sys.stderr,
+                )
+        else:
+            print(
+                "bench: stalling gang produced no stall anomaly row",
+                file=sys.stderr,
+            )
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
 
     # Serving: the continuous-batching engine under CONCURRENT load vs the
     # same requests one-at-a-time through generate().  Decode is
@@ -614,6 +708,12 @@ def main() -> None:
                     else None
                 ),
                 "trace_overhead_ok": trace_overhead_ok,
+                "stall_detect_s": (
+                    round(stall_detect_s, 2)
+                    if stall_detect_s is not None
+                    else None
+                ),
+                "stall_detect_ok": stall_detect_ok,
             }
         )
     )

@@ -19,6 +19,7 @@ from typing import Any, Dict, Optional
 from polyaxon_tpu.db.registry import Run, RunRegistry
 from polyaxon_tpu.events import EventTypes
 from polyaxon_tpu.exceptions import PolyaxonTPUError
+from polyaxon_tpu.monitor.watcher import anomaly_status
 from polyaxon_tpu.orchestrator import Orchestrator
 from polyaxon_tpu.stats.metrics import PROMETHEUS_CONTENT_TYPE, render_prometheus
 from polyaxon_tpu.tracking.trace import chrome_trace
@@ -260,7 +261,17 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
 
     @routes.get(f"{API_PREFIX}/runs/{{run_id}}")
     async def get_run(request):
-        return web.json_response(run_to_dict(_run_or_404(request)))
+        run = _run_or_404(request)
+        payload = run_to_dict(run)
+        # Live stall/straggler roll-up — detail view only, so list views
+        # stay a single-table read.  A finished run cannot be stalled: its
+        # progress rows age out, but the alarm must not outlive the gang
+        # (the heartbeat stays "fresh" for heartbeat_fresh_s after exit).
+        status = anomaly_status(reg, run.id)
+        if run.is_done:
+            status.update(stalled=False, stall_age_s=0.0, stragglers=[])
+        payload["anomalies"] = status
+        return web.json_response(payload)
 
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/stop")
     async def stop_run(request):
@@ -400,6 +411,23 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         run = _run_or_404(request)
         spans = reg.get_spans(run.id, since_id=_int_param(request, "since_id", 0))
         return web.json_response(chrome_trace(spans))
+
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/anomalies")
+    async def get_anomalies(request):
+        # Incident timeline (stall/straggler/crash rows from the detector
+        # and the workers' flight recorders) + the live detector roll-up.
+        run = _run_or_404(request)
+        rows = reg.get_anomalies(
+            run.id,
+            since_id=_int_param(request, "since_id", 0),
+            limit=_int_param(request, "limit"),
+        )
+        status = anomaly_status(reg, run.id)
+        if run.is_done:
+            # The incident rows are history; the live roll-up is not —
+            # a finished run cannot be currently stalled or straggling.
+            status.update(stalled=False, stall_age_s=0.0, stragglers=[])
+        return web.json_response({"results": rows, "status": status})
 
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/heartbeat")
     async def post_heartbeat(request):

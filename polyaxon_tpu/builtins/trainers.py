@@ -13,6 +13,7 @@ import time
 
 from polyaxon_tpu.stats import get_stats
 from polyaxon_tpu.tracking import Context
+from polyaxon_tpu.tracking.flightrec import get_progress
 from polyaxon_tpu.tracking.trace import get_tracer
 
 
@@ -55,6 +56,32 @@ def flaky_once(ctx: Context) -> None:
         marker.write_text("1")
         raise RuntimeError("flaky first attempt")
     ctx.log_metrics(recovered=1.0)
+
+
+def stalling(ctx: Context) -> None:
+    """Beats the progress beacon, then one process goes silent
+    (stall/straggler-detection probe).
+
+    Every process beats ``warm_steps`` steps ``beat_interval`` apart, then
+    the ``stall_process`` victim (-1 = all of them) sleeps ``stall_s``
+    without beating while its peers advance ``peer_steps`` more — which is
+    what distinguishes a gang-wide *stall* (everyone silent, heartbeats
+    fresh) from a *straggler* (one host falling behind the gang median).
+    """
+    progress = get_progress()
+    warm = int(ctx.get_param("warm_steps", 5))
+    interval = float(ctx.get_param("beat_interval", 0.02))
+    for i in range(warm):
+        progress.beat(step=i)
+        time.sleep(interval)
+    victim = int(ctx.get_param("stall_process", -1))
+    if victim in (-1, ctx.process_id):
+        time.sleep(float(ctx.get_param("stall_s", 2.0)))
+    else:
+        for i in range(warm, warm + int(ctx.get_param("peer_steps", 100))):
+            progress.beat(step=i)
+            time.sleep(interval)
+    ctx.log_metrics(step=warm, done=1.0)
 
 
 def resume_counter(ctx: Context) -> None:
@@ -212,6 +239,7 @@ def _train_image_classifier(
     clock = StepClock()
     tracer = get_tracer()
     run_stats = get_stats()
+    progress = get_progress()
     metrics = None
     batch = None
     t0 = time.time()
@@ -235,6 +263,9 @@ def _train_image_classifier(
                 if step_dt is not None:
                     run_stats.timing("train.step_wall_s", step_dt)
                 run_stats.timing("train.data_wait_s", pipe.pop_data_wait_s())
+                # Feed the stall watchdog (tracking/flightrec.py): a beat
+                # per step keeps the adaptive deadline honest.
+                progress.beat(step=i)
         # Fence BEFORE timing: with async dispatch, steps are still
         # executing when the loop exits — an unfenced clock read would
         # overstate throughput.
@@ -566,6 +597,7 @@ def lm_train(ctx: Context) -> None:
 
     tracer = get_tracer()
     run_stats = get_stats()
+    progress = get_progress()
     metrics = None
     t0 = time.time()
     clock.start()
@@ -587,6 +619,7 @@ def lm_train(ctx: Context) -> None:
                 step_dt = clock.tick()
                 if step_dt is not None:
                     run_stats.timing("train.step_wall_s", step_dt)
+                progress.beat(step=i)
         jax.block_until_ready(params)
         dt = time.time() - t0
     finally:

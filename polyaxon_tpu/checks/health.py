@@ -44,6 +44,22 @@ def check_stores(orch) -> Tuple[bool, str]:
     return True, f"{free_frac:.0%} free"
 
 
+def check_heartbeats(orch) -> Tuple[bool, str]:
+    """Running runs with stale heartbeats — the zombie cron's worklist,
+    surfaced here as diagnostic detail (the cron, not /status, acts on
+    it; a wedged worker doesn't make the control plane unhealthy)."""
+    ttl = getattr(getattr(orch, "ctx", None), "heartbeat_ttl", None) or 600.0
+    stale = orch.registry.zombie_runs(ttl)
+    if not stale:
+        return True, "no stale heartbeats"
+    ids = ", ".join(str(r.id) for r in stale[:5])
+    more = f" (+{len(stale) - 5} more)" if len(stale) > 5 else ""
+    return True, (
+        f"{len(stale)} running run(s) with heartbeat older than "
+        f"{ttl:.0f}s: {ids}{more}"
+    )
+
+
 def check_devices(orch) -> Tuple[bool, str]:
     """Accelerator visibility — only meaningful in-process on a worker/bench
     host; the control plane itself may legitimately be CPU-only."""
@@ -61,6 +77,7 @@ CHECKS: Dict[str, Callable] = {
     "registry": check_registry,
     "bus": check_bus,
     "stores": check_stores,
+    "heartbeats": check_heartbeats,
 }
 
 

@@ -129,6 +129,28 @@ CREATE TABLE IF NOT EXISTS heartbeats (
     last_at REAL NOT NULL
 );
 
+CREATE TABLE IF NOT EXISTS progress (
+    run_id INTEGER NOT NULL,
+    process_id INTEGER NOT NULL,
+    step INTEGER,
+    epoch INTEGER,
+    throughput REAL,
+    at REAL NOT NULL,
+    updated_at REAL NOT NULL,
+    PRIMARY KEY (run_id, process_id)
+);
+
+CREATE TABLE IF NOT EXISTS anomalies (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_id INTEGER NOT NULL,
+    process_id INTEGER,
+    kind TEXT NOT NULL,
+    message TEXT,
+    attrs TEXT,
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_anomalies_run ON anomalies (run_id);
+
 CREATE TABLE IF NOT EXISTS iterations (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
     group_id INTEGER NOT NULL,
@@ -630,6 +652,8 @@ class RunRegistry:
                 ("metrics", "run_id"),
                 ("logs", "run_id"),
                 ("spans", "run_id"),
+                ("progress", "run_id"),
+                ("anomalies", "run_id"),
                 ("heartbeats", "run_id"),
                 ("processes", "run_id"),
                 ("bookmarks", "run_id"),
@@ -917,6 +941,100 @@ class RunRegistry:
             for run in map(_row_to_run, rows)
             if run.lifecycle.needs_heartbeat(run.status)
         ]
+
+    # -- progress + anomalies --------------------------------------------------
+    def upsert_progress(
+        self,
+        run_id: int,
+        process_id: int,
+        *,
+        step: Optional[int] = None,
+        epoch: Optional[int] = None,
+        throughput: Optional[float] = None,
+        at: Optional[float] = None,
+    ) -> None:
+        """Latest-wins forward-progress marker per gang process.
+
+        One row per (run, process): the stall/straggler detector only ever
+        needs the newest beat, and metric rows already carry history —
+        keeping this a fixed-size upsert means the detector's poll is O(gang)
+        no matter how long the run is."""
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO progress
+                   (run_id, process_id, step, epoch, throughput, at, updated_at)
+                   VALUES (?, ?, ?, ?, ?, ?, ?)
+                   ON CONFLICT (run_id, process_id) DO UPDATE SET
+                     step = COALESCE(excluded.step, step),
+                     epoch = COALESCE(excluded.epoch, epoch),
+                     throughput = COALESCE(excluded.throughput, throughput),
+                     at = excluded.at,
+                     updated_at = excluded.updated_at""",
+                (run_id, process_id, step, epoch, throughput,
+                 at or time.time(), time.time()),
+            )
+
+    def get_progress(self, run_id: int) -> List[Dict[str, Any]]:
+        rows = self._conn().execute(
+            "SELECT process_id, step, epoch, throughput, at, updated_at"
+            " FROM progress WHERE run_id = ? ORDER BY process_id",
+            (run_id,),
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    def add_anomaly(
+        self,
+        run_id: int,
+        kind: str,
+        *,
+        process_id: Optional[int] = None,
+        message: Optional[str] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+        created_at: Optional[float] = None,
+    ) -> None:
+        """One detected anomaly (stall/straggler/crash) — append-only, like
+        statuses: the rows ARE the incident timeline."""
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO anomalies
+                   (run_id, process_id, kind, message, attrs, created_at)
+                   VALUES (?, ?, ?, ?, ?, ?)""",
+                (
+                    run_id,
+                    process_id,
+                    str(kind),
+                    message,
+                    json.dumps(attrs, default=str) if attrs else None,
+                    created_at or time.time(),
+                ),
+            )
+
+    def get_anomalies(
+        self,
+        run_id: int,
+        *,
+        kind: Optional[str] = None,
+        since_id: int = 0,
+        limit: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        sql = (
+            "SELECT id, process_id, kind, message, attrs, created_at"
+            " FROM anomalies WHERE run_id = ? AND id > ?"
+        )
+        params: List[Any] = [run_id, since_id]
+        if kind is not None:
+            sql += " AND kind = ?"
+            params.append(kind)
+        sql += " ORDER BY id"
+        if limit is not None:
+            sql += f" LIMIT {int(limit)}"
+        rows = self._conn().execute(sql, params).fetchall()
+        out: List[Dict[str, Any]] = []
+        for r in rows:
+            row = dict(r)
+            row["attrs"] = json.loads(row["attrs"]) if row["attrs"] else {}
+            out.append(row)
+        return out
 
     def stale_queued_runs(
         self, ttl_seconds: float, now: Optional[float] = None
@@ -1366,7 +1484,17 @@ class RunRegistry:
                    (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
                 (cutoff, cutoff),
             ).rowcount
-        return {"activity": act, "logs": logs, "spans": spans}
+            anomalies = conn.execute(
+                """DELETE FROM anomalies WHERE created_at < ? AND run_id IN
+                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
+                (cutoff, cutoff),
+            ).rowcount
+        return {
+            "activity": act,
+            "logs": logs,
+            "spans": spans,
+            "anomalies": anomalies,
+        }
 
     # -- projects (entity metadata over runs.project) --------------------------
     def create_project(

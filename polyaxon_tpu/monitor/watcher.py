@@ -14,7 +14,10 @@ from __future__ import annotations
 
 import json
 import logging
-from typing import Dict, List, Optional
+import os
+import statistics
+import time
+from typing import Any, Dict, List, Optional
 
 from polyaxon_tpu.db.registry import RunRegistry
 from polyaxon_tpu.lifecycles import StatusOptions as S
@@ -24,12 +27,116 @@ from polyaxon_tpu.tracking.trace import get_tracer
 
 logger = logging.getLogger(__name__)
 
+#: Per-poll read budget per process file — bounds the watcher's memory when
+#: it falls behind a chatty gang (the tail used to be slurped whole).
+DEFAULT_POLL_BYTES = 4 * 1024 * 1024
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def anomaly_status(
+    registry: RunRegistry,
+    run_id: int,
+    *,
+    now: Optional[float] = None,
+    stall_after_s: Optional[float] = None,
+    straggler_lag_steps: Optional[float] = None,
+    heartbeat_fresh_s: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Live gang-level stall/straggler roll-up over ingested progress rows.
+
+    Pure read — shared by the watcher's per-tick detector (which persists
+    transitions as anomaly rows) and the API's run-status payload (which
+    wants the current truth without waiting for a monitor tick).
+
+    *Stalled* means alive-but-stuck: every liveness signal is fresh
+    (heartbeats within ``heartbeat_fresh_s``) but the newest progress beat
+    across the whole gang is older than ``stall_after_s`` — the state
+    ``reconcile()`` cannot see, because every process is still running.
+    *Straggler* means one host's step lags the gang's median step by
+    ``straggler_lag_steps`` or more.
+    """
+    now = now if now is not None else time.time()
+    if stall_after_s is None:
+        stall_after_s = _env_float("POLYAXON_TPU_STALL_AFTER_S", 60.0)
+    if straggler_lag_steps is None:
+        straggler_lag_steps = _env_float("POLYAXON_TPU_STRAGGLER_LAG_STEPS", 50.0)
+    if heartbeat_fresh_s is None:
+        heartbeat_fresh_s = _env_float("POLYAXON_TPU_STALL_HEARTBEAT_FRESH_S", 30.0)
+    out: Dict[str, Any] = {
+        "stalled": False,
+        "stall_age_s": 0.0,
+        "stragglers": [],
+        "progress": registry.get_progress(run_id),
+    }
+    rows = out["progress"]
+    if not rows:
+        return out
+    newest = max(r["at"] for r in rows)
+    age = now - newest
+    hb = registry.last_heartbeat(run_id)
+    if hb is not None and now - hb <= heartbeat_fresh_s and age > stall_after_s:
+        out["stalled"] = True
+        out["stall_age_s"] = age
+    steps = [(r["process_id"], r["step"]) for r in rows if r["step"] is not None]
+    if len(steps) >= 2:
+        median_step = statistics.median(s for _, s in steps)
+        for process_id, step in steps:
+            lag = median_step - step
+            if lag >= straggler_lag_steps:
+                out["stragglers"].append(
+                    {
+                        "process_id": process_id,
+                        "step": step,
+                        "median_step": median_step,
+                        "lag_steps": lag,
+                    }
+                )
+    return out
+
 
 class GangWatcher:
     """Stateless-per-call watcher; tail cursors live on the GangHandle."""
 
-    def __init__(self, registry: RunRegistry) -> None:
+    def __init__(
+        self,
+        registry: RunRegistry,
+        stats: Any = None,
+        *,
+        max_poll_bytes: Optional[int] = None,
+        stall_after_s: Optional[float] = None,
+        straggler_lag_steps: Optional[float] = None,
+        heartbeat_fresh_s: Optional[float] = None,
+    ) -> None:
         self.registry = registry
+        self.stats = stats
+        self.max_poll_bytes = (
+            max_poll_bytes
+            if max_poll_bytes is not None
+            else int(
+                os.environ.get("POLYAXON_TPU_WATCHER_POLL_BYTES", DEFAULT_POLL_BYTES)
+            )
+        )
+        self.stall_after_s = (
+            stall_after_s
+            if stall_after_s is not None
+            else _env_float("POLYAXON_TPU_STALL_AFTER_S", 60.0)
+        )
+        self.straggler_lag_steps = (
+            straggler_lag_steps
+            if straggler_lag_steps is not None
+            else _env_float("POLYAXON_TPU_STRAGGLER_LAG_STEPS", 50.0)
+        )
+        self.heartbeat_fresh_s = (
+            heartbeat_fresh_s
+            if heartbeat_fresh_s is not None
+            else _env_float("POLYAXON_TPU_STALL_HEARTBEAT_FRESH_S", 30.0)
+        )
 
     # -- report ingestion -----------------------------------------------------
     def ingest(self, handle: GangHandle) -> None:
@@ -41,13 +148,32 @@ class GangWatcher:
             offset = handle.report_offsets.get(process_id, 0)
             with open(path, "rb") as fh:
                 fh.seek(offset)
-                chunk = fh.read()
+                # Bounded read: a long catch-up (control-plane restart, slow
+                # poll cadence) drains in max_poll_bytes slices across polls
+                # instead of one unbounded slurp; the durable offset carries
+                # the remainder.
+                chunk = fh.read(self.max_poll_bytes)
             if not chunk:
                 continue
             # Only consume complete lines; a partially-flushed tail is
             # re-read next poll.
             end = chunk.rfind(b"\n")
             if end < 0:
+                if len(chunk) >= self.max_poll_bytes:
+                    # A single line larger than the whole poll budget can
+                    # never terminate inside a bounded read — skip these
+                    # bytes or the tail wedges forever.  The line's final
+                    # fragment (up to its real newline) will fail to parse
+                    # next poll and be skipped like any malformed line.
+                    logger.warning(
+                        "Oversized report line from proc %d (> %d bytes); skipping",
+                        process_id,
+                        self.max_poll_bytes,
+                    )
+                    handle.report_offsets[process_id] = offset + len(chunk)
+                    self.registry.set_report_offset(
+                        handle.run_id, process_id, offset + len(chunk)
+                    )
                 continue
             handle.report_offsets[process_id] = offset + end + 1
             for raw in chunk[: end + 1].splitlines():
@@ -56,7 +182,27 @@ class GangWatcher:
                 except json.JSONDecodeError:
                     logger.warning("Bad report line from proc %d: %r", process_id, raw[:200])
                     continue
-                self._apply(handle, process_id, event)
+                if not isinstance(event, dict):
+                    # json.loads accepts bare scalars/arrays ("123" → int);
+                    # those are junk on this channel, not a poll-aborting
+                    # error.
+                    logger.warning(
+                        "Non-object report line from proc %d: %r",
+                        process_id,
+                        raw[:200],
+                    )
+                    continue
+                try:
+                    self._apply(handle, process_id, event)
+                except Exception:
+                    # One poisonous line (bad field types, etc.) must not
+                    # permanently wedge the tail behind it.
+                    logger.warning(
+                        "Failed to apply report line from proc %d: %r",
+                        process_id,
+                        raw[:200],
+                        exc_info=True,
+                    )
             # Durable cursor: a restarted control plane reattaches and
             # resumes the tail here. Persisted AFTER the apply loop — a
             # crash in between replays these lines (status upserts are
@@ -77,6 +223,31 @@ class GangWatcher:
             self.registry.add_span(run_id, event, process_id=process_id)
         elif etype == "heartbeat":
             self.registry.ping_heartbeat(run_id, at=event.get("ts"))
+        elif etype == "progress":
+            self.registry.upsert_progress(
+                run_id,
+                process_id,
+                step=event.get("step"),
+                epoch=event.get("epoch"),
+                throughput=event.get("throughput"),
+                # "at" = the beat's own wall time; emission is throttled, so
+                # the line's ts can postdate the progress it describes.
+                at=event.get("at") or event.get("ts"),
+            )
+        elif etype == "anomaly":
+            attrs = {
+                k: v
+                for k, v in event.items()
+                if k not in ("type", "ts", "kind", "message")
+            }
+            self.registry.add_anomaly(
+                run_id,
+                event.get("kind") or "anomaly",
+                process_id=process_id,
+                message=event.get("message"),
+                attrs=attrs,
+                created_at=event.get("ts"),
+            )
         elif etype == "service":
             # A service refining its own URL (jupyter appends its token
             # as a query string; an absolute url replaces outright).
@@ -128,6 +299,85 @@ class GangWatcher:
             statuses.append(status)
         return statuses
 
+    # -- gang-level anomaly detection -----------------------------------------
+    def detect_anomalies(
+        self, handle: GangHandle, *, now: Optional[float] = None
+    ) -> Dict[str, Any]:
+        """Flag gang-wide stalls and stragglers; persist each *transition*.
+
+        Edge-triggered: one ``stall``/``straggler`` anomaly row per episode
+        (per-handle marks de-dupe across monitor ticks; recovery re-arms),
+        so the anomalies table reads as an incident timeline rather than a
+        row per 200ms poll.  Gauges (``run_stall_age_s`` /
+        ``straggler_lag_steps``) track the *current* state on the stats
+        backend and recover to 0.
+        """
+        now = now if now is not None else time.time()
+        status = anomaly_status(
+            self.registry,
+            handle.run_id,
+            now=now,
+            stall_after_s=self.stall_after_s,
+            straggler_lag_steps=self.straggler_lag_steps,
+            heartbeat_fresh_s=self.heartbeat_fresh_s,
+        )
+        marks = getattr(handle, "anomaly_marks", None)
+        if marks is None:
+            marks = {}
+            try:
+                handle.anomaly_marks = marks
+            except Exception:  # frozen test stand-ins: detection, no dedup
+                pass
+        if status["stalled"]:
+            if not marks.get("stall"):
+                marks["stall"] = True
+                steps = [r["step"] for r in status["progress"]]
+                self.registry.add_anomaly(
+                    handle.run_id,
+                    "stall",
+                    message=(
+                        f"gang alive but no progress for "
+                        f"{status['stall_age_s']:.1f}s (steps: {steps})"
+                    ),
+                    attrs={
+                        "age_s": status["stall_age_s"],
+                        "threshold_s": self.stall_after_s,
+                        "steps": steps,
+                    },
+                    created_at=now,
+                )
+        else:
+            marks["stall"] = False
+        lagging = {s["process_id"]: s for s in status["stragglers"]}
+        for process_id, info in lagging.items():
+            key = f"straggler:{process_id}"
+            if not marks.get(key):
+                marks[key] = True
+                self.registry.add_anomaly(
+                    handle.run_id,
+                    "straggler",
+                    process_id=process_id,
+                    message=(
+                        f"proc {process_id} at step {info['step']}, "
+                        f"{info['lag_steps']:.0f} steps behind the gang "
+                        f"median ({info['median_step']})"
+                    ),
+                    attrs={
+                        "lag_steps": info["lag_steps"],
+                        "median_step": info["median_step"],
+                        "threshold_steps": self.straggler_lag_steps,
+                    },
+                    created_at=now,
+                )
+        for key in list(marks):
+            if key.startswith("straggler:") and int(key.split(":")[1]) not in lagging:
+                marks[key] = False
+        if self.stats is not None:
+            self.stats.gauge("run_stall_age_s", float(status["stall_age_s"]))
+            worst = max((s["lag_steps"] for s in status["stragglers"]), default=0.0)
+            self.stats.gauge("straggler_lag_steps", float(worst))
+        return status
+
     def observe(self, handle: GangHandle) -> Optional[str]:
         """One poll: ingest reports, reconcile liveness, return gang roll-up."""
         tracer = get_tracer()
@@ -138,4 +388,24 @@ class GangWatcher:
         ):
             self.ingest(handle)
             statuses = self.reconcile(handle)
-            return gang_status(statuses)
+            rollup = gang_status(statuses)
+            if rollup == S.RUNNING:
+                # Only live gangs can stall; a finished gang's progress rows
+                # age out harmlessly.
+                try:
+                    self.detect_anomalies(handle)
+                except Exception:
+                    logger.warning(
+                        "Anomaly detection failed for run %d",
+                        handle.run_id,
+                        exc_info=True,
+                    )
+            elif self.stats is not None:
+                # A run that goes terminal mid-episode must not pin the
+                # alarm gauges at its last stalled value.
+                marks = getattr(handle, "anomaly_marks", None)
+                if marks and any(marks.values()):
+                    self.stats.gauge("run_stall_age_s", 0.0)
+                    self.stats.gauge("straggler_lag_steps", 0.0)
+                    marks.clear()
+            return rollup

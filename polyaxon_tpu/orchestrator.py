@@ -175,7 +175,9 @@ class Orchestrator:
         self.spawner = spawner_from_conf(
             self.layout, conf, heartbeat_interval=heartbeat_interval
         )
-        self.watcher = GangWatcher(self.registry)
+        # The stats backend lets the watcher's stall/straggler detector
+        # export its alarm gauges on /metrics.
+        self.watcher = GangWatcher(self.registry, stats=self.stats)
         artifacts_url = conf.get("stores.artifacts_url")
         self.artifact_store = None
         if artifacts_url:

@@ -111,6 +111,18 @@ def main() -> int:
     )
     reporter.status("starting")
     reporter.start_heartbeat(info.heartbeat_interval)
+    from polyaxon_tpu.tracking.flightrec import FlightRecorder, get_progress
+
+    # Stall watchdog + crash forensics: trainers/serving beat the shared
+    # progress beacon; no beat within the adaptive deadline → forensic
+    # dump to reports/flightrec-<proc>-<n>.json + typed anomaly line.
+    recorder = FlightRecorder(
+        get_progress(),
+        reporter=reporter,
+        out_dir=paths.reports,
+        process_id=info.process_id,
+    )
+    recorder.start()
     from polyaxon_tpu.monitor.resources import ResourceSampler
 
     # NOT started yet: the sampler thread touches jax.local_devices(),
@@ -209,9 +221,13 @@ def main() -> int:
         reporter.status("succeeded")
         return 0
     except BaseException as e:  # noqa: BLE001 — report, then die loudly
+        # Postmortem first (thread stacks, span tail, HBM stats) so every
+        # FAILED run leaves a flight-recorder dump next to its reports.
+        recorder.crash_dump(e)
         reporter.error(e)
         raise
     finally:
+        recorder.stop()
         sampler.stop()
         reporter.close()
 

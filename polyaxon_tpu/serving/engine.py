@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from polyaxon_tpu.stats import MemoryStats
+from polyaxon_tpu.tracking.flightrec import get_progress
 from polyaxon_tpu.tracking.trace import get_tracer
 
 
@@ -216,6 +217,9 @@ class ServingEngine:
         # latency distributions go to the (possibly shared) histogram
         # registry so /metrics can export percentiles.
         self.stats_registry = stats if stats is not None else MemoryStats()
+        # Decode ticks feed the process's stall watchdog: a serving worker
+        # that stops emitting tokens is as stuck as a hung train step.
+        self._progress = get_progress()
         self._stats_lock = threading.Lock()
         self._n_submitted = 0
         self._n_finished = 0
@@ -510,6 +514,7 @@ class ServingEngine:
             "serving.decode_step_s", time.perf_counter() - t0
         )
         self.stats_registry.observe("serving.batch_occupancy", float(n_live))
+        self._progress.beat(step=self._n_steps)
 
     def _emit(self, slot: int, req: GenerationRequest, tok: int) -> None:
         """Record one generated token; retire the slot when done."""

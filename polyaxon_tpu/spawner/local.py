@@ -66,6 +66,9 @@ class GangHandle:
     #: (re-signalling every monitor tick would hammer ssh hosts).
     term_sent: bool = False
     kill_sent: bool = False
+    #: Edge-trigger marks for the watcher's stall/straggler detector (one
+    #: anomaly row per episode, not per monitor tick).
+    anomaly_marks: Dict[str, bool] = field(default_factory=dict)
 
     def poll(self) -> Dict[int, Optional[int]]:
         """process_id -> exit code (None while running)."""

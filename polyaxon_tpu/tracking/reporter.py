@@ -24,11 +24,13 @@ class Reporter:
     """Append-only typed-line writer, safe for one writer per file."""
 
     # Event types that must survive a host crash: lifecycle transitions
-    # drive scheduling decisions, so they are fsynced to disk.  Everything
-    # else (metrics/logs/spans) is flushed to the OS only — losing the
-    # last few lines of telemetry on a power cut is fine, but an fsync per
-    # metric line serializes the train loop on disk latency.
-    FSYNC_TYPES = ("status",)
+    # drive scheduling decisions, so they are fsynced to disk.  Anomaly
+    # lines are fsynced too — they are rare and often immediately precede
+    # the crash they describe.  Everything else (metrics/logs/spans) is
+    # flushed to the OS only — losing the last few lines of telemetry on a
+    # power cut is fine, but an fsync per metric line serializes the train
+    # loop on disk latency.
+    FSYNC_TYPES = ("status", "anomaly")
 
     def __init__(
         self,
@@ -69,6 +71,32 @@ class Reporter:
     def resources(self, values: Dict[str, Any]) -> None:
         """Telemetry samples (cpu/rss/HBM) — streamed like metrics."""
         self._emit("resources", values=values)
+
+    def progress(
+        self,
+        *,
+        step: Optional[int] = None,
+        epoch: Optional[int] = None,
+        throughput: Optional[float] = None,
+        at: Optional[float] = None,
+    ) -> None:
+        """Forward-progress beacon relay (see tracking/flightrec.py).
+
+        The watcher folds these into the registry's ``progress`` table —
+        the gang-level stall/straggler detector's input.  ``at`` is the
+        wall time of the *beat itself*: emission is throttled (and flushed
+        once more at shutdown), so the line's own ``ts`` can postdate the
+        progress it describes — stall ages must be measured from ``at``."""
+        self._emit(
+            "progress", step=step, epoch=epoch, throughput=throughput, at=at
+        )
+
+    def anomaly(
+        self, kind: str, message: Optional[str] = None, **attrs: Any
+    ) -> None:
+        """A detected anomaly (stall, crash) with its forensic context —
+        typically the path of a flight-recorder dump in ``attrs['dump']``."""
+        self._emit("anomaly", kind=kind, message=message, **attrs)
 
     def span(self, record: Dict[str, Any]) -> None:
         """Ship a finished tracer span (see tracking/trace.py) upstream.

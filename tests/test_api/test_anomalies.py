@@ -1,0 +1,222 @@
+"""The anomalies surface: ``GET /api/v1/runs/<id>/anomalies`` (incident
+rows + live detector roll-up), the ``anomalies`` block on the run detail
+payload, and the end-to-end paths — a gang that genuinely stalls and a
+gang with one genuinely lagging host.
+"""
+
+import asyncio
+import json
+from pathlib import Path
+
+import pytest
+
+from polyaxon_tpu.api.app import create_app
+from polyaxon_tpu.orchestrator import Orchestrator
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+
+def _stalling_spec(*, num_hosts=1, stall_process=-1, **declarations):
+    decls = {"warm_steps": 10, "beat_interval": 0.02, "stall_s": 3.0}
+    decls.update(declarations)
+    if stall_process >= 0:
+        decls["stall_process"] = stall_process
+    return {
+        "kind": "experiment",
+        "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:stalling"},
+        "declarations": decls,
+        "environment": {
+            "topology": {
+                "accelerator": "cpu" if num_hosts > 1 else "cpu-1",
+                "num_devices": num_hosts,
+                "num_hosts": num_hosts,
+            }
+        },
+    }
+
+
+@pytest.fixture()
+def anomaly_env(monkeypatch):
+    """Tight thresholds so a 3s sleep reads as a stall, not lunch."""
+    monkeypatch.setenv("POLYAXON_TPU_STALL_AFTER_S", "0.6")
+    monkeypatch.setenv("POLYAXON_TPU_PROGRESS_INTERVAL_S", "0.05")
+    monkeypatch.setenv("POLYAXON_TPU_WATCHDOG_INTERVAL_S", "0.05")
+    monkeypatch.setenv("POLYAXON_TPU_WATCHDOG_FLOOR_S", "0.6")
+    monkeypatch.setenv("POLYAXON_TPU_WATCHDOG_CEILING_S", "2.0")
+    monkeypatch.setenv("POLYAXON_TPU_STRAGGLER_LAG_STEPS", "20")
+
+
+@pytest.fixture()
+def orch(anomaly_env, tmp_path):
+    # Env set BEFORE construction: the orchestrator's GangWatcher reads its
+    # thresholds at init, the workers theirs at spawn.
+    o = Orchestrator(
+        tmp_path / "plat",
+        monitor_interval=0.05,
+        heartbeat_interval=0.2,
+        heartbeat_ttl=30.0,
+    )
+    yield o
+    o.stop()
+
+
+def drive(orch, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        app = create_app(orch)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+async def _wait_done(orch, client, run_id, timeout=60.0):
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        await loop.run_in_executor(None, orch.pump, 0.05)
+        resp = await client.get(f"/api/v1/runs/{run_id}")
+        data = await resp.json()
+        if data["is_done"]:
+            return data
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"run {run_id} not done after {timeout}s")
+
+
+class TestAnomaliesEndpoint:
+    def test_404_for_unknown_run(self, orch):
+        async def body(client):
+            resp = await client.get("/api/v1/runs/999/anomalies")
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)
+
+    def test_rows_and_live_status(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            orch.registry.add_anomaly(
+                run["id"],
+                "stall",
+                message="gang wedged",
+                attrs={"age_s": 12.0, "threshold_s": 0.6},
+            )
+            orch.registry.add_anomaly(
+                run["id"], "straggler", process_id=1, attrs={"lag_steps": 30}
+            )
+            resp = await client.get(f"/api/v1/runs/{run['id']}/anomalies")
+            assert resp.status == 200
+            doc = await resp.json()
+            kinds = [r["kind"] for r in doc["results"]]
+            assert kinds == ["stall", "straggler"]
+            assert doc["results"][0]["attrs"]["age_s"] == 12.0
+            assert doc["results"][1]["process_id"] == 1
+            # Live roll-up rides along (no progress rows yet: all quiet).
+            assert doc["status"]["stalled"] is False
+            assert doc["status"]["stragglers"] == []
+            # since_id pagination, same contract as logs/metrics.
+            first_id = doc["results"][0]["id"]
+            page = await (
+                await client.get(
+                    f"/api/v1/runs/{run['id']}/anomalies?since_id={first_id}"
+                )
+            ).json()
+            assert [r["kind"] for r in page["results"]] == ["straggler"]
+            return True
+
+        assert drive(orch, body)
+
+    def test_run_detail_carries_anomaly_rollup(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            detail = await (await client.get(f"/api/v1/runs/{run['id']}")).json()
+            assert detail["anomalies"]["stalled"] is False
+            assert detail["anomalies"]["progress"] == []
+            # List views stay a single-table read: no anomalies block.
+            listing = await (await client.get("/api/v1/runs")).json()
+            assert "anomalies" not in listing["results"][0]
+            return True
+
+        assert drive(orch, body)
+
+
+@pytest.mark.e2e
+class TestStallEndToEnd:
+    def test_stalled_gang_leaves_anomaly_rows_and_flight_dump(self, orch):
+        """The acceptance path: a worker that goes silent mid-run produces
+        (a) a ``stall`` anomaly row, (b) an on-disk flight dump with
+        thread stacks and the span tail, (c) a non-empty anomalies
+        endpoint."""
+
+        async def body(client):
+            run = await (
+                await client.post(
+                    "/api/v1/runs", json={"spec": _stalling_spec()}
+                )
+            ).json()
+            await _wait_done(orch, client, run["id"])
+            doc = await (
+                await client.get(f"/api/v1/runs/{run['id']}/anomalies")
+            ).json()
+            return run, doc
+
+        run, doc = drive(orch, body)
+        stalls = [r for r in doc["results"] if r["kind"] == "stall"]
+        assert stalls, doc
+        # The incident rows persist; the live roll-up does not — a
+        # finished run is never *currently* stalled.
+        assert doc["status"]["stalled"] is False
+        # Gang-level detector row: gang alive (heartbeats fresh) while the
+        # beacon was silent past the threshold.
+        gang_rows = [r for r in stalls if r["process_id"] is None]
+        assert gang_rows and "no progress" in gang_rows[0]["message"]
+        # Worker watchdog row points at its flight dump on disk.
+        dumps = [r["attrs"].get("dump") for r in stalls if r["attrs"].get("dump")]
+        assert dumps, stalls
+        dump = json.loads(Path(dumps[0]).read_text())
+        assert dump["kind"] == "stall"
+        assert any(k.startswith("MainThread") for k in dump["threads"])
+        stack = "".join(dump["threads"][next(iter(dump["threads"]))])
+        assert "File " in stack
+        assert isinstance(dump["spans"], list)
+        # The last progress the control plane saw predates the stall row.
+        prog = orch.registry.get_progress(run["id"])
+        assert prog and prog[0]["step"] == 9
+        assert prog[0]["at"] < stalls[0]["created_at"]
+
+    def test_straggler_flagged_in_two_host_gang(self, orch):
+        """One host stops beating while its peer advances: the gang-median
+        detector files a ``straggler`` row for the lagging process."""
+        run = orch.submit(
+            _stalling_spec(
+                num_hosts=2, stall_process=1, peer_steps=120, stall_s=4.0
+            ),
+            name="straggler-e2e",
+        )
+        orch.wait(run.id, timeout=120)
+        rows = orch.registry.get_anomalies(run.id, kind="straggler")
+        assert rows, orch.registry.get_anomalies(run.id)
+        assert rows[0]["process_id"] == 1
+        assert rows[0]["attrs"]["lag_steps"] >= 20
+        # Both hosts reported progress; the victim froze at its warm step.
+        steps = {
+            r["process_id"]: r["step"]
+            for r in orch.registry.get_progress(run.id)
+        }
+        assert steps[1] == 9
+        assert steps[0] > steps[1]

@@ -1,0 +1,225 @@
+"""Worker-side stall watchdog + flight recorder (tracking/flightrec.py).
+
+Exercises the beacon, the adaptive deadline, the edge-triggered stall
+dump, the crash-path postmortem, and the typed ``progress``/``anomaly``
+report lines through a real :class:`Reporter` file.
+"""
+
+import json
+import time
+
+import pytest
+
+from polyaxon_tpu.tracking.flightrec import (
+    FlightRecorder,
+    Progress,
+    dump_forensics,
+    get_progress,
+    thread_stacks,
+)
+from polyaxon_tpu.tracking.reporter import Reporter
+
+
+class TestProgress:
+    def test_unarmed_until_first_beat(self):
+        p = Progress()
+        snap = p.snapshot()
+        assert snap["armed"] is False
+        assert snap["age_s"] is None and snap["median_dt_s"] is None
+
+    def test_beat_tracks_step_epoch_and_median(self):
+        p = Progress()
+        for i in range(5):
+            p.beat(step=i, epoch=1)
+            time.sleep(0.01)
+        snap = p.snapshot()
+        assert snap["armed"] is True
+        assert snap["beats"] == 5
+        assert snap["step"] == 4 and snap["epoch"] == 1
+        assert snap["median_dt_s"] == pytest.approx(0.01, abs=0.05)
+        assert snap["throughput"] == pytest.approx(1 / snap["median_dt_s"])
+        assert snap["last_beat_at"] == pytest.approx(time.time(), abs=1.0)
+
+    def test_beat_without_step_keeps_last_step(self):
+        p = Progress()
+        p.beat(step=7)
+        p.beat()  # serving-style anonymous tick
+        assert p.snapshot()["step"] == 7
+
+    def test_reset_disarms(self):
+        p = Progress()
+        p.beat(step=1)
+        p.reset()
+        assert p.snapshot()["armed"] is False
+
+    def test_module_singleton(self):
+        assert get_progress() is get_progress()
+
+
+class TestDeadline:
+    def test_clamped_between_floor_and_ceiling(self):
+        rec = FlightRecorder(Progress(), k=8.0, floor_s=1.0, ceiling_s=10.0)
+        assert rec.deadline_s(0.001) == 1.0  # fast steps hit the floor
+        assert rec.deadline_s(0.5) == 4.0  # 8 x median in band
+        assert rec.deadline_s(100.0) == 10.0  # slow steps hit the ceiling
+
+    def test_ceiling_while_unmeasured(self):
+        # No dt samples yet (compilation, first step): maximum patience.
+        rec = FlightRecorder(Progress(), floor_s=1.0, ceiling_s=10.0)
+        assert rec.deadline_s(None) == 10.0
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_WATCHDOG_K", "2.0")
+        monkeypatch.setenv("POLYAXON_TPU_WATCHDOG_FLOOR_S", "0.5")
+        monkeypatch.setenv("POLYAXON_TPU_WATCHDOG_CEILING_S", "3.0")
+        rec = FlightRecorder(Progress())
+        assert (rec.k, rec.floor_s, rec.ceiling_s) == (2.0, 0.5, 3.0)
+
+
+class TestWatchdog:
+    def _stalled_recorder(self, tmp_path, **kw):
+        """A beacon that beat fast, then went silent past its deadline."""
+        p = Progress()
+        for i in range(4):
+            p.beat(step=i)
+            time.sleep(0.005)
+        rec = FlightRecorder(
+            p, out_dir=tmp_path, k=2.0, floor_s=0.05, ceiling_s=0.2, **kw
+        )
+        time.sleep(0.25)  # > ceiling: definitely past any deadline
+        return p, rec
+
+    def test_not_armed_no_dump(self, tmp_path):
+        rec = FlightRecorder(Progress(), out_dir=tmp_path, floor_s=0.01)
+        assert rec.check() is None  # silence before the first beat is fine
+
+    def test_stall_fires_once_per_episode(self, tmp_path):
+        p, rec = self._stalled_recorder(tmp_path)
+        path = rec.check()
+        assert path is not None and path.exists()
+        assert rec.check() is None  # same episode: no second dump
+
+    def test_beat_rearms(self, tmp_path):
+        p, rec = self._stalled_recorder(tmp_path)
+        assert rec.check() is not None
+        p.beat(step=99)
+        assert rec.check() is None  # recovered
+        time.sleep(0.25)
+        assert rec.check() is not None  # new episode, new dump
+
+    def test_dump_contents(self, tmp_path):
+        p, rec = self._stalled_recorder(tmp_path)
+        doc = json.loads(rec.check().read_text())
+        assert doc["kind"] == "stall"
+        assert doc["progress"]["step"] == 3
+        assert any(k.startswith("MainThread") for k in doc["threads"])
+        stack = "".join(doc["threads"][next(iter(doc["threads"]))])
+        assert "File " in stack  # real frames, not reprs
+        assert isinstance(doc["spans"], list)
+
+    def test_disabled_by_interval_knob(self):
+        rec = FlightRecorder(Progress(), interval_s=0.0)
+        rec.start()
+        assert rec._thread is None
+        rec.stop()
+
+    def test_thread_lifecycle(self, tmp_path):
+        p = Progress()
+        p.beat(step=0)
+        rec = FlightRecorder(
+            p, out_dir=tmp_path, interval_s=0.01, floor_s=0.03, ceiling_s=0.05
+        )
+        rec.start()
+        try:
+            deadline = time.time() + 2.0
+            while time.time() < deadline and not any(tmp_path.glob("flightrec-*")):
+                time.sleep(0.02)
+        finally:
+            rec.stop()
+        assert any(tmp_path.glob("flightrec-*.json"))
+
+
+class TestForensics:
+    def test_crash_dump_carries_exception(self, tmp_path):
+        rec = FlightRecorder(Progress(), out_dir=tmp_path, process_id=3)
+        try:
+            raise ValueError("boom")
+        except ValueError as e:
+            path = rec.crash_dump(e)
+        doc = json.loads(path.read_text())
+        assert doc["kind"] == "crash"
+        assert doc["process_id"] == 3
+        assert doc["exception"]["type"] == "ValueError"
+        assert any("boom" in ln for ln in doc["exception"]["traceback"])
+
+    def test_dump_survives_unserializable_ingredients(self, tmp_path):
+        # default=str in the writer: a dump must never fail on exotic attrs.
+        path = dump_forensics(
+            tmp_path, 0, 1, kind="stall", progress={"odd": object()}
+        )
+        assert path is not None and json.loads(path.read_text())
+
+    def test_thread_stacks_names_current_thread(self):
+        stacks = thread_stacks()
+        assert any(k.startswith("MainThread") for k in stacks)
+
+
+class TestReporterIntegration:
+    def _lines(self, path):
+        return [
+            json.loads(ln)
+            for ln in path.read_text().splitlines()
+            if ln.strip()
+        ]
+
+    def test_anomaly_line_points_at_dump(self, tmp_path):
+        report = tmp_path / "proc0.jsonl"
+        reporter = Reporter(report, process_id=0)
+        rec = FlightRecorder(
+            Progress(), reporter=reporter, out_dir=tmp_path, process_id=0
+        )
+        path = rec.record("stall", message="wedged", age_s=12.5)
+        reporter.close()
+        (event,) = [e for e in self._lines(report) if e["type"] == "anomaly"]
+        assert event["kind"] == "stall"
+        assert event["message"] == "wedged"
+        assert event["age_s"] == 12.5
+        assert event["dump"] == str(path)
+        # The dump's report_tail must see its own channel's earlier lines.
+        doc = json.loads(path.read_text())
+        assert "report_tail" in doc
+
+    def test_progress_lines_deduped_per_beat(self, tmp_path):
+        report = tmp_path / "proc0.jsonl"
+        reporter = Reporter(report, process_id=0)
+        p = Progress()
+        rec = FlightRecorder(
+            p, reporter=reporter, progress_interval_s=0.0, interval_s=0.0
+        )
+        p.beat(step=0)
+        rec.check()
+        rec.check()  # beats unchanged: no duplicate line
+        p.beat(step=1)
+        rec.check()
+        reporter.close()
+        lines = [e for e in self._lines(report) if e["type"] == "progress"]
+        assert [e["step"] for e in lines] == [0, 1]
+        # "at" is the beat's wall time, not the (later) emit time.
+        assert lines[-1]["at"] <= lines[-1]["ts"]
+
+    def test_progress_throttled_but_flushed_at_stop(self, tmp_path):
+        report = tmp_path / "proc0.jsonl"
+        reporter = Reporter(report, process_id=0)
+        p = Progress()
+        rec = FlightRecorder(
+            p, reporter=reporter, progress_interval_s=60.0, interval_s=0.0
+        )
+        rec._last_progress_emit = time.perf_counter()  # window just opened
+        p.beat(step=0)
+        rec.check()  # inside the throttle window: suppressed
+        p.beat(step=1)
+        rec.check()  # still suppressed
+        rec.stop()  # final flush ships the last step regardless
+        reporter.close()
+        lines = [e for e in self._lines(report) if e["type"] == "progress"]
+        assert [e["step"] for e in lines] == [1]

@@ -1,0 +1,371 @@
+"""Watcher ingestion robustness + gang-level anomaly detection.
+
+The report channel is append-only JSON lines written by worker processes
+that can crash mid-write — the watcher must survive scalar/garbage/torn
+lines, bound its per-poll reads, and keep its durable cursor honest
+across a control-plane restart.  The second half drives the stall /
+straggler detector over fabricated progress rows.
+"""
+
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from polyaxon_tpu.db.registry import RunRegistry
+from polyaxon_tpu.monitor.watcher import GangWatcher, anomaly_status
+from polyaxon_tpu.stats import MemoryStats
+from polyaxon_tpu.stores.layout import RunPaths
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+}
+
+
+@pytest.fixture()
+def rig(tmp_path):
+    registry = RunRegistry(tmp_path / "registry.sqlite")
+    run = registry.create_run(SPEC, name="robust")
+    paths = RunPaths(tmp_path / "run").ensure()
+    handle = SimpleNamespace(
+        run_id=run.id,
+        run_uuid=run.uuid,
+        plan=SimpleNamespace(num_hosts=1),
+        paths=paths,
+        report_offsets={},
+        anomaly_marks={},
+    )
+    yield registry, handle
+    registry.close()
+
+
+def _append_raw(paths, process_id, lines):
+    with open(paths.report_file(process_id), "a", encoding="utf-8") as fh:
+        for line in lines:
+            fh.write(line + "\n")
+
+
+def _metric(step, value=0.5):
+    return json.dumps({"type": "metric", "ts": 1.0, "values": {"loss": value}, "step": step})
+
+
+class TestMalformedLines:
+    def test_scalar_line_does_not_abort_the_poll(self, rig):
+        """json.loads(b"123") yields an int, not an error — the old code
+        called .get on it and crashed the whole poll."""
+        registry, handle = rig
+        _append_raw(handle.paths, 0, ["123", _metric(1)])
+        GangWatcher(registry).ingest(handle)
+        assert len(registry.get_metrics(handle.run_id)) == 1
+
+    def test_garbage_and_array_lines_skipped(self, rig):
+        registry, handle = rig
+        _append_raw(
+            handle.paths,
+            0,
+            ['{not json', '[1, 2]', '"quoted"', 'null', _metric(1), _metric(2)],
+        )
+        GangWatcher(registry).ingest(handle)
+        assert len(registry.get_metrics(handle.run_id)) == 2
+
+    def test_poisonous_object_line_skipped(self, rig):
+        # Well-formed JSON object whose field types blow up _apply.
+        registry, handle = rig
+        _append_raw(
+            handle.paths,
+            0,
+            [
+                json.dumps({"type": "metric", "ts": 1.0, "values": "not-a-dict"}),
+                _metric(7),
+            ],
+        )
+        GangWatcher(registry).ingest(handle)
+        steps = [m["step"] for m in registry.get_metrics(handle.run_id)]
+        assert 7 in steps
+
+    def test_torn_tail_line_deferred_not_dropped(self, rig):
+        registry, handle = rig
+        path = handle.paths.report_file(0)
+        path.write_text(_metric(1) + "\n" + _metric(2)[:10])
+        watcher = GangWatcher(registry)
+        watcher.ingest(handle)
+        assert len(registry.get_metrics(handle.run_id)) == 1
+        with open(path, "a") as fh:
+            fh.write(_metric(2)[10:] + "\n")
+        watcher.ingest(handle)
+        assert [m["step"] for m in registry.get_metrics(handle.run_id)] == [1, 2]
+
+
+class TestBoundedPoll:
+    def test_catchup_drains_in_slices(self, rig):
+        registry, handle = rig
+        lines = [_metric(i) for i in range(50)]
+        _append_raw(handle.paths, 0, lines)
+        budget = len(lines[0]) + 20  # a couple of lines per poll
+        watcher = GangWatcher(registry, max_poll_bytes=budget)
+        for _ in range(len(lines)):
+            watcher.ingest(handle)
+            if len(registry.get_metrics(handle.run_id)) == 50:
+                break
+        assert [m["step"] for m in registry.get_metrics(handle.run_id)] == list(range(50))
+
+    def test_oversized_line_skipped_not_wedged(self, rig):
+        """A single line bigger than the whole poll budget can never
+        terminate inside a bounded read — it must be skipped, and the
+        lines after it still ingested."""
+        registry, handle = rig
+        huge = json.dumps(
+            {"type": "log", "ts": 1.0, "line": "x" * 4096}
+        )
+        _append_raw(handle.paths, 0, [huge, _metric(9)])
+        watcher = GangWatcher(registry, max_poll_bytes=256)
+        for _ in range(40):
+            watcher.ingest(handle)
+        assert [m["step"] for m in registry.get_metrics(handle.run_id)] == [9]
+        # The oversized payload never landed as a log line.
+        assert all(
+            "x" * 4096 not in l["line"] for l in registry.get_logs(handle.run_id)
+        )
+
+    def test_env_knob_sets_budget(self, rig, monkeypatch):
+        registry, _ = rig
+        monkeypatch.setenv("POLYAXON_TPU_WATCHER_POLL_BYTES", "1234")
+        assert GangWatcher(registry).max_poll_bytes == 1234
+
+
+class TestOffsetDurability:
+    def test_restart_resumes_from_durable_cursor(self, rig):
+        """A restarted control plane reattaches with offsets loaded from
+        the registry, not zero — already-ingested lines must not replay."""
+        registry, handle = rig
+        # The status line creates the processes row the durable offset
+        # UPDATE lands on (same order as a real worker's first report).
+        _append_raw(
+            handle.paths,
+            0,
+            [
+                json.dumps({"type": "status", "ts": 1.0, "status": "running"}),
+                _metric(1),
+            ],
+        )
+        GangWatcher(registry).ingest(handle)
+        assert len(registry.get_metrics(handle.run_id)) == 1
+        saved = {
+            p["process_id"]: p["report_offset"]
+            for p in registry.get_processes(handle.run_id)
+        }
+        assert saved[0] > 0
+        # Simulated restart: a fresh handle seeded from the registry.
+        reborn = SimpleNamespace(
+            run_id=handle.run_id,
+            run_uuid=handle.run_uuid,
+            plan=handle.plan,
+            paths=handle.paths,
+            report_offsets=dict(saved),
+            anomaly_marks={},
+        )
+        _append_raw(handle.paths, 0, [_metric(2)])
+        GangWatcher(registry).ingest(reborn)
+        assert [m["step"] for m in registry.get_metrics(handle.run_id)] == [1, 2]
+
+
+class TestProgressAndAnomalyIngestion:
+    def test_interleaved_with_spans_and_metrics(self, rig):
+        registry, handle = rig
+        _append_raw(
+            handle.paths,
+            0,
+            [
+                json.dumps(
+                    {
+                        "type": "span",
+                        "ts": 10.0,
+                        "name": "train:step",
+                        "trace_id": "t1",
+                        "span_id": "0.1",
+                        "parent_id": None,
+                        "start": 10.0,
+                        "duration": 0.25,
+                        "process_id": 0,
+                        "thread": "MainThread",
+                    }
+                ),
+                json.dumps(
+                    {
+                        "type": "progress",
+                        "ts": 11.0,
+                        "at": 10.5,
+                        "step": 42,
+                        "epoch": 2,
+                        "throughput": 33.0,
+                    }
+                ),
+                _metric(42),
+                json.dumps(
+                    {
+                        "type": "anomaly",
+                        "ts": 12.0,
+                        "kind": "stall",
+                        "message": "wedged",
+                        "dump": "/tmp/flightrec-0-1.json",
+                        "age_s": 9.5,
+                    }
+                ),
+            ],
+        )
+        GangWatcher(registry).ingest(handle)
+        (row,) = registry.get_progress(handle.run_id)
+        assert row["step"] == 42 and row["epoch"] == 2
+        assert row["throughput"] == 33.0
+        assert row["at"] == 10.5  # the beat's time, not the line's ts
+        (anom,) = registry.get_anomalies(handle.run_id)
+        assert anom["kind"] == "stall"
+        assert anom["process_id"] == 0
+        assert anom["message"] == "wedged"
+        assert anom["attrs"]["dump"] == "/tmp/flightrec-0-1.json"
+        assert anom["attrs"]["age_s"] == 9.5
+        assert anom["created_at"] == 12.0
+        assert len(registry.get_spans(handle.run_id)) == 1
+        assert len(registry.get_metrics(handle.run_id)) == 1
+
+    def test_progress_upsert_latest_wins(self, rig):
+        registry, handle = rig
+        for step, at in ((1, 10.0), (2, 11.0)):
+            _append_raw(
+                handle.paths,
+                0,
+                [json.dumps({"type": "progress", "ts": at, "at": at, "step": step})],
+            )
+        GangWatcher(registry).ingest(handle)
+        (row,) = registry.get_progress(handle.run_id)
+        assert row["step"] == 2 and row["at"] == 11.0
+
+
+def _seed_progress(registry, run_id, steps, *, at, hb_at):
+    """Progress rows per process + a fresh-enough heartbeat."""
+    for pid, step in enumerate(steps):
+        registry.upsert_progress(run_id, pid, step=step, at=at)
+    registry.ping_heartbeat(run_id, at=hb_at)
+
+
+class TestAnomalyDetection:
+    def _handle(self, run, n=2):
+        return SimpleNamespace(
+            run_id=run.id,
+            run_uuid=run.uuid,
+            plan=SimpleNamespace(num_hosts=n),
+            paths=None,
+            report_offsets={},
+            anomaly_marks={},
+        )
+
+    def test_stall_requires_fresh_heartbeat(self, rig):
+        registry, handle = rig
+        now = 1000.0
+        # Progress stale AND heartbeat stale: that's a zombie (the TTL
+        # cron's business), not a stall.
+        _seed_progress(registry, handle.run_id, [5, 5], at=now - 100, hb_at=now - 100)
+        status = anomaly_status(
+            registry, handle.run_id, now=now, stall_after_s=60.0,
+            heartbeat_fresh_s=30.0,
+        )
+        assert status["stalled"] is False
+        # Heartbeat fresh, progress stale: alive-but-stuck.
+        registry.ping_heartbeat(handle.run_id, at=now - 1)
+        status = anomaly_status(
+            registry, handle.run_id, now=now, stall_after_s=60.0,
+            heartbeat_fresh_s=30.0,
+        )
+        assert status["stalled"] is True
+        assert status["stall_age_s"] == pytest.approx(100, abs=1)
+
+    def test_straggler_needs_two_processes(self, rig):
+        registry, handle = rig
+        now = 1000.0
+        registry.upsert_progress(handle.run_id, 0, step=100, at=now)
+        registry.ping_heartbeat(handle.run_id, at=now)
+        status = anomaly_status(
+            registry, handle.run_id, now=now, straggler_lag_steps=10.0
+        )
+        assert status["stragglers"] == []
+
+    def test_straggler_flagged_against_median(self, rig):
+        registry, handle = rig
+        now = 1000.0
+        for pid, step in enumerate([100, 102, 101, 30]):
+            registry.upsert_progress(handle.run_id, pid, step=step, at=now)
+        registry.ping_heartbeat(handle.run_id, at=now)
+        status = anomaly_status(
+            registry, handle.run_id, now=now, straggler_lag_steps=50.0
+        )
+        (lagger,) = status["stragglers"]
+        assert lagger["process_id"] == 3
+        assert lagger["step"] == 30
+        assert lagger["lag_steps"] >= 50.0
+
+    def test_detect_is_edge_triggered_and_rearms(self, rig):
+        registry, handle = rig
+        stats = MemoryStats()
+        watcher = GangWatcher(
+            registry, stats=stats, stall_after_s=60.0, heartbeat_fresh_s=30.0
+        )
+        now = 1000.0
+        _seed_progress(registry, handle.run_id, [5], at=now - 100, hb_at=now - 1)
+        watcher.detect_anomalies(handle, now=now)
+        watcher.detect_anomalies(handle, now=now + 1)  # same episode
+        stalls = registry.get_anomalies(handle.run_id, kind="stall")
+        assert len(stalls) == 1
+        assert "no progress" in stalls[0]["message"]
+        assert stalls[0]["attrs"]["threshold_s"] == 60.0
+        assert stats.snapshot()["gauges"]["run_stall_age_s"] > 60.0
+        # Recovery: fresh beat resets the gauge and re-arms the edge.
+        registry.upsert_progress(handle.run_id, 0, step=6, at=now + 2)
+        registry.ping_heartbeat(handle.run_id, at=now + 2)
+        watcher.detect_anomalies(handle, now=now + 3)
+        assert stats.snapshot()["gauges"]["run_stall_age_s"] < 60.0
+        _seed_progress(registry, handle.run_id, [6], at=now + 2, hb_at=now + 200)
+        watcher.detect_anomalies(handle, now=now + 200)
+        assert len(registry.get_anomalies(handle.run_id, kind="stall")) == 2
+
+    def test_straggler_rows_per_process(self, rig):
+        registry, handle = rig
+        watcher = GangWatcher(registry, straggler_lag_steps=50.0)
+        now = 1000.0
+        _seed_progress(registry, handle.run_id, [100, 100, 10], at=now, hb_at=now)
+        watcher.detect_anomalies(handle, now=now)
+        watcher.detect_anomalies(handle, now=now + 1)  # deduped
+        (row,) = registry.get_anomalies(handle.run_id, kind="straggler")
+        assert row["process_id"] == 2
+        assert row["attrs"]["lag_steps"] >= 50.0
+        # The straggler catches up; a NEW straggler episode gets a new row.
+        registry.upsert_progress(handle.run_id, 2, step=100, at=now + 2)
+        watcher.detect_anomalies(handle, now=now + 2)
+        registry.upsert_progress(handle.run_id, 2, step=120, at=now + 3)
+        registry.upsert_progress(handle.run_id, 0, step=200, at=now + 3)
+        registry.upsert_progress(handle.run_id, 1, step=200, at=now + 3)
+        watcher.detect_anomalies(handle, now=now + 3)
+        rows = registry.get_anomalies(handle.run_id, kind="straggler")
+        assert len(rows) == 2
+
+
+class TestRegistryAnomalyStore:
+    def test_pagination_and_kind_filter(self, rig):
+        registry, handle = rig
+        for i in range(3):
+            registry.add_anomaly(handle.run_id, "stall", message=f"s{i}")
+        registry.add_anomaly(handle.run_id, "straggler", process_id=1)
+        rows = registry.get_anomalies(handle.run_id)
+        assert len(rows) == 4
+        page = registry.get_anomalies(handle.run_id, limit=2)
+        rest = registry.get_anomalies(handle.run_id, since_id=page[-1]["id"])
+        assert [r["message"] for r in rest if r["kind"] == "stall"] == ["s2"]
+        assert len(registry.get_anomalies(handle.run_id, kind="straggler")) == 1
+
+    def test_delete_run_cascades(self, rig):
+        registry, handle = rig
+        registry.upsert_progress(handle.run_id, 0, step=1)
+        registry.add_anomaly(handle.run_id, "stall")
+        registry.delete_run(handle.run_id)
+        assert registry.get_progress(handle.run_id) == []
+        assert registry.get_anomalies(handle.run_id) == []
