@@ -14,16 +14,10 @@ import json
 import time
 from pathlib import Path
 
-#: bf16 peak FLOP/s per chip by device kind (dense MXU).
-PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+# bf16 peak FLOP/s per chip by device kind (dense MXU) — single source of
+# truth lives in the platform's utilization ledger so bench MFU and the
+# in-product MFU can never disagree about the denominator.
+from polyaxon_tpu.tracking.ledger import PEAK_FLOPS  # noqa: E402
 
 
 def main() -> None:
@@ -414,6 +408,102 @@ def main() -> None:
 
         traceback.print_exc(file=sys.stderr)
 
+    # Ledger ground-truth check: run an lm_train smoke gang through the
+    # REAL platform path (worker ledger → report line → watcher ingest →
+    # goodput roll-up) and compare the platform's MFU against this
+    # benchmark's own out-of-band computation for the same run (reported
+    # tokens/s × analytic FLOPs/token ÷ the shared peak table).  Budget-
+    # asserted like trace_overhead_pct, so the in-product number can
+    # never silently drift from the benchmark's accounting.  The two
+    # measure slightly different windows (the ledger's wall clock
+    # includes model build + compile; reported tokens/s is loop-only), so
+    # the budget is absolute-error with compile-amortization slack.
+    reported_mfu_abs_err = None
+    reported_mfu_ok = None
+    try:
+        import sys
+        import tempfile
+
+        from polyaxon_tpu.monitor.watcher import goodput_status
+        from polyaxon_tpu.orchestrator import Orchestrator
+
+        orch = Orchestrator(
+            tempfile.mkdtemp(), monitor_interval=0.05, heartbeat_interval=0.2
+        )
+        try:
+            run = orch.submit(
+                {
+                    "kind": "experiment",
+                    "run": {
+                        "entrypoint": "polyaxon_tpu.builtins.trainers:lm_train"
+                    },
+                    "declarations": {
+                        "steps": 30,
+                        "batch": 4,
+                        "seq": 64,
+                        "vocab_size": 256,
+                        "d_model": 64,
+                        "n_layers": 2,
+                        "n_heads": 4,
+                        "head_dim": 16,
+                        "d_ff": 128,
+                    },
+                    "environment": {
+                        "topology": {
+                            "accelerator": "cpu-1",
+                            "num_devices": 1,
+                            "num_hosts": 1,
+                        }
+                    },
+                }
+            )
+            orch.wait(run.id, timeout=300)
+            g = goodput_status(orch.registry, run.id)
+            last = orch.registry.get_run(run.id).last_metric or {}
+        finally:
+            orch.stop()
+        if g["rows"] and g["wall_s"] > 0 and last.get("tokens_per_s"):
+            smoke_peak = PEAK_FLOPS.get(g["device_kind"], 197e12) * max(
+                1, g["devices"]
+            )
+            # Platform side: the ledger's FLOPs/wall accounting (its own
+            # MFU is 0.0 off-TPU where peak is unknown — normalize both
+            # sides by the same fallback peak so the check exercises the
+            # numerator everywhere).
+            platform_mfu = g["mfu"] or g["flops"] / (g["wall_s"] * smoke_peak)
+            smoke_cfg = TransformerConfig(
+                vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                head_dim=16, d_ff=128, max_seq=64,
+            )
+            smoke_fpt = (
+                6 * smoke_cfg.n_params
+                + 12 * smoke_cfg.n_layers * smoke_cfg.n_heads
+                * smoke_cfg.head_dim * 64
+            )
+            bench_mfu = last["tokens_per_s"] * smoke_fpt / smoke_peak
+            reported_mfu_abs_err = abs(platform_mfu - bench_mfu)
+            mfu_budget = 0.15 if on_tpu else 0.05
+            reported_mfu_ok = reported_mfu_abs_err <= mfu_budget
+            if not reported_mfu_ok:
+                print(
+                    f"bench: reported_mfu_abs_err={reported_mfu_abs_err:.4f} "
+                    f"exceeds the {mfu_budget} budget — the platform ledger "
+                    "disagrees with the benchmark's MFU accounting",
+                    file=sys.stderr,
+                )
+        else:
+            print(
+                "bench: lm_train smoke gang produced no usable ledger "
+                f"roll-up (rows={g['rows']}, wall={g['wall_s']:.2f}, "
+                f"tokens_per_s={last.get('tokens_per_s')})",
+                file=sys.stderr,
+            )
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+
     # Serving: the continuous-batching engine under CONCURRENT load vs the
     # same requests one-at-a-time through generate().  Decode is
     # memory-bound, so a batched slot step costs about what a B=1 step
@@ -714,6 +804,12 @@ def main() -> None:
                     else None
                 ),
                 "stall_detect_ok": stall_detect_ok,
+                "reported_mfu_abs_err": (
+                    round(reported_mfu_abs_err, 5)
+                    if reported_mfu_abs_err is not None
+                    else None
+                ),
+                "reported_mfu_ok": reported_mfu_ok,
             }
         )
     )

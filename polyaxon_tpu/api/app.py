@@ -19,9 +19,13 @@ from typing import Any, Dict, Optional
 from polyaxon_tpu.db.registry import Run, RunRegistry
 from polyaxon_tpu.events import EventTypes
 from polyaxon_tpu.exceptions import PolyaxonTPUError
-from polyaxon_tpu.monitor.watcher import anomaly_status
+from polyaxon_tpu.monitor.watcher import anomaly_status, goodput_status
 from polyaxon_tpu.orchestrator import Orchestrator
-from polyaxon_tpu.stats.metrics import PROMETHEUS_CONTENT_TYPE, render_prometheus
+from polyaxon_tpu.stats.metrics import (
+    PROMETHEUS_CONTENT_TYPE,
+    render_prometheus,
+    render_standard_gauges,
+)
 from polyaxon_tpu.tracking.trace import chrome_trace
 
 logger = logging.getLogger(__name__)
@@ -172,6 +176,9 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             body = render_prometheus(
                 snapshot_fn(), labels={"component": "control_plane"}
             )
+        # Exposition hygiene: standard process/build gauges render even
+        # when the stats backend keeps no registry.
+        body += render_standard_gauges(labels={"component": "control_plane"})
         return web.Response(
             body=body.encode("utf-8"),
             headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
@@ -271,6 +278,8 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         if run.is_done:
             status.update(stalled=False, stall_age_s=0.0, stragglers=[])
         payload["anomalies"] = status
+        # Goodput/MFU roll-up block (no timeline — /goodput serves that).
+        payload["goodput"] = goodput_status(reg, run.id, timeline_limit=0)
         return web.json_response(payload)
 
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/stop")
@@ -410,7 +419,31 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         # chrome://tracing; pid = gang process id).
         run = _run_or_404(request)
         spans = reg.get_spans(run.id, since_id=_int_param(request, "since_id", 0))
+        fmt = request.rel_url.query.get("format", "chrome")
+        if fmt == "spans":
+            # Raw registry rows for programmatic consumers.
+            return web.json_response({"results": spans})
+        if fmt != "chrome":
+            return web.json_response(
+                {"error": f"unknown timeline format {fmt!r}"}, status=400
+            )
         return web.json_response(chrome_trace(spans))
+
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/goodput")
+    async def get_goodput(request):
+        # Per-run utilization ledger: gang-wide wall-clock decomposition
+        # (buckets sum to wall time), goodput ratio, live MFU timeline,
+        # compile/HBM telemetry — plus the raw per-process ledger rows
+        # with since_id/limit paging for pollers.
+        run = _run_or_404(request)
+        status = goodput_status(reg, run.id)
+        rows = reg.get_utilization(
+            run.id,
+            since_id=_int_param(request, "since_id", 0),
+            limit=_int_param(request, "limit"),
+        )
+        status["results"] = rows
+        return web.json_response(status)
 
     @routes.get(f"{API_PREFIX}/runs/{{run_id}}/anomalies")
     async def get_anomalies(request):

@@ -185,6 +185,7 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
                 from polyaxon_tpu.stats.metrics import (
                     PROMETHEUS_CONTENT_TYPE,
                     render_prometheus,
+                    render_standard_gauges,
                 )
 
                 snapshot_fn = getattr(engine.stats_registry, "snapshot", None)
@@ -194,6 +195,7 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
                     text = render_prometheus(
                         snapshot_fn(), labels={"component": "lm_server"}
                     )
+                text += render_standard_gauges(labels={"component": "lm_server"})
                 body = text.encode("utf-8")
                 self.send_response(200)
                 self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)

@@ -100,6 +100,21 @@ def resume_counter(ctx: Context) -> None:
     ctx.log_text(f"resume_counter attempt {n + 1}")
 
 
+def _should_measure_flops(ctx: Context, backend: str) -> bool:
+    """Whether to probe per-step FLOPs via XLA cost analysis.
+
+    The probe (``lower().compile()``) costs one extra compile of the
+    step, so ``auto`` measures only on CPU (where compiles are cheap and
+    the e2e path exercises cost analysis) and trusts the analytic
+    estimate on TPU.  ``flops_probe: measure|analytic`` overrides."""
+    mode = str(ctx.get_param("flops_probe", "auto"))
+    if mode == "measure":
+        return True
+    if mode == "analytic":
+        return False
+    return backend == "cpu"
+
+
 def _train_image_classifier(
     ctx: Context,
     *,
@@ -109,6 +124,7 @@ def _train_image_classifier(
     init_fn,
     axes_tree,
     optimizer,
+    flops_per_example: float = 0.0,
 ) -> None:
     """Shared image-classifier train loop (cnn_train / vit_train).
 
@@ -141,7 +157,12 @@ def _train_image_classifier(
     from polyaxon_tpu.runtime.data import global_batch_from_host_data
     from polyaxon_tpu.runtime.pipeline import MetricsDrain, TrainPipeline
     from polyaxon_tpu.runtime.train import build_train_step
+    from polyaxon_tpu.tracking.ledger import get_ledger
     from polyaxon_tpu.tracking.profiling import StepClock, StepProfiler
+
+    # Arm the utilization ledger first: model build, jit init, and data
+    # setup all belong to this run's wall clock.
+    led = get_ledger().start(source="train")
 
     steps = int(ctx.get_param("steps", 20))
     batch_size = int(ctx.get_param("batch", 64))
@@ -242,14 +263,29 @@ def _train_image_classifier(
     progress = get_progress()
     metrics = None
     batch = None
+    # FLOPs denominator for live MFU: XLA cost analysis of the compiled
+    # step where cheap (see _should_measure_flops — probed in-loop, once
+    # the first real batch exists), analytic conv/attention estimate
+    # otherwise.
+    measure_flops = _should_measure_flops(ctx, jax.default_backend())
+    led.set_flops_per_step(flops_per_example * batch_size)
+    data_wait_accounted = 0.0
     t0 = time.time()
     clock.start()
+    led.mark_loop_start()
     try:
         with tracer.span("train:loop", steps=steps - start_step):
             for i in range(start_step, steps):
                 profiler.on_step(i)
                 with tracer.span("train:step", sample=tracer.hot_sample, step=i):
                     batch = next(pipe)
+                    if measure_flops and i == start_step:
+                        # One extra compile, attributed to the compile
+                        # bucket by the ledger (mark_loop_start).
+                        led.set_flops_per_step(
+                            ts.step_flops(params, opt_state, batch, key)
+                            or flops_per_example * batch_size
+                        )
                     params, opt_state, metrics = ts.step(
                         params, opt_state, batch, key
                     )
@@ -262,7 +298,12 @@ def _train_image_classifier(
                 step_dt = clock.tick()
                 if step_dt is not None:
                     run_stats.timing("train.step_wall_s", step_dt)
-                run_stats.timing("train.data_wait_s", pipe.pop_data_wait_s())
+                dwait = pipe.pop_data_wait_s()
+                run_stats.timing("train.data_wait_s", dwait)
+                led.account("data_wait_s", dwait)
+                data_wait_accounted += dwait
+                led.step(step_dt, tokens=batch_size)
+                led.maybe_flush()
                 # Feed the stall watchdog (tracking/flightrec.py): a beat
                 # per step keeps the adaptive deadline honest.
                 progress.beat(step=i)
@@ -278,6 +319,15 @@ def _train_image_classifier(
         if ckpt is not None:
             ckpt.wait_until_finished()
             ckpt.close()
+    # Ledger finalization (every process — the gang roll-up sums hosts):
+    # residual data waits not popped in-loop, checkpoint write blocks,
+    # the drain backlog paid at close.  A crashed run skips this; the
+    # worker's exit flush ships whatever was accounted by then.
+    led.account("data_wait_s", max(0.0, pipe.data_wait_s - data_wait_accounted))
+    if ckpt is not None:
+        led.account("ckpt_block_s", ckpt.save_block_s)
+    led.account("metric_drain_s", drain.close_wait_s)
+    led.flush(final=True)
     steps_run = steps - start_step
     if steps_run <= 0 or batch is None:
         if ctx.is_leader:
@@ -313,6 +363,7 @@ def cnn_train(ctx: Context) -> None:
     import optax
 
     from polyaxon_tpu.models import cnn
+    from polyaxon_tpu.tracking.ledger import conv_classifier_flops_per_image
 
     cfg = cnn.CNNConfig(
         image_size=int(ctx.get_param("image_size", 32)),
@@ -337,6 +388,13 @@ def cnn_train(ctx: Context) -> None:
         init_fn=lambda k: cnn.init_params(k, cfg),
         axes_tree=cnn.param_axes(cfg),
         optimizer=optax.adamw(float(ctx.get_param("lr", 1e-3))),
+        flops_per_example=conv_classifier_flops_per_image(
+            cfg.image_size,
+            cfg.in_channels,
+            cfg.channels,
+            cfg.dense_dim,
+            cfg.n_classes,
+        ),
     )
 
 
@@ -352,6 +410,7 @@ def vit_train(ctx: Context) -> None:
     import optax
 
     from polyaxon_tpu.models import vit
+    from polyaxon_tpu.tracking.ledger import transformer_flops_per_token
 
     d_model = int(ctx.get_param("d_model", 192))
     n_heads = int(ctx.get_param("n_heads", 6))
@@ -379,6 +438,15 @@ def vit_train(ctx: Context) -> None:
         optimizer=optax.adamw(
             float(ctx.get_param("lr", 1e-3)), mu_dtype=jnp.bfloat16
         ),
+        # A ViT image is a num_patches-token transformer sequence.
+        flops_per_example=transformer_flops_per_token(
+            cfg.n_params,
+            cfg.n_layers,
+            cfg.n_heads,
+            cfg.head_dim,
+            cfg.num_patches,
+        )
+        * cfg.num_patches,
     )
 
 
@@ -522,7 +590,12 @@ def lm_train(ctx: Context) -> None:
     )
     from polyaxon_tpu.parallel import template_for
     from polyaxon_tpu.runtime.train import build_train_step
+    from polyaxon_tpu.tracking.ledger import (
+        get_ledger,
+        transformer_flops_per_token,
+    )
 
+    led = get_ledger().start(source="train")
     steps = int(ctx.get_param("steps", 10))
     batch_size = int(ctx.get_param("batch", 8))
     seq = int(ctx.get_param("seq", 128))
@@ -599,8 +672,21 @@ def lm_train(ctx: Context) -> None:
     run_stats = get_stats()
     progress = get_progress()
     metrics = None
+    # FLOPs denominator for live MFU: XLA cost analysis where cheap (one
+    # extra compile — see _should_measure_flops), else the analytic
+    # 6N + attention accounting bench.py uses.
+    analytic = transformer_flops_per_token(
+        cfg.n_params, cfg.n_layers, cfg.n_heads, cfg.head_dim, seq
+    ) * (batch_size * seq)
+    measured = (
+        ts.step_flops(params, opt_state, batch, key)
+        if _should_measure_flops(ctx, jax.default_backend())
+        else None
+    )
+    led.set_flops_per_step(measured or analytic)
     t0 = time.time()
     clock.start()
+    led.mark_loop_start()
     try:
         with tracer.span("train:loop", steps=steps - start_step):
             for i in range(start_step, steps):
@@ -619,6 +705,8 @@ def lm_train(ctx: Context) -> None:
                 step_dt = clock.tick()
                 if step_dt is not None:
                     run_stats.timing("train.step_wall_s", step_dt)
+                led.step(step_dt, tokens=batch_size * seq)
+                led.maybe_flush()
                 progress.beat(step=i)
         jax.block_until_ready(params)
         dt = time.time() - t0
@@ -628,6 +716,11 @@ def lm_train(ctx: Context) -> None:
         if ckpt is not None:
             ckpt.wait_until_finished()
             ckpt.close()
+    # Ledger finalization (every process — the gang roll-up sums hosts).
+    if ckpt is not None:
+        led.account("ckpt_block_s", ckpt.save_block_s)
+    led.account("metric_drain_s", drain.close_wait_s)
+    led.flush(final=True)
     steps_run = steps - start_step
     if steps_run <= 0:
         if ctx.is_leader:

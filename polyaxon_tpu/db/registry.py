@@ -151,6 +151,32 @@ CREATE TABLE IF NOT EXISTS anomalies (
 );
 CREATE INDEX IF NOT EXISTS ix_anomalies_run ON anomalies (run_id);
 
+CREATE TABLE IF NOT EXISTS utilization (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_id INTEGER NOT NULL,
+    process_id INTEGER,
+    seq INTEGER,
+    source TEXT,
+    wall_s REAL,
+    buckets TEXT,
+    steps INTEGER,
+    tokens INTEGER,
+    flops REAL,
+    goodput REAL,
+    mfu REAL,
+    tokens_per_device_s REAL,
+    compile_s REAL,
+    compile_events INTEGER,
+    hbm_peak_bytes REAL,
+    devices INTEGER,
+    device_kind TEXT,
+    peak_flops_per_s REAL,
+    final INTEGER NOT NULL DEFAULT 0,
+    attrs TEXT,
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_utilization_run ON utilization (run_id);
+
 CREATE TABLE IF NOT EXISTS iterations (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
     group_id INTEGER NOT NULL,
@@ -654,6 +680,7 @@ class RunRegistry:
                 ("spans", "run_id"),
                 ("progress", "run_id"),
                 ("anomalies", "run_id"),
+                ("utilization", "run_id"),
                 ("heartbeats", "run_id"),
                 ("processes", "run_id"),
                 ("bookmarks", "run_id"),
@@ -905,6 +932,113 @@ class RunRegistry:
             span = dict(r)
             span["attrs"] = json.loads(span["attrs"]) if span["attrs"] else {}
             out.append(span)
+        return out
+
+    # -- utilization ledger ----------------------------------------------------
+    def add_utilization(
+        self,
+        run_id: int,
+        row: Dict[str, Any],
+        process_id: Optional[int] = None,
+    ) -> None:
+        """Store one utilization-ledger row (a ``ledger`` report event).
+
+        ``row`` is the record shape tracking/ledger.py emits — unknown
+        keys are folded into ``attrs`` so the channel can grow fields
+        without a schema change."""
+        known = {
+            "seq",
+            "source",
+            "wall_s",
+            "buckets",
+            "steps",
+            "tokens",
+            "flops",
+            "goodput",
+            "mfu",
+            "tokens_per_device_s",
+            "compile_s",
+            "compile_events",
+            "hbm_peak_bytes",
+            "devices",
+            "device_kind",
+            "peak_flops_per_s",
+            "final",
+            "process_id",
+            "attrs",
+        }
+        attrs = dict(row.get("attrs") or {})
+        for key, value in row.items():
+            if key not in known and key not in ("type", "ts"):
+                attrs[key] = value
+        if process_id is None:
+            process_id = row.get("process_id")
+        buckets = row.get("buckets") or {}
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO utilization
+                   (run_id, process_id, seq, source, wall_s, buckets, steps,
+                    tokens, flops, goodput, mfu, tokens_per_device_s,
+                    compile_s, compile_events, hbm_peak_bytes, devices,
+                    device_kind, peak_flops_per_s, final, attrs, created_at)
+                   VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)""",
+                (
+                    run_id,
+                    process_id,
+                    int(row.get("seq") or 0),
+                    str(row.get("source") or "train"),
+                    float(row.get("wall_s") or 0.0),
+                    json.dumps(buckets) if buckets else None,
+                    int(row.get("steps") or 0),
+                    int(row.get("tokens") or 0),
+                    float(row.get("flops") or 0.0),
+                    float(row.get("goodput") or 0.0),
+                    float(row.get("mfu") or 0.0),
+                    float(row.get("tokens_per_device_s") or 0.0),
+                    float(row.get("compile_s") or 0.0),
+                    int(row.get("compile_events") or 0),
+                    float(row.get("hbm_peak_bytes") or 0.0),
+                    int(row.get("devices") or 0),
+                    str(row.get("device_kind") or ""),
+                    float(row.get("peak_flops_per_s") or 0.0),
+                    1 if row.get("final") else 0,
+                    json.dumps(attrs) if attrs else None,
+                    float(row.get("ts") or time.time()),
+                ),
+            )
+
+    def get_utilization(
+        self,
+        run_id: int,
+        *,
+        process_id: Optional[int] = None,
+        since_id: int = 0,
+        limit: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        """Ledger rows for a run in ingest order (rows are cumulative per
+        process — the latest per process_id is its current truth)."""
+        sql = (
+            "SELECT id, process_id, seq, source, wall_s, buckets, steps,"
+            " tokens, flops, goodput, mfu, tokens_per_device_s, compile_s,"
+            " compile_events, hbm_peak_bytes, devices, device_kind,"
+            " peak_flops_per_s, final, attrs, created_at"
+            " FROM utilization WHERE run_id = ? AND id > ?"
+        )
+        params: List[Any] = [run_id, since_id]
+        if process_id is not None:
+            sql += " AND process_id = ?"
+            params.append(process_id)
+        sql += " ORDER BY id"
+        if limit is not None:
+            sql += f" LIMIT {int(limit)}"
+        rows = self._conn().execute(sql, params).fetchall()
+        out: List[Dict[str, Any]] = []
+        for r in rows:
+            rec = dict(r)
+            rec["buckets"] = json.loads(rec["buckets"]) if rec["buckets"] else {}
+            rec["attrs"] = json.loads(rec["attrs"]) if rec["attrs"] else {}
+            rec["final"] = bool(rec["final"])
+            out.append(rec)
         return out
 
     # -- heartbeats -----------------------------------------------------------
@@ -1489,11 +1623,17 @@ class RunRegistry:
                    (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
                 (cutoff, cutoff),
             ).rowcount
+            utilization = conn.execute(
+                """DELETE FROM utilization WHERE created_at < ? AND run_id IN
+                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
+                (cutoff, cutoff),
+            ).rowcount
         return {
             "activity": act,
             "logs": logs,
             "spans": spans,
             "anomalies": anomalies,
+            "utilization": utilization,
         }
 
     # -- projects (entity metadata over runs.project) --------------------------

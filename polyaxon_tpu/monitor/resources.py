@@ -65,29 +65,75 @@ def sample_process(pid: Optional[int] = None) -> Dict[str, float]:
     return out
 
 
+# Probe-once gate for the accelerator sampler: CPU and older PJRT backends
+# have no memory_stats() — the first sample that yields no memory telemetry
+# disables the device sampler for the process lifetime instead of paying a
+# device walk (and swallowing an exception) on every tick.
+_device_probe_ok: Optional[bool] = None
+_device_probe_lock = threading.Lock()
+_hbm_peak_mb = 0.0
+
+
+def _reset_device_probe() -> None:
+    """Re-arm the probe (tests; a process never needs this)."""
+    global _device_probe_ok, _hbm_peak_mb
+    with _device_probe_lock:
+        _device_probe_ok = None
+        _hbm_peak_mb = 0.0
+
+
 def sample_devices() -> Dict[str, float]:
-    """Per-local-device HBM usage from the PJRT client, if initialized."""
+    """Per-local-device HBM usage from the PJRT client, if initialized.
+
+    Degrades gracefully: the first sample without memory telemetry turns
+    the sampler off (``_device_probe_ok = False``) rather than raising —
+    or even probing — on every tick.  Emits per-device current and peak
+    usage plus an aggregate ``sys/hbm_peak_mb`` high-water mark.
+    """
+    global _device_probe_ok, _hbm_peak_mb
     out: Dict[str, float] = {}
     import sys
 
     if "jax" not in sys.modules:
         # No jax in this process yet → no PJRT client to sample, and the
         # telemetry thread must not be the thing that pays the jax import
-        # (non-jax gang workloads boot ~2s faster without it).
+        # (non-jax gang workloads boot ~2s faster without it).  Leaves the
+        # probe unanswered: jax may still be imported later.
         return out
+    with _device_probe_lock:
+        if _device_probe_ok is False:
+            return out
+    total_peak_mb = 0.0
+    got_any = False
     try:
         import jax
 
         for d in jax.local_devices():
-            stats = d.memory_stats() or {}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                stats = {}
             in_use = stats.get("bytes_in_use")
             limit = stats.get("bytes_limit")
+            peak = stats.get("peak_bytes_in_use")
             if in_use is not None:
+                got_any = True
                 out[f"sys/hbm{d.id}_mb"] = in_use / 1e6
             if in_use is not None and limit:
                 out[f"sys/hbm{d.id}_frac"] = in_use / limit
+            if peak is not None:
+                out[f"sys/hbm{d.id}_peak_mb"] = peak / 1e6
+                total_peak_mb += peak / 1e6
+            elif in_use is not None:
+                total_peak_mb += in_use / 1e6
     except Exception:
         pass
+    with _device_probe_lock:
+        if _device_probe_ok is None:
+            _device_probe_ok = got_any
+        if got_any:
+            _hbm_peak_mb = max(_hbm_peak_mb, total_peak_mb)
+            out["sys/hbm_peak_mb"] = _hbm_peak_mb
     return out
 
 

@@ -100,6 +100,103 @@ def anomaly_status(
     return out
 
 
+def goodput_status(
+    registry: RunRegistry,
+    run_id: int,
+    *,
+    timeline_limit: int = 200,
+) -> Dict[str, Any]:
+    """Gang-wide goodput/MFU roll-up over ingested utilization rows.
+
+    Pure read — shared by the watcher's gauge refresh and the API's
+    ``/goodput`` endpoint and run-detail payload.
+
+    Ledger rows are *cumulative per process*, so the latest row per
+    process_id is that host's current truth; the gang aggregate sums
+    FLOPs/tokens/buckets across those, takes the max per-process wall as
+    the run's wall clock, and recomputes the ratios from the sums (so a
+    straggling host drags the gang's goodput down, exactly as it drags
+    the real run).  Empty until the first ledger row lands
+    (``rows == 0``).
+    """
+    rows = registry.get_utilization(run_id)
+    out: Dict[str, Any] = {
+        "rows": len(rows),
+        "processes": 0,
+        "wall_s": 0.0,
+        "buckets": {},
+        "goodput_ratio": 0.0,
+        "mfu": 0.0,
+        "flops": 0.0,
+        "tokens": 0,
+        "steps": 0,
+        "tokens_per_device_s": 0.0,
+        "compile_s": 0.0,
+        "compile_events": 0,
+        "hbm_peak_bytes": 0.0,
+        "devices": 0,
+        "device_kind": "",
+        "final": False,
+        "timeline": [],
+    }
+    if not rows:
+        return out
+    latest: Dict[Any, Dict[str, Any]] = {}
+    for r in rows:
+        latest[r["process_id"]] = r  # ingest order: last wins
+    per_proc = list(latest.values())
+    out["processes"] = len(per_proc)
+    out["wall_s"] = max(r["wall_s"] or 0.0 for r in per_proc)
+    out["flops"] = sum(r["flops"] or 0.0 for r in per_proc)
+    out["tokens"] = sum(r["tokens"] or 0 for r in per_proc)
+    out["steps"] = max(r["steps"] or 0 for r in per_proc)
+    out["compile_s"] = sum(r["compile_s"] or 0.0 for r in per_proc)
+    out["compile_events"] = sum(r["compile_events"] or 0 for r in per_proc)
+    out["hbm_peak_bytes"] = sum(r["hbm_peak_bytes"] or 0.0 for r in per_proc)
+    out["devices"] = sum(r["devices"] or 0 for r in per_proc)
+    out["device_kind"] = next(
+        (r["device_kind"] for r in per_proc if r["device_kind"]), ""
+    )
+    out["final"] = all(r["final"] for r in per_proc)
+    buckets: Dict[str, Dict[str, float]] = {}
+    for r in per_proc:
+        for name, secs in (r["buckets"] or {}).items():
+            secs = float(secs or 0.0)
+            agg = buckets.setdefault(
+                name, {"sum": 0.0, "min": secs, "max": secs}
+            )
+            agg["sum"] += secs
+            agg["min"] = min(agg["min"], secs)
+            agg["max"] = max(agg["max"], secs)
+    out["buckets"] = buckets
+    total_wall = sum(r["wall_s"] or 0.0 for r in per_proc)
+    step_compute = buckets.get("step_compute_s", {}).get("sum", 0.0)
+    if total_wall > 0:
+        out["goodput_ratio"] = min(1.0, step_compute / total_wall)
+    peak_total = sum(r["peak_flops_per_s"] or 0.0 for r in per_proc)
+    if out["wall_s"] > 0 and peak_total > 0:
+        out["mfu"] = out["flops"] / (out["wall_s"] * peak_total)
+    if out["wall_s"] > 0 and out["devices"] > 0:
+        out["tokens_per_device_s"] = out["tokens"] / (
+            out["wall_s"] * out["devices"]
+        )
+    # MFU/goodput trajectory: every ingested row is a point (cumulative
+    # averages, so the curve converges rather than jitters).
+    # ``timeline_limit=0`` skips the timeline (run-detail wants the
+    # roll-up only).
+    for r in rows[-timeline_limit:] if timeline_limit > 0 else []:
+        out["timeline"].append(
+            {
+                "at": r["created_at"],
+                "process_id": r["process_id"],
+                "mfu": r["mfu"] or 0.0,
+                "goodput": r["goodput"] or 0.0,
+                "wall_s": r["wall_s"] or 0.0,
+            }
+        )
+    return out
+
+
 class GangWatcher:
     """Stateless-per-call watcher; tail cursors live on the GangHandle."""
 
@@ -221,6 +318,8 @@ class GangWatcher:
             self.registry.add_log(run_id, event.get("line", ""), process_id=process_id)
         elif etype == "span":
             self.registry.add_span(run_id, event, process_id=process_id)
+        elif etype == "ledger":
+            self.registry.add_utilization(run_id, event, process_id=process_id)
         elif etype == "heartbeat":
             self.registry.ping_heartbeat(run_id, at=event.get("ts"))
         elif etype == "progress":
@@ -378,6 +477,28 @@ class GangWatcher:
             self.stats.gauge("straggler_lag_steps", float(worst))
         return status
 
+    # -- goodput gauges --------------------------------------------------------
+    def _refresh_goodput_gauges(self, handle: GangHandle) -> None:
+        """Publish the gang's current goodput/MFU roll-up as gauges.
+
+        No-op until the first ledger row lands — the gauges should show
+        the last real measurement, never a synthetic zero."""
+        if self.stats is None:
+            return
+        try:
+            status = goodput_status(self.registry, handle.run_id)
+        except Exception:
+            logger.warning(
+                "Goodput roll-up failed for run %d", handle.run_id, exc_info=True
+            )
+            return
+        if not status["rows"]:
+            return
+        self.stats.gauge("run_goodput_ratio", float(status["goodput_ratio"]))
+        self.stats.gauge("run_mfu", float(status["mfu"]))
+        self.stats.gauge("run_compile_s_total", float(status["compile_s"]))
+        self.stats.gauge("run_hbm_peak_bytes", float(status["hbm_peak_bytes"]))
+
     def observe(self, handle: GangHandle) -> Optional[str]:
         """One poll: ingest reports, reconcile liveness, return gang roll-up."""
         tracer = get_tracer()
@@ -400,6 +521,7 @@ class GangWatcher:
                         handle.run_id,
                         exc_info=True,
                     )
+                self._refresh_goodput_gauges(handle)
             elif self.stats is not None:
                 # A run that goes terminal mid-episode must not pin the
                 # alarm gauges at its last stalled value.
@@ -408,4 +530,14 @@ class GangWatcher:
                     self.stats.gauge("run_stall_age_s", 0.0)
                     self.stats.gauge("straggler_lag_steps", 0.0)
                     marks.clear()
+                # Unlike the alarm gauges, goodput/MFU *freeze* at the
+                # run's final truth: one last refresh picks up the final
+                # ledger rows ingested this same poll, then stops — the
+                # gauges keep reporting what the run achieved.
+                if not getattr(handle, "goodput_frozen", False):
+                    self._refresh_goodput_gauges(handle)
+                    try:
+                        handle.goodput_frozen = True
+                    except Exception:
+                        pass
             return rollup

@@ -280,6 +280,9 @@ class MetricsDrain:
         #: Last drained values / step (host floats) — for end-of-run logs.
         self.last: Dict[str, float] = {}
         self.last_step: Optional[int] = None
+        #: Seconds ``close()`` spent draining the backlog — the run's
+        #: "metric-drain" ledger bucket (in-loop drains overlap compute).
+        self.close_wait_s = 0.0
         self._thread = threading.Thread(
             target=self._run, name="metrics-drain", daemon=True
         )
@@ -308,7 +311,11 @@ class MetricsDrain:
 
     def close(self) -> None:
         """Drain everything queued, join the thread, surface any error."""
-        self._q.put(self._DONE)
-        self._thread.join()
+        t0 = time.perf_counter()
+        try:
+            self._q.put(self._DONE)
+            self._thread.join()
+        finally:
+            self.close_wait_s += time.perf_counter() - t0
         if self._error is not None:
             raise self._error

@@ -71,6 +71,15 @@ class TrainStep:
             lambda x: jax.device_put(x, self.batch_sharding), batch
         )
 
+    def step_flops(self, *args: Any) -> Optional[float]:
+        """Total FLOPs of one step from XLA's cost analysis, or None where
+        the backend exposes none — the utilization ledger's measured path
+        (callers fall back to analytic estimates).  Costs one extra
+        compile: ``lower().compile()`` does not populate the jit cache."""
+        from polyaxon_tpu.tracking.ledger import compiled_flops
+
+        return compiled_flops(self.step, *args)
+
 
 def build_train_step(
     *,

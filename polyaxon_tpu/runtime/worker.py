@@ -109,6 +109,12 @@ def main() -> int:
         process_id=info.process_id,
         trace_id=info.run_uuid or None,
     )
+    # Same wiring for the utilization ledger: workloads that feed it
+    # (trainers, serving engine) get their goodput/MFU rows shipped as
+    # typed ``ledger`` report lines.  Imports no jax.
+    from polyaxon_tpu.tracking import ledger as ledger_mod
+
+    ledger_mod.configure(sink=reporter.ledger, process_id=info.process_id)
     reporter.status("starting")
     reporter.start_heartbeat(info.heartbeat_interval)
     from polyaxon_tpu.tracking.flightrec import FlightRecorder, get_progress
@@ -229,6 +235,12 @@ def main() -> int:
     finally:
         recorder.stop()
         sampler.stop()
+        # Final ledger row (no-op if the workload never armed it): the
+        # run's last cumulative truth, flagged final for consumers.
+        try:
+            ledger_mod.get_ledger().flush(final=True)
+        except Exception:
+            pass
         reporter.close()
 
 

@@ -226,6 +226,13 @@ class ServingEngine:
         self._n_tokens = 0
         self._n_steps = 0
         self._window: "deque[tuple]" = deque()  # (t, n_tokens)
+        # Decode-side utilization ledger (armed in start()): device-busy
+        # seconds (prefill + decode dispatch/sync) and occupancy-weighted
+        # busy time — the serving analogue of train-side goodput/MFU.
+        self._ledger: Optional[Any] = None
+        self._started_at: Optional[float] = None
+        self._busy_s = 0.0
+        self._occ_weighted_s = 0.0
 
     # -- compiled functions ----------------------------------------------------
 
@@ -297,6 +304,10 @@ class ServingEngine:
 
     def start(self) -> "ServingEngine":
         if self._thread is None:
+            from polyaxon_tpu.tracking.ledger import get_ledger
+
+            self._ledger = get_ledger().start(source="serving")
+            self._started_at = time.time()
             self._thread = threading.Thread(
                 target=self._loop, name="serving-engine", daemon=True
             )
@@ -310,6 +321,10 @@ class ServingEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self._ledger is not None:
+            self._ledger.merge_extra(**self._utilization_snapshot())
+            self._ledger.flush(final=True)
+            self._ledger = None
         # Fail anything still queued or in flight so waiters unblock.
         with self._cv:
             pending = list(self._queue)
@@ -358,7 +373,40 @@ class ServingEngine:
         """Blocking convenience: submit + wait."""
         return self.submit(prompt, max_new_tokens, temperature).wait(timeout)
 
+    def _utilization_snapshot(self) -> Dict[str, float]:
+        """Decode-side utilization: busy fraction of wall clock since
+        start(), mean slot occupancy while busy, and their product — the
+        serving equivalent of the train ledger's goodput × MFU."""
+        with self._stats_lock:
+            busy = self._busy_s
+            occw = self._occ_weighted_s
+        elapsed = (
+            time.time() - self._started_at if self._started_at else 0.0
+        )
+        busy_frac = busy / elapsed if elapsed > 0 else 0.0
+        occ = occw / busy if busy > 0 else 0.0
+        return {
+            "decode_busy_frac": round(busy_frac, 6),
+            "slot_occupancy": round(occ, 6),
+            "decode_utilization": round(busy_frac * occ, 6),
+        }
+
+    def _ledger_account(self, dt: float, occ_frac: float, tokens: int) -> None:
+        """Fold one device-busy interval into the utilization ledger."""
+        with self._stats_lock:
+            self._busy_s += dt
+            self._occ_weighted_s += dt * occ_frac
+        led = self._ledger
+        if led is None:
+            return
+        led.account("step_compute_s", dt)
+        if tokens:
+            led.step(tokens=tokens)
+        led.merge_extra(**self._utilization_snapshot())
+        led.maybe_flush()
+
     def stats(self) -> Dict[str, Any]:
+        util = self._utilization_snapshot()
         with self._stats_lock:
             now = time.time()
             while self._window and now - self._window[0][0] > 10.0:
@@ -378,6 +426,7 @@ class ServingEngine:
                 "decode_steps": self._n_steps,
                 "tokens_per_s": round(tps, 1),
                 "max_len": self.max_len,
+                **util,
             }
 
     def latency_summaries(self) -> Dict[str, Dict[str, float]]:
@@ -444,6 +493,7 @@ class ServingEngine:
     def _prefill_into(self, slot: int, req: GenerationRequest) -> None:
         import jax.numpy as jnp
 
+        t0 = time.perf_counter()
         req.started_at = time.time()
         self.stats_registry.timing(
             "serving.queue_wait_s", req.started_at - req.submitted_at
@@ -468,6 +518,11 @@ class ServingEngine:
             self._pos[slot] = t
             self._temps[slot] = req.temperature
             self._active[slot] = True
+        # Prefill is device-busy time serving one request (+ its first
+        # emitted token).
+        self._ledger_account(
+            time.perf_counter() - t0, 1.0 / self.slots, tokens=1
+        )
 
     def _pick_first(self, logits: np.ndarray, temperature: float) -> int:
         """First generated token comes from the prefill logits (exactly
@@ -510,10 +565,10 @@ class ServingEngine:
             self._window.append((time.time(), n_live))
         # The step advances every live slot one token, so its wall time IS
         # the per-token decode latency each of those requests observed.
-        self.stats_registry.timing(
-            "serving.decode_step_s", time.perf_counter() - t0
-        )
+        step_dt = time.perf_counter() - t0
+        self.stats_registry.timing("serving.decode_step_s", step_dt)
         self.stats_registry.observe("serving.batch_occupancy", float(n_live))
+        self._ledger_account(step_dt, n_live / self.slots, tokens=n_live)
         self._progress.beat(step=self._n_steps)
 
     def _emit(self, slot: int, req: GenerationRequest, tok: int) -> None:

@@ -6,6 +6,7 @@ from polyaxon_tpu.stats.metrics import (
     Histogram,
     default_buckets,
     render_prometheus,
+    render_standard_gauges,
 )
 
 __all__ = [
@@ -16,6 +17,7 @@ __all__ = [
     "Histogram",
     "default_buckets",
     "render_prometheus",
+    "render_standard_gauges",
     "PROMETHEUS_CONTENT_TYPE",
     "get_stats",
 ]

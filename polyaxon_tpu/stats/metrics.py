@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import math
 import re
+import time as _time
 from bisect import bisect_left
 from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence
 
@@ -25,6 +26,7 @@ __all__ = [
     "Histogram",
     "default_buckets",
     "render_prometheus",
+    "render_standard_gauges",
     "PROMETHEUS_CONTENT_TYPE",
 ]
 
@@ -105,6 +107,14 @@ class Histogram:
             "p99": self.quantile(0.99),
         }
 
+    def reset(self) -> None:
+        """Zero all buckets in place (bucket layout kept) — the primitive
+        behind rolling-window percentiles: snapshot ``summary()``, reset,
+        accumulate the next window."""
+        self.counts = [0] * (len(self.edges) + 1)
+        self.count = 0
+        self.sum = 0.0
+
     def state(self) -> Dict[str, Any]:
         """Copyable snapshot (what ``MemoryStats.snapshot()`` exports)."""
         return {
@@ -118,6 +128,19 @@ class Histogram:
 # -- Prometheus text exposition (v0.0.4) ---------------------------------------
 
 _INVALID_NAME_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+#: Fallback process start time when psutil is unavailable: first import of
+#: this module, which happens early in every entrypoint's life.
+_IMPORT_TIME = _time.time()
+
+
+def _process_start_time() -> float:
+    try:
+        import psutil
+
+        return float(psutil.Process().create_time())
+    except Exception:
+        return _IMPORT_TIME
 
 
 def _metric_name(key: str, prefix: str) -> str:
@@ -196,4 +219,27 @@ def render_prometheus(
         lines.append(f"{name}_sum{_labels(base_labels)} {_fmt(state['sum'])}")
         lines.append(f"{name}_count{_labels(base_labels)} {state['count']}")
 
+    return "\n".join(lines) + "\n"
+
+
+def render_standard_gauges(labels: Optional[Mapping[str, Any]] = None) -> str:
+    """Exposition hygiene every scrape target should carry: the standard
+    ``process_start_time_seconds`` (Prometheus derives restarts/uptime
+    from it) and a ``polyaxon_tpu_build_info`` info-gauge whose labels
+    pin the build version.  Appended to ``/metrics`` on both the control
+    plane and ``lm_server``.
+    """
+    try:
+        from polyaxon_tpu.version import __version__ as version
+    except Exception:
+        version = "unknown"
+    base_labels = dict(labels or {})
+    info_labels = dict(base_labels)
+    info_labels["version"] = version
+    lines = [
+        "# TYPE process_start_time_seconds gauge",
+        f"process_start_time_seconds{_labels(base_labels)} {_fmt(_process_start_time())}",
+        "# TYPE polyaxon_tpu_build_info gauge",
+        f"polyaxon_tpu_build_info{_labels(info_labels)} 1",
+    ]
     return "\n".join(lines) + "\n"

@@ -105,6 +105,14 @@ class Reporter:
         the registry's ``spans`` table for the cross-process timeline."""
         self._emit("span", **record)
 
+    def ledger(self, record: Dict[str, Any]) -> None:
+        """Ship a utilization-ledger row (see tracking/ledger.py) upstream.
+
+        Wired as the worker ledger's sink; the watcher ingests these into
+        the registry's ``utilization`` table for the run's goodput/MFU
+        roll-up."""
+        self._emit("ledger", **record)
+
     def service(
         self, *, url: Optional[str] = None, query: Optional[str] = None
     ) -> None:

@@ -1,0 +1,204 @@
+"""The goodput surface: ``GET /api/v1/runs/<id>/goodput`` (gang roll-up +
+raw ledger rows with paging), the ``goodput`` block on the run detail
+payload, the ``?format=`` selector on the timeline endpoint, and the
+standard process/build gauges on ``/metrics``.
+"""
+
+import asyncio
+
+import pytest
+
+from polyaxon_tpu.api.app import create_app
+from polyaxon_tpu.orchestrator import Orchestrator
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(
+        tmp_path / "plat",
+        monitor_interval=0.05,
+        heartbeat_interval=0.2,
+        heartbeat_ttl=30.0,
+    )
+    yield o
+    o.stop()
+
+
+def drive(orch, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        app = create_app(orch)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def _ledger_row(pid, seq, wall, step_compute, *, final=False):
+    return {
+        "seq": seq,
+        "source": "train",
+        "process_id": pid,
+        "wall_s": wall,
+        "buckets": {
+            "xla_compile_s": 1.0,
+            "data_wait_s": 0.5,
+            "step_compute_s": step_compute,
+            "ckpt_block_s": 0.0,
+            "metric_drain_s": 0.0,
+            "idle_s": max(0.0, wall - 1.5 - step_compute),
+        },
+        "steps": seq * 10,
+        "tokens": seq * 1000,
+        "flops": seq * 1e9,
+        "goodput": step_compute / wall,
+        "mfu": 0.05,
+        "tokens_per_device_s": 10.0,
+        "compile_s": 1.0,
+        "compile_events": 3,
+        "hbm_peak_bytes": 5e8,
+        "devices": 4,
+        "device_kind": "TPU v4",
+        "peak_flops_per_s": 4 * 275e12,
+        "final": final,
+    }
+
+
+class TestGoodputEndpoint:
+    def test_404_for_unknown_run(self, orch):
+        async def body(client):
+            resp = await client.get("/api/v1/runs/999/goodput")
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)
+
+    def test_empty_rollup_before_first_row(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            doc = await (
+                await client.get(f"/api/v1/runs/{run['id']}/goodput")
+            ).json()
+            assert doc["rows"] == 0
+            assert doc["goodput_ratio"] == 0.0
+            assert doc["results"] == []
+            return True
+
+        assert drive(orch, body)
+
+    def test_rollup_rows_and_paging(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            reg = orch.registry
+            reg.add_utilization(run["id"], _ledger_row(0, 1, 5.0, 3.0))
+            reg.add_utilization(
+                run["id"], _ledger_row(0, 2, 10.0, 8.0, final=True)
+            )
+            reg.add_utilization(
+                run["id"], _ledger_row(1, 1, 10.0, 6.0, final=True)
+            )
+            doc = await (
+                await client.get(f"/api/v1/runs/{run['id']}/goodput")
+            ).json()
+            assert doc["rows"] == 3
+            assert doc["processes"] == 2
+            assert doc["wall_s"] == 10.0
+            # Latest row per process: step_compute 8 + 6 over wall 10 + 10.
+            assert doc["goodput_ratio"] == pytest.approx(0.7)
+            assert doc["buckets"]["step_compute_s"]["sum"] == pytest.approx(
+                14.0
+            )
+            assert doc["final"] is True
+            assert doc["device_kind"] == "TPU v4"
+            assert len(doc["timeline"]) == 3
+            # Raw rows ride along with since_id paging.
+            assert [r["seq"] for r in doc["results"]] == [1, 2, 1]
+            cursor = doc["results"][0]["id"]
+            page = await (
+                await client.get(
+                    f"/api/v1/runs/{run['id']}/goodput?since_id={cursor}&limit=1"
+                )
+            ).json()
+            assert [r["seq"] for r in page["results"]] == [2]
+            # The roll-up itself is unaffected by row paging.
+            assert page["rows"] == 3
+            return True
+
+        assert drive(orch, body)
+
+    def test_run_detail_carries_goodput_block(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            orch.registry.add_utilization(run["id"], _ledger_row(0, 1, 8.0, 4.0))
+            doc = await (await client.get(f"/api/v1/runs/{run['id']}")).json()
+            assert doc["goodput"]["rows"] == 1
+            assert doc["goodput"]["goodput_ratio"] == pytest.approx(0.5)
+            # Detail payload is the roll-up only — no timeline bloat.
+            assert doc["goodput"]["timeline"] == []
+            return True
+
+        assert drive(orch, body)
+
+
+class TestTimelineFormats:
+    def test_format_selector(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            orch.registry.add_span(
+                run["id"],
+                {
+                    "name": "worker:entrypoint",
+                    "start": 10.0,
+                    "duration": 2.0,
+                    "process_id": 0,
+                    "thread": "MainThread",
+                },
+            )
+            base = f"/api/v1/runs/{run['id']}/timeline"
+            chrome = await (await client.get(base)).json()
+            assert "traceEvents" in chrome  # default stays chrome
+            explicit = await (await client.get(f"{base}?format=chrome")).json()
+            assert explicit == chrome
+            raw = await (await client.get(f"{base}?format=spans")).json()
+            assert [r["name"] for r in raw["results"]] == ["worker:entrypoint"]
+            bad = await client.get(f"{base}?format=flamegraph")
+            assert bad.status == 400
+            assert "flamegraph" in (await bad.json())["error"]
+            return True
+
+        assert drive(orch, body)
+
+
+class TestStandardGaugesOnMetrics:
+    def test_process_and_build_gauges_exposed(self, orch):
+        async def body(client):
+            text = await (await client.get("/metrics")).text()
+            assert (
+                'process_start_time_seconds{component="control_plane"}' in text
+            )
+            assert 'polyaxon_tpu_build_info{component="control_plane"' in text
+            assert 'version="' in text
+            return True
+
+        assert drive(orch, body)

@@ -1,0 +1,137 @@
+"""Registry ``utilization`` table: parity with spans/anomalies.
+
+Storage roundtrip, unknown-key folding into attrs, since-id paging and
+process filtering, delete_run cascade, and retention sweep.
+"""
+
+import time
+
+import pytest
+
+from polyaxon_tpu.db.registry import RunRegistry
+
+SPEC = {"kind": "experiment", "run": {"entrypoint": "x:y"}}
+
+
+@pytest.fixture()
+def reg(tmp_path):
+    registry = RunRegistry(tmp_path / "registry.sqlite")
+    yield registry
+    registry.close()
+
+
+def _row(seq=1, **over):
+    row = {
+        "seq": seq,
+        "source": "train",
+        "wall_s": 10.0 * seq,
+        "buckets": {"step_compute_s": 8.0 * seq, "idle_s": 2.0 * seq},
+        "steps": 100 * seq,
+        "tokens": 1000 * seq,
+        "flops": 1e12 * seq,
+        "goodput": 0.8,
+        "mfu": 0.4,
+        "tokens_per_device_s": 25.0,
+        "compile_s": 3.5,
+        "compile_events": 7,
+        "hbm_peak_bytes": 2.5e9,
+        "devices": 4,
+        "device_kind": "TPU v4",
+        "peak_flops_per_s": 1.1e15,
+        "final": False,
+    }
+    row.update(over)
+    return row
+
+
+class TestUtilizationTable:
+    def test_roundtrip_preserves_typed_fields(self, reg):
+        run = reg.create_run(SPEC, name="u")
+        reg.add_utilization(run.id, _row(), process_id=2)
+        (rec,) = reg.get_utilization(run.id)
+        assert rec["process_id"] == 2
+        assert rec["seq"] == 1
+        assert rec["source"] == "train"
+        assert rec["wall_s"] == 10.0
+        assert rec["buckets"] == {"step_compute_s": 8.0, "idle_s": 2.0}
+        assert rec["steps"] == 100
+        assert rec["tokens"] == 1000
+        assert rec["flops"] == 1e12
+        assert rec["goodput"] == 0.8
+        assert rec["compile_s"] == 3.5
+        assert rec["compile_events"] == 7
+        assert rec["hbm_peak_bytes"] == 2.5e9
+        assert rec["devices"] == 4
+        assert rec["device_kind"] == "TPU v4"
+        assert rec["peak_flops_per_s"] == 1.1e15
+        assert rec["final"] is False
+        assert rec["attrs"] == {}
+
+    def test_unknown_keys_fold_into_attrs(self, reg):
+        run = reg.create_run(SPEC, name="u")
+        reg.add_utilization(
+            run.id,
+            _row(extra={"decode_busy_frac": 0.7}, novel_field=42, ts=123.0),
+            process_id=0,
+        )
+        (rec,) = reg.get_utilization(run.id)
+        # "extra" and any future field survive in attrs; the transport
+        # envelope ("type"/"ts") does not.
+        assert rec["attrs"]["extra"] == {"decode_busy_frac": 0.7}
+        assert rec["attrs"]["novel_field"] == 42
+        assert "ts" not in rec["attrs"]
+        assert rec["created_at"] == 123.0  # ts becomes the row timestamp
+
+    def test_process_id_from_row_when_not_passed(self, reg):
+        run = reg.create_run(SPEC, name="u")
+        reg.add_utilization(run.id, _row(process_id=5))
+        (rec,) = reg.get_utilization(run.id)
+        assert rec["process_id"] == 5
+
+    def test_since_id_paging_and_process_filter(self, reg):
+        run = reg.create_run(SPEC, name="u")
+        for seq in (1, 2, 3):
+            reg.add_utilization(run.id, _row(seq), process_id=0)
+        reg.add_utilization(run.id, _row(9), process_id=1)
+        all_rows = reg.get_utilization(run.id)
+        assert [r["seq"] for r in all_rows] == [1, 2, 3, 9]
+        assert [r["id"] for r in all_rows] == sorted(r["id"] for r in all_rows)
+        # Incremental tail: only rows after the cursor.
+        tail = reg.get_utilization(run.id, since_id=all_rows[1]["id"])
+        assert [r["seq"] for r in tail] == [3, 9]
+        # Page size.
+        page = reg.get_utilization(run.id, limit=2)
+        assert [r["seq"] for r in page] == [1, 2]
+        # One host's trajectory.
+        mine = reg.get_utilization(run.id, process_id=1)
+        assert [r["seq"] for r in mine] == [9]
+
+    def test_rows_scoped_per_run(self, reg):
+        a = reg.create_run(SPEC, name="a")
+        b = reg.create_run(SPEC, name="b")
+        reg.add_utilization(a.id, _row(), process_id=0)
+        assert reg.get_utilization(b.id) == []
+
+    def test_delete_run_cascades(self, reg):
+        run = reg.create_run(SPEC, name="u")
+        reg.add_utilization(run.id, _row(), process_id=0)
+        reg.delete_run(run.id)
+        assert reg.get_utilization(run.id) == []
+
+    def test_retention_sweeps_only_done_runs(self, reg):
+        now = time.time()
+        old = reg.create_run(SPEC, name="old")
+        live = reg.create_run(SPEC, name="live")
+        reg.add_utilization(old.id, _row(ts=now - 100), process_id=0)
+        reg.add_utilization(live.id, _row(ts=now - 100), process_id=0)
+        for s in ("scheduled", "starting", "running", "succeeded"):
+            reg.set_status(old.id, s)
+        with reg._lock, reg._conn() as conn:  # age the finish time
+            conn.execute(
+                "UPDATE runs SET finished_at = ? WHERE id = ?",
+                (now - 100, old.id),
+            )
+        removed = reg.clean_old_rows(50, now=now)
+        assert removed["utilization"] == 1  # only the done run's old row
+        assert reg.get_utilization(old.id) == []
+        assert len(reg.get_utilization(live.id)) == 1

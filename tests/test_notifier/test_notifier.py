@@ -2,6 +2,8 @@
 
 import logging
 
+import pytest
+
 from polyaxon_tpu.events import Event, EventTypes
 from polyaxon_tpu.monitor.resources import ResourceSampler, sample_process
 from polyaxon_tpu.notifier import CallbackAction, LogAction, Notifier, WebhookAction
@@ -125,3 +127,76 @@ class TestResources:
         from polyaxon_tpu.monitor.resources import sample_tpu_utilization
 
         assert sample_tpu_utilization() == {}
+
+
+class TestDeviceProbeOnce:
+    """The accelerator sampler's probe-once gate: one memoryless walk
+    disables device sampling for the process lifetime; backends with
+    memory telemetry keep emitting per-device rows plus the aggregate
+    ``sys/hbm_peak_mb`` high-water mark."""
+
+    @pytest.fixture(autouse=True)
+    def rearmed_probe(self):
+        from polyaxon_tpu.monitor import resources
+
+        resources._reset_device_probe()
+        yield
+        resources._reset_device_probe()
+
+    class FakeDevice:
+        def __init__(self, id, stats):
+            self.id = id
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    def test_memoryless_backend_disables_probe(self, monkeypatch):
+        import jax
+
+        from polyaxon_tpu.monitor import resources
+
+        calls = []
+
+        def fake_devices():
+            calls.append(1)
+            return [self.FakeDevice(0, None)]  # CPU-style: no telemetry
+
+        monkeypatch.setattr(jax, "local_devices", fake_devices)
+        assert resources.sample_devices() == {}
+        assert resources._device_probe_ok is False
+        # The gate short-circuits: no more device walks, ever — even if
+        # telemetry would now be available.
+        monkeypatch.setattr(
+            jax,
+            "local_devices",
+            lambda: [self.FakeDevice(0, {"bytes_in_use": 1_000_000})],
+        )
+        assert resources.sample_devices() == {}
+        assert calls == [1]
+
+    def test_hbm_rows_and_peak_high_water(self, monkeypatch):
+        import jax
+
+        from polyaxon_tpu.monitor import resources
+
+        stats = {
+            "bytes_in_use": 4_000_000,
+            "bytes_limit": 16_000_000,
+            "peak_bytes_in_use": 8_000_000,
+        }
+        monkeypatch.setattr(
+            jax,
+            "local_devices",
+            lambda: [self.FakeDevice(0, stats), self.FakeDevice(1, dict(stats))],
+        )
+        values = resources.sample_devices()
+        assert resources._device_probe_ok is True
+        assert values["sys/hbm0_mb"] == 4.0
+        assert values["sys/hbm0_frac"] == 0.25
+        assert values["sys/hbm1_peak_mb"] == 8.0
+        assert values["sys/hbm_peak_mb"] == 16.0  # both devices' peaks
+        # High-water: a later, lower sample must not lower the aggregate.
+        stats["peak_bytes_in_use"] = 2_000_000
+        values = resources.sample_devices()
+        assert values["sys/hbm_peak_mb"] == 16.0

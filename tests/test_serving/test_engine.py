@@ -250,3 +250,39 @@ class TestShardedEngine:
             eng.stop()
         for p, (_, mn), out in zip(prompts, shapes, outs):
             assert out == _ref(params, p, mn)
+
+
+class TestEngineUtilization:
+    def test_stats_carry_decode_utilization(self, params, engine):
+        engine.submit([1, 2, 3], 4).wait(timeout=60)
+        s = engine.stats()
+        assert {"decode_busy_frac", "slot_occupancy", "decode_utilization"} <= set(s)
+        # Real decode work happened, so the busy fraction is a genuine
+        # fraction — not zero, and bounded by wall clock.
+        assert 0.0 < s["decode_busy_frac"] <= 1.0
+        assert 0.0 < s["slot_occupancy"] <= 1.0
+        assert s["decode_utilization"] == pytest.approx(
+            s["decode_busy_frac"] * s["slot_occupancy"], abs=1e-5
+        )
+
+    def test_engine_ships_final_ledger_row_on_stop(self, params):
+        from polyaxon_tpu.serving import ServingEngine
+        from polyaxon_tpu.tracking.ledger import get_ledger
+
+        rows = []
+        get_ledger().configure(sink=rows.append)
+        try:
+            eng = ServingEngine(params, CFG, slots=2, max_len=48).start()
+            eng.submit([1, 2, 3], 4).wait(timeout=60)
+            eng.stop()
+        finally:
+            get_ledger().configure(sink=None)
+        final = [r for r in rows if r["final"]]
+        assert final, "engine.stop() must flush a final ledger row"
+        row = final[-1]
+        assert row["source"] == "serving"
+        assert row["tokens"] >= 4
+        # Decode busy time is accounted as step-compute directly.
+        assert row["buckets"]["step_compute_s"] > 0
+        assert 0.0 < row["goodput"] <= 1.0
+        assert 0.0 < row["extra"]["decode_busy_frac"] <= 1.0

@@ -241,3 +241,46 @@ class TestMemoryStatsRegistry:
         text = render_prometheus(snap)
         assert "polyaxon_tpu_hits_total" in text
         assert not math.isnan(snap["histograms"]["lat"]["sum"])
+
+
+class TestHistogramReset:
+    def test_reset_zeroes_in_place_keeping_edges(self):
+        h = Histogram(edges=[1.0, 2.0])
+        for v in (0.5, 1.5, 9.0):
+            h.observe(v)
+        h.reset()
+        assert h.edges == [1.0, 2.0]
+        assert h.counts == [0, 0, 0]
+        assert h.count == 0 and h.sum == 0.0
+        # Empty-safe after reset: summary and quantiles, no ZeroDivision.
+        s = h.summary()
+        assert s["count"] == 0.0 and s["mean"] == 0.0
+        # Reusable: the rolling-window pattern.
+        h.observe(1.5)
+        assert h.counts == [0, 1, 0] and h.count == 1
+
+
+class TestStandardGauges:
+    def test_process_start_time_and_build_info(self):
+        import time as _t
+
+        from polyaxon_tpu.stats import render_standard_gauges
+        from polyaxon_tpu.version import __version__
+
+        text = render_standard_gauges(labels={"component": "control_plane"})
+        samples = _parse_samples(text)
+        ((labels, start),) = samples["process_start_time_seconds"]
+        assert labels == '{component="control_plane"}'
+        assert 0 < start <= _t.time()
+        ((labels, value),) = samples["polyaxon_tpu_build_info"]
+        assert value == 1.0
+        assert 'component="control_plane"' in labels
+        assert f'version="{__version__}"' in labels
+
+    def test_no_labels_is_valid_exposition(self):
+        from polyaxon_tpu.stats import render_standard_gauges
+
+        text = render_standard_gauges()
+        samples = _parse_samples(text)  # asserts every line parses
+        assert "process_start_time_seconds" in samples
+        assert text.endswith("\n")
