@@ -597,6 +597,148 @@ def main() -> None:
 
         traceback.print_exc(file=sys.stderr)
 
+    # Serving under LOAD: the same engine driven by Poisson arrivals at a
+    # calibrated offered rate, chunked prefill vs full-prompt prefill.
+    # The instant-burst number above can't see head-of-line blocking: a
+    # short prompt that ARRIVES while a long prompt's monolithic prefill
+    # is on the device waits the whole thing out; with chunked prefill it
+    # waits at most one chunk (chunk boundaries are preemption points for
+    # the shortest-remaining-first prefill queue).  Both sides get the
+    # IDENTICAL arrival schedule (same seed), warmed pad buckets, and the
+    # prefix cache off so the second run can't ride the first's KV.
+    # The headline TTFT percentiles are over the SHORT (interactive)
+    # class: chunking deliberately trades the long prompt's own TTFT for
+    # everyone else's, so all-requests percentiles at small n are just
+    # the slowest long both ways (bench-notes.md has the methodology).
+    serving_loaded = None
+    try:
+        from polyaxon_tpu.serving import ServingEngine
+        from polyaxon_tpu.serving.loadgen import poisson_load
+
+        if on_tpu:
+            lcfg, lparams = scfg, sparams
+            long_len, short_len = 768, 16
+            lmax_new, lchunk, n_loaded, lslots = 32, 128, 24, 8
+        else:
+            # The tiny smoke config's prefill is microseconds — too fast
+            # for arrival overlap to be measurable above timer noise — so
+            # the loaded A/B uses a config whose full-prompt prefill costs
+            # real milliseconds on CPU.
+            lcfg = TransformerConfig(
+                vocab_size=256,
+                d_model=256,
+                n_layers=2,
+                n_heads=4,
+                head_dim=64,
+                d_ff=1024,
+                max_seq=512,
+                dtype=jnp.float32,
+            )
+            lparams = init_params(jax.random.PRNGKey(2), lcfg)
+            # 8 slots so admission never bottlenecks (a long request holds
+            # its slot for its whole prefill; the A/B should measure
+            # prefill head-of-line blocking, not slot scarcity).
+            long_len, short_len = 480, 8
+            lmax_new, lchunk, n_loaded, lslots = 4, 128, 24, 8
+        loaded_prompts = [
+            [
+                int(x)
+                for x in rng.integers(
+                    0,
+                    lcfg.vocab_size,
+                    long_len if i % 3 == 0 else short_len,
+                )
+            ]
+            for i in range(n_loaded)
+        ]
+
+        def loaded_run(prefill_chunk, rate_rps=None):
+            eng = ServingEngine(
+                lparams,
+                lcfg,
+                slots=lslots,
+                max_len=lcfg.max_seq,
+                prefill_chunk=prefill_chunk,
+                prefix_cache=False,
+            ).start()
+            try:
+                # Warm every prefill pad bucket + the decode step.
+                for t in (long_len, short_len):
+                    eng.submit([1] * t, 2).wait(timeout=600)
+                if rate_rps is None:
+                    # Calibrate the offered rate once, from this side's
+                    # measured sequential service time.  This mix is
+                    # PREFILL-bound (prefill is serialized on the device
+                    # regardless of slot count), so capacity is ~1/svc,
+                    # not slots/svc; offer 60% of it — genuinely loaded,
+                    # but queues drain, so TTFT measures head-of-line
+                    # blocking rather than raw queueing backlog.
+                    t0 = time.perf_counter()
+                    for p in loaded_prompts[:3]:
+                        eng.submit(p, lmax_new).wait(timeout=600)
+                    svc = (time.perf_counter() - t0) / 3
+                    rate_rps = 0.6 / svc
+                res = poisson_load(
+                    eng,
+                    loaded_prompts,
+                    lmax_new,
+                    rate_rps=rate_rps,
+                    seed=17,
+                )
+            finally:
+                eng.stop()
+            return res, rate_rps
+
+        full_res, lrate = loaded_run(None)
+        chunked_res, _ = loaded_run(lchunk, rate_rps=lrate)
+
+        from polyaxon_tpu.serving.loadgen import _pct
+
+        def short_pct(res, q):
+            vals = sorted(
+                t
+                for i, t in enumerate(res["ttft_s"])
+                if i % 3 != 0 and t is not None
+            )
+            return _pct(vals, q)
+
+        def long_mean(res):
+            vals = [
+                t
+                for i, t in enumerate(res["ttft_s"])
+                if i % 3 == 0 and t is not None
+            ]
+            return round(float(np.mean(vals)), 6) if vals else 0.0
+
+        c_p99, f_p99 = short_pct(chunked_res, 99), short_pct(full_res, 99)
+        serving_loaded = {
+            "ttft_p99_s": c_p99,
+            "ttft_p50_s": short_pct(chunked_res, 50),
+            "tokens_per_s_loaded": chunked_res["tokens_per_s"],
+            "full_prefill_ttft_p99_s": f_p99,
+            "full_prefill_ttft_p50_s": short_pct(full_res, 50),
+            "full_prefill_tokens_per_s": full_res["tokens_per_s"],
+            "ttft_p99_speedup": (
+                round(f_p99 / c_p99, 2) if c_p99 > 0 else None
+            ),
+            # The other side of the trade, reported so it can't hide:
+            # the long prompts' own TTFT, which chunking makes WORSE.
+            "long_ttft_mean_s": long_mean(chunked_res),
+            "full_prefill_long_ttft_mean_s": long_mean(full_res),
+            "all_ttft_p99_s": chunked_res["ttft_p99_s"],
+            "full_prefill_all_ttft_p99_s": full_res["ttft_p99_s"],
+            "offered_rps": round(lrate, 2),
+            "prefill_chunk": lchunk,
+            "n_requests": n_loaded,
+            "completed": [chunked_res["completed"], full_res["completed"]],
+            "errors": [chunked_res["errors"], full_res["errors"]],
+        }
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+
     # Training input pipeline: the overlapped hot loop (host prefetch +
     # device prefetch + async metrics, runtime/pipeline.py) vs the same
     # loop fully synchronous, on a dataset-backed image-classifier config.
@@ -723,6 +865,7 @@ def main() -> None:
     longctx_vs_baseline = None
     hpsearch_vs_baseline = None
     serving_vs_baseline = None
+    serving_loaded_vs_baseline = None
     train_images_vs_baseline = None
     if on_tpu:
         base = json.loads(baseline_path.read_text()) if baseline_path.exists() else {}
@@ -757,6 +900,19 @@ def main() -> None:
                 )
             else:
                 base["serving_tokens_per_s"] = serving["tokens_per_s"]
+        # Loaded serving throughput gates separately — paging/prefill
+        # regressions show up here before the instant-burst number moves.
+        if serving_loaded is not None:
+            if base.get("serving_tokens_per_s_loaded"):
+                serving_loaded_vs_baseline = round(
+                    serving_loaded["tokens_per_s_loaded"]
+                    / base["serving_tokens_per_s_loaded"],
+                    3,
+                )
+            else:
+                base["serving_tokens_per_s_loaded"] = serving_loaded[
+                    "tokens_per_s_loaded"
+                ]
         # The overlapped train input path gates like serving: a prefetch
         # or async-checkpoint regression must not hide behind an unchanged
         # (synthetic-data) training headline.
@@ -790,6 +946,16 @@ def main() -> None:
                 "longctx_vs_baseline": longctx_vs_baseline,
                 "serving_tokens_per_s": serving,
                 "serving_vs_baseline": serving_vs_baseline,
+                "serving_ttft_p99_s": (
+                    serving_loaded["ttft_p99_s"] if serving_loaded else None
+                ),
+                "serving_tokens_per_s_loaded": (
+                    serving_loaded["tokens_per_s_loaded"]
+                    if serving_loaded
+                    else None
+                ),
+                "serving_loaded": serving_loaded,
+                "serving_loaded_vs_baseline": serving_loaded_vs_baseline,
                 "train_images_per_s": train_images,
                 "train_images_vs_baseline": train_images_vs_baseline,
                 "trace_overhead_pct": (

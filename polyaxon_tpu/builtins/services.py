@@ -225,6 +225,14 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
             )
 
         def do_POST(self):
+            if self.path == "/v1/cancel":
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json_mod.loads(self.rfile.read(n) or b"{}")
+                    rid = int(req["request_id"])
+                except (KeyError, ValueError, TypeError) as e:
+                    return self._json(400, {"error": str(e)})
+                return self._json(200, {"cancelled": engine.cancel(rid)})
             if self.path != "/generate":
                 return self._json(404, {"error": "not found"})
             try:
@@ -247,8 +255,16 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
             except (KeyError, ValueError, TypeError) as e:
                 return self._json(400, {"error": str(e)})
             try:
-                tokens = [r.wait(timeout=600) for r in reqs]
+                timeout_s = float(meta.get("request_timeout_s", 600))
+                tokens = [r.wait(timeout=timeout_s) for r in reqs]
             except (RuntimeError, TimeoutError) as e:
+                # The client is about to get an error and walk away:
+                # release every still-running sibling's slot, KV blocks,
+                # and prefix refs instead of decoding to max_new_tokens
+                # for nobody.
+                for r in reqs:
+                    if not r.done.is_set():
+                        engine.cancel(r.id)
                 return self._json(503, {"error": str(e)})
             dt = time.time() - t0
             total = sum(len(t) for t in tokens)
@@ -266,31 +282,47 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
 def lm_server(ctx: Context) -> None:
     """LM inference endpoint: the default ``kind: service`` entrypoint.
 
-    A CONTINUOUS-BATCHING server (polyaxon_tpu/serving/engine.py): one
-    slot-addressed KV cache, one jitted decode step advancing every
-    in-flight request a token per iteration, requests admitted/retired
-    mid-flight.  Concurrent connections feed the engine queue through a
-    threaded front-end and block only on their own completion — a long
-    generation never head-of-line-blocks a short one.  Routes:
+    A CONTINUOUS-BATCHING server (polyaxon_tpu/serving/engine.py) over a
+    PAGED KV cache: one ref-counted block pool, per-request block tables,
+    shared-prefix reuse (system prompts map to the same blocks,
+    copy-on-write at divergence), chunked prefill interleaved with
+    decode, and one jitted decode step advancing every in-flight request
+    a token per iteration.  Concurrent connections feed the engine queue
+    through a threaded front-end and block only on their own completion —
+    a long generation (or a long PROMPT) never head-of-line-blocks a
+    short one.  Routes:
 
     - ``POST /generate`` ``{"prompts": [[ids…]…], "max_new_tokens": N,
       "temperature": t}`` → ``{"tokens": [[ids…]…], "decode_tokens_per_s"}``
       (prompts may have DIFFERENT lengths — each is its own engine
-      request; the KV cache stores UNEXPANDED GQA heads).
+      request; the KV cache stores UNEXPANDED GQA heads).  A request that
+      times out server-side is CANCELLED (its slot and blocks free
+      immediately) before the 503 goes out.
+    - ``POST /v1/cancel`` ``{"request_id": N}`` → ``{"cancelled": bool}``
+      — release an in-flight or queued request's slot, KV blocks, and
+      prefix-cache references immediately.
     - ``GET /healthz`` → model/checkpoint metadata + engine occupancy.
-    - ``GET /v1/stats`` → queue depth, slot occupancy, tokens/s, latency
+    - ``GET /v1/stats`` → queue depth, slot occupancy, tokens/s, block
+      pool occupancy, prefix-cache hit rate, prefill backlog, latency
       percentiles (queue wait / TTFT / per-token decode).
     - ``GET /metrics`` → Prometheus text exposition of the same
-      histograms (see docs/observability.md).
+      histograms plus the paging gauges (see docs/observability.md).
 
     Params: ``target`` (run uuid whose ``checkpoints/`` to serve — omit
     for fresh random weights, a load-testing double), the model-shape
     params of ``lm_train`` (must match the checkpoint), ``seq`` (max
-    prompt+generation length per slot), ``slots`` (concurrent sequences
-    the cache holds), ``max_new_tokens`` (server default when a request
-    omits it), ``eos_id`` (retire a slot early on this token), ``host``,
+    prompt+generation length per request), ``slots`` (concurrent
+    sequences the batch holds), ``block_size`` (tokens per KV block),
+    ``kv_blocks`` (pool size override — size below slots×seq to
+    overcommit on prefix sharing), ``prefill_chunk`` (prompt tokens
+    inserted per scheduler iteration; 0/unset = whole-prompt),
+    ``prefix_cache`` (share identical prompt prefixes, default on),
+    ``request_timeout_s`` (server-side wait budget per /generate),
+    ``max_new_tokens`` (server default when a request omits it),
+    ``eos_id`` (retire a slot early on this token), ``host``,
     ``quantize`` (``int8`` weight-only decode).  The decode step's shapes
-    depend only on ``slots`` — steady-state serving never recompiles.
+    depend only on (slots, pool size) — steady-state serving never
+    recompiles.
     """
     import jax
 
@@ -377,11 +409,18 @@ def lm_server(ctx: Context) -> None:
     port = _service_port(ctx)
     host = str(ctx.get_param("host", "0.0.0.0"))
     eos_id = ctx.get_param("eos_id")
+    kv_blocks = ctx.get_param("kv_blocks")
+    prefill_chunk = int(ctx.get_param("prefill_chunk", 0) or 0)
     engine = ServingEngine(
         params,
         cfg,
         slots=int(ctx.get_param("slots", 4)),
         max_len=seq,
+        block_size=int(ctx.get_param("block_size", 16)),
+        num_blocks=int(kv_blocks) if kv_blocks is not None else None,
+        prefill_chunk=prefill_chunk if prefill_chunk > 0 else None,
+        prefix_cache=str(ctx.get_param("prefix_cache", "1")).lower()
+        not in ("0", "false", "no"),
         qweights=qweights,
         mesh=mesh if template is not None else None,
         eos_id=int(eos_id) if eos_id is not None else None,
@@ -397,6 +436,7 @@ def lm_server(ctx: Context) -> None:
         "checkpoint_step": step,
         "target": target,
         "default_max_new": int(ctx.get_param("max_new_tokens", 64)),
+        "request_timeout_s": float(ctx.get_param("request_timeout_s", 600)),
     }
     handler = _make_lm_handler(engine, cfg, meta, log=ctx.log_text)
     server = ThreadingHTTPServer((host, port), handler)

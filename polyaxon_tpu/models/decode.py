@@ -340,6 +340,233 @@ def slot_decode_step(
     return logits[:, 0].astype(jnp.float32), {"k": new_ck, "v": new_cv}
 
 
+# -- paged (block-table) cache ops -----------------------------------------
+# The vLLM-style refinement of the slot cache: KV lives in a POOL of
+# fixed-size blocks [L, num_blocks, block_size, Hkv, d] and each in-flight
+# sequence owns a BLOCK TABLE of physical block ids covering its logical
+# positions.  Two consequences the slot layout can't express:
+#
+# - **sharing** — two sequences with a common token prefix point their
+#   leading table entries at the SAME physical blocks (the engine
+#   ref-counts them; a block a sequence must WRITE into is copied first);
+# - **chunked prefill** — a prompt is inserted C tokens at a time by
+#   :func:`paged_prefill_chunk`, each chunk attending to the KV already in
+#   the table, so a long prompt never stalls the decode loop for its full
+#   length.
+#
+# Shapes stay static everywhere (pool size, table width, chunk bucket);
+# tables, positions, and the active mask are DATA, so steady-state serving
+# still never recompiles.  Block 0 is reserved by the engine as a trash
+# lane: inactive decode lanes and prompt-pad writes land there, and unset
+# table entries point at it — every such read is masked by the position
+# mask before it can influence a live row.
+
+
+def init_block_pool(
+    cfg: TransformerConfig, num_blocks: int, block_size: int
+) -> Dict[str, jax.Array]:
+    """Zeroed paged KV pool: k/v [L, num_blocks, block_size, Hkv, d]."""
+    c = cfg
+    shape = (c.n_layers, num_blocks, block_size, c.kv_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, c.dtype),
+        "v": jnp.zeros(shape, c.dtype),
+    }
+
+
+def copy_block(
+    pool: Dict[str, jax.Array], src: jax.Array, dst: jax.Array
+) -> Dict[str, jax.Array]:
+    """Copy one physical block's KV rows (all layers) — the copy-on-write
+    primitive: a shared block a sequence must write into is duplicated
+    into a private block first.  ``src``/``dst`` are traced scalars, so
+    every COW reuses one compilation."""
+    k = lax.dynamic_slice_in_dim(pool["k"], src, 1, axis=1)
+    v = lax.dynamic_slice_in_dim(pool["v"], src, 1, axis=1)
+    return {
+        "k": lax.dynamic_update_slice(pool["k"], k, (0, dst, 0, 0, 0)),
+        "v": lax.dynamic_update_slice(pool["v"], v, (0, dst, 0, 0, 0)),
+    }
+
+
+def paged_prefill_chunk(
+    params: Dict[str, Any],
+    pool: Dict[str, jax.Array],
+    table: jax.Array,
+    tokens: jax.Array,
+    start: jax.Array,
+    length: jax.Array,
+    cfg: TransformerConfig,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Insert one prompt chunk into a paged cache and return the logits of
+    its last REAL token.
+
+    tokens: [C] (right-padded to the engine's chunk bucket); ``start`` is
+    the chunk's absolute start position, ``length`` the valid count (both
+    traced scalars — only C mints a compilation).  ``table`` [W] maps the
+    sequence's logical blocks to pool blocks; blocks covering
+    [start, start+length) must already be allocated (and private — the
+    chunk WRITES its KV rows there).  The chunk attends to everything the
+    table already holds (a reused shared prefix, earlier chunks) plus
+    itself, causally — which is what makes chunked prefill and
+    prefix-reuse recompute the same operation.  Pad positions write their
+    garbage rows to trash block 0 and are masked out of attention.
+
+    Numerics mirror the training ``forward`` block exactly (broadcast GQA
+    heads, ``_dense_attention``'s masked f32 softmax), so greedy outputs
+    stay token-identical to the sequential :func:`generate` path.
+    """
+    from polyaxon_tpu.models.transformer import _dense_attention
+
+    c = cfg
+    C = tokens.shape[0]
+    W = table.shape[0]
+    bs = pool["k"].shape[2]
+    Hkv, d = pool["k"].shape[3], pool["k"].shape[4]
+    group = c.n_heads // c.kv_heads
+
+    qpos = start + jnp.arange(C)  # [C] absolute positions
+    valid = jnp.arange(C) < length
+    # Pad writes are redirected to the trash block: their logical blocks
+    # may not be allocated yet (they belong to future generation).
+    write_blk = jnp.where(valid, table[jnp.clip(qpos // bs, 0, W - 1)], 0)
+    write_off = jnp.where(valid, qpos % bs, 0)
+    kpos = jnp.arange(W * bs)[None]  # gathered keys sit in logical order
+
+    x = params["embed"].astype(c.dtype)[tokens][None]  # [1, C, D]
+    positions = qpos[None]  # [1, C]
+
+    def layer_body(x, inputs):
+        layer, pk, pv = inputs  # pk/pv: [NB, bs, Hkv, d]
+        h = _rmsnorm(x, layer["attn_norm"])
+        q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(h.dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(h.dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(h.dtype))
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+        # Write the chunk's KV rows, then attend against the whole table —
+        # the rows just written ARE the chunk's causal self-attention keys.
+        pk = pk.at[write_blk, write_off].set(k[0].astype(pk.dtype))
+        pv = pv.at[write_blk, write_off].set(v[0].astype(pv.dtype))
+        ck = pk[table].reshape(1, W * bs, Hkv, d)
+        cv = pv[table].reshape(1, W * bs, Hkv, d)
+        if group > 1:
+            ck = jnp.repeat(ck, group, axis=2)
+            cv = jnp.repeat(cv, group, axis=2)
+        attn = _dense_attention(q, ck, cv, positions, kpos)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, layer["wo"].astype(h.dtype))
+
+        h = _rmsnorm(x, layer["mlp_norm"])
+        up = jnp.einsum("btd,df->btf", h, layer["wi"].astype(h.dtype))
+        gate = jnp.einsum("btd,df->btf", h, layer["wg"].astype(h.dtype))
+        y = jax.nn.silu(gate) * up
+        x = x + jnp.einsum("btf,fd->btd", y, layer["wd"].astype(h.dtype))
+        return x, (pk, pv)
+
+    x, (new_k, new_v) = lax.scan(
+        layer_body, x, (params["block"], pool["k"], pool["v"])
+    )
+    x = _rmsnorm(x, params["final_norm"])
+    logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(x.dtype))
+    last = jnp.take(logits[0], length - 1, axis=0)
+    return last.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def _attend_paged(q, ck, cv, pos, group):
+    """One-token attention over block-table-gathered KV.
+
+    q: [S, 1, H, d]; ck/cv: [S, W*bs, Hkv, d] in logical-position order;
+    pos: [S] per-slot absolute positions.  Identical contraction shape to
+    :func:`_attend_slots` — the gather changed where keys LIVE, not how a
+    row attends — which is what keeps paged greedy outputs token-identical
+    to the slot (and sequential) paths.
+    """
+    S, K, Hkv, d = ck.shape
+    scale = d**-0.5
+    qg = q.reshape(S, 1, Hkv, group, d)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, ck) * scale  # [S,Hkv,g,1,K]
+    valid = (jnp.arange(K)[None, :] <= pos[:, None])[:, None, None, None, :]
+    s = jnp.where(valid, s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, cv)
+    return out.reshape(S, 1, Hkv * group, d)
+
+
+def paged_decode_step(
+    params: Dict[str, Any],
+    pool: Dict[str, jax.Array],
+    tables: jax.Array,
+    tokens: jax.Array,
+    pos: jax.Array,
+    active: jax.Array,
+    cfg: TransformerConfig,
+    qweights: Optional[Dict[str, Any]] = None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Advance a mixed batch one token against the paged pool.
+
+    tables: [S, W] physical block ids per slot (the engine maps unset
+    entries to trash block 0); tokens/pos/active as in
+    :func:`slot_decode_step`.  Inactive lanes write their garbage row to
+    block 0 offset 0 — never into a live block — and every gathered
+    position beyond a slot's ``pos`` is masked.  Shapes depend only on
+    (slots, pool size, table width): steady-state serving never
+    recompiles, whichever requests come and go or how their blocks are
+    scattered across the pool.
+    """
+    c = cfg
+    S, W = tables.shape
+    bs = pool["k"].shape[2]
+    Hkv, d = pool["k"].shape[3], pool["k"].shape[4]
+    pos = jnp.where(active, pos, 0)
+    write_blk = jnp.where(active, tables[jnp.arange(S), pos // bs], 0)
+    write_off = jnp.where(active, pos % bs, 0)
+
+    x = params["embed"].astype(c.dtype)[tokens][:, None, :]  # [S,1,D]
+
+    blk = params["block"]
+    if qweights is None:
+        layers = blk
+        unembed = params["unembed"]
+    else:
+        layers = {
+            "attn_norm": blk["attn_norm"],
+            "mlp_norm": blk["mlp_norm"],
+            **{k: qweights[k] for k in QUANTIZED_BLOCK_WEIGHTS},
+        }
+        unembed = qweights["unembed"]
+
+    def layer_body(carry, inputs):
+        x = carry
+        layer, pk, pv = inputs  # pk/pv: [NB, bs, Hkv, d]
+        h = _rmsnorm(x, layer["attn_norm"])
+        q = jnp.einsum("btd,dhk->bthk", h, _wdq(layer["wq"], h.dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, _wdq(layer["wk"], h.dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, _wdq(layer["wv"], h.dtype))
+        positions = pos[:, None]  # [S, 1]
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+        pk = pk.at[write_blk, write_off].set(k[:, 0].astype(pk.dtype))
+        pv = pv.at[write_blk, write_off].set(v[:, 0].astype(pv.dtype))
+        ck = pk[tables].reshape(S, W * bs, Hkv, d)
+        cv = pv[tables].reshape(S, W * bs, Hkv, d)
+        attn = _attend_paged(q, ck, cv, pos, c.n_heads // c.kv_heads)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, _wdq(layer["wo"], h.dtype))
+
+        h = _rmsnorm(x, layer["mlp_norm"])
+        up = jnp.einsum("btd,df->btf", h, _wdq(layer["wi"], h.dtype))
+        gate = jnp.einsum("btd,df->btf", h, _wdq(layer["wg"], h.dtype))
+        y = jax.nn.silu(gate) * up
+        x = x + jnp.einsum("btf,fd->btd", y, _wdq(layer["wd"], h.dtype))
+        return x, (pk, pv)
+
+    x, (new_k, new_v) = lax.scan(
+        layer_body, x, (layers, pool["k"], pool["v"])
+    )
+    x = _rmsnorm(x, params["final_norm"])
+    logits = jnp.einsum("btd,dv->btv", x, _wdq(unembed, x.dtype))
+    return logits[:, 0].astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
 def _fit_spec(spec, leaf, mesh_shape):
     """Drop sharding on axes whose mesh size doesn't divide the leaf's
     actual dimension (shape-aware replication fallback)."""

@@ -1,10 +1,14 @@
-"""Continuous-batching LM serving (Orca-style iteration-level scheduling).
+"""Continuous-batching LM serving (Orca-style iteration-level scheduling
+over a vLLM-style paged KV cache).
 
-The engine owns ONE fixed-shape, slot-addressed KV cache and admits or
-retires requests at decode-STEP granularity — a long generation never
-head-of-line-blocks a short one, and a freed slot is refilled from the
-queue mid-flight.  ``builtins/services.py:lm_server`` is the HTTP
-front-end; the engine itself is front-end-agnostic.
+The engine owns ONE fixed-shape block pool; requests hold per-sequence
+block TABLES (data, never shapes), so admission, retirement, prefix
+sharing, and chunked prefill all happen at decode-STEP granularity with
+zero steady-state recompilation — a long generation never
+head-of-line-blocks a short one, a long PROMPT never stalls the decode
+batch, and identical prompt prefixes share ref-counted KV blocks.
+``builtins/services.py:lm_server`` is the HTTP front-end; the engine
+itself is front-end-agnostic.
 """
 
 from polyaxon_tpu.serving.engine import (
@@ -12,5 +16,12 @@ from polyaxon_tpu.serving.engine import (
     ServingEngine,
     SlotAllocator,
 )
+from polyaxon_tpu.serving.paging import BlockAllocator, PrefixCache
 
-__all__ = ["GenerationRequest", "ServingEngine", "SlotAllocator"]
+__all__ = [
+    "BlockAllocator",
+    "GenerationRequest",
+    "PrefixCache",
+    "ServingEngine",
+    "SlotAllocator",
+]

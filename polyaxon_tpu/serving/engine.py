@@ -1,34 +1,47 @@
-"""Continuous-batching generation engine over a slot-addressed KV cache.
+"""Continuous-batching generation engine over a PAGED KV cache.
 
-Iteration-level scheduling (Orca; the KV management popularized by
-vLLM, here slot-granular rather than paged): the engine owns one
-``[L, slots, max_len, Hkv, d]`` cache and ONE jitted
-:func:`~polyaxon_tpu.models.decode.slot_decode_step` whose shapes depend
-only on the slot count — per-slot positions, the active mask, and the
-slot index of every admission are DATA, so steady-state serving never
-recompiles.  Each scheduler iteration:
+Iteration-level scheduling (Orca) over block-table KV management
+(vLLM's PagedAttention) with Sarathi-style chunked prefill: the engine
+owns one ``[L, num_blocks, block_size, Hkv, d]`` block POOL and ONE
+jitted :func:`~polyaxon_tpu.models.decode.paged_decode_step` whose
+shapes depend only on (slots, pool size, table width) — per-slot block
+tables, positions, and the active mask are DATA, so steady-state
+serving never recompiles.  Each scheduler iteration:
 
-1. **admit** — while a slot is free and the queue is non-empty, prefill
-   the next prompt (one B=1 forward, padded to a small bucket set so
-   prompt lengths don't mint unbounded compilations) and write its KV
-   into the free slot via ``insert_prompt``;
-2. **step** — one batched decode step advances every active slot one
-   token, each at its own position;
-3. **retire** — finished slots (max_new reached, or EOS) are freed
-   IMMEDIATELY; the next queued request takes the slot on the very next
-   iteration, while its neighbors keep decoding.
+1. **admit** — move queued requests into free slots and enqueue a
+   prefill job per admission; the shared-prefix cache
+   (:class:`~polyaxon_tpu.serving.paging.PrefixCache`) maps any cached
+   block-prefix of the prompt straight into the request's table (a
+   block-aligned FULL hit copies the last block private first —
+   copy-on-write — and recomputes only the final prompt token);
+2. **prefill tick** — run ONE chunk (``prefill_chunk`` tokens) of the
+   oldest pending prefill via
+   :func:`~polyaxon_tpu.models.decode.paged_prefill_chunk`, allocating
+   table blocks lazily from the ref-counted
+   :class:`~polyaxon_tpu.serving.paging.BlockAllocator`; a long prompt
+   therefore interleaves with decode instead of stalling the batch;
+3. **step** — one batched decode step advances every active slot one
+   token; a slot that faults a new block on an exhausted pool PARKS
+   (state and blocks kept, active mask cleared — still just data) and
+   resumes when references drop;
+4. **retire** — finished slots free their blocks back to the pool
+   (shared prefix blocks merely drop one reference) and publish their
+   prompt blocks to the prefix cache for the next request.
 
-Tokens stream back per-request as they land (``GenerationRequest.stream``);
-a request's latency is its own prefill + its own tokens, not the
-longest neighbor's.  Greedy outputs are token-identical to sequential
-:func:`~polyaxon_tpu.models.decode.generate` calls
-(tests/test_serving/test_engine.py asserts it per request).
+Tokens stream back per-request as they land; ``cancel()`` releases a
+request's slot, blocks, and prefix references immediately, and
+``stop()`` drains deterministically — every still-pending request gets
+an error and exactly one ``None`` stream sentinel.  Greedy outputs are
+token-identical to sequential
+:func:`~polyaxon_tpu.models.decode.generate` calls with paging, prefix
+sharing, and chunked prefill all enabled
+(tests/test_serving/test_paging.py asserts it per request).
 
-Sharded + quantized serving compose exactly like the request-granular
+Sharded + quantized serving compose exactly like the slot-granular
 path did: place the params (and the int8 ``(q, scale)`` tree) with
 ``decode_param_shardings`` / ``quantized_weight_shardings`` and GSPMD
-propagates head-sharding through prefill and the slot step — the KV
-slots live on the gang mesh.
+propagates head-sharding through the chunked prefill and the paged
+step — the block pool lives on the gang mesh.
 """
 
 from __future__ import annotations
@@ -42,6 +55,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from polyaxon_tpu.serving.paging import BlockAllocator, PrefixCache
 from polyaxon_tpu.stats import MemoryStats
 from polyaxon_tpu.tracking.flightrec import get_progress
 from polyaxon_tpu.tracking.trace import get_tracer
@@ -123,16 +137,45 @@ class SlotAllocator:
         return len(self._held)
 
 
+class _PrefillJob:
+    """One admitted request's remaining prompt insertion, advanced one
+    chunk per scheduler iteration."""
+
+    __slots__ = ("req", "slot", "next_pos", "cow_pending")
+
+    def __init__(self, req: GenerationRequest, slot: int) -> None:
+        self.req = req
+        self.slot = slot
+        self.next_pos = 0  # first prompt position not yet inserted
+        self.cow_pending = False  # full prefix hit: copy last block first
+
+
 class ServingEngine:
     """The continuous-batching scheduler: one thread owns the device.
 
     Parameters
     ----------
     params, cfg : the model (a ``TransformerConfig`` tree).
-    slots : concurrent sequences the cache holds (the static batch dim).
-    max_len : per-slot sequence capacity (default ``cfg.max_seq``).
-    qweights : int8 tree from ``decode.quantize_weights`` — the slot
-        step streams int8 exactly like request-granular decode did.
+    slots : concurrent sequences the batch holds (the static batch dim).
+    max_len : per-request sequence capacity (default ``cfg.max_seq``).
+    block_size : tokens per KV block — the paging granularity.  Smaller
+        blocks waste less tail capacity and share shorter prefixes;
+        larger blocks shrink tables and gather indices.
+    num_blocks : physical pool size INCLUDING the reserved trash block.
+        Defaults to ``1 + slots * ceil(max_len / block_size)`` — enough
+        for every slot to reach ``max_len`` with no sharing, i.e. the
+        old slot-granular footprint plus one block.  Size it below that
+        to overcommit on prefix sharing: exhaustion parks decodes until
+        references drop (and sheds the newest blocked request if nobody
+        can ever free one).
+    prefill_chunk : prompt tokens inserted per scheduler iteration.
+        ``None`` inserts each prompt whole (one chunk); a finite chunk
+        bounds how long any prefill can stall the decode batch, which
+        is what keeps TTFT p99 flat under load.
+    prefix_cache : share KV blocks between requests with identical
+        token-block prefixes (copy-on-write at the divergence point).
+    qweights : int8 tree from ``decode.quantize_weights`` — the paged
+        step streams int8 exactly like the slot step did.
     mesh / param_shardings / qweights_shardings : multi-chip serving;
         when given, params (and qweights) are placed on the mesh and
         GSPMD propagates the sharding through prefill and the step.
@@ -140,13 +183,16 @@ class ServingEngine:
     seed : RNG seed for the sampling path (greedy ignores it).
     stats : a stats backend receiving latency histograms
         (``serving.queue_wait_s`` / ``serving.ttft_s`` /
-        ``serving.decode_step_s`` / ``serving.batch_occupancy``);
-        defaults to a private :class:`MemoryStats` — ``lm_server`` passes
-        the process-wide registry so ``/metrics`` exports them.
+        ``serving.decode_step_s`` / ``serving.batch_occupancy``) and
+        paging gauges (``serving.block_occupancy`` /
+        ``serving.prefix_cache_hit_rate`` /
+        ``serving.prefill_backlog_chunks``); defaults to a private
+        :class:`MemoryStats` — ``lm_server`` passes the process-wide
+        registry so ``/metrics`` exports them.
     """
 
-    #: Prompt-length padding buckets: powers of two bound the number of
-    #: prefill compilations at log2(max_len) regardless of traffic.
+    #: Padding buckets for prompt chunks: powers of two bound the number
+    #: of prefill compilations at log2(max_len) regardless of traffic.
     @staticmethod
     def _bucket(t: int, max_len: int) -> int:
         b = 8
@@ -161,6 +207,10 @@ class ServingEngine:
         *,
         slots: int = 4,
         max_len: Optional[int] = None,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
+        prefix_cache: bool = True,
         qweights: Optional[Any] = None,
         mesh: Any = None,
         param_shardings: Optional[Any] = None,
@@ -180,9 +230,17 @@ class ServingEngine:
                 f"max_len ({max_len}) exceeds the model's max_seq "
                 f"({cfg.max_seq})"
             )
+        if block_size < 1:
+            raise ValueError(f"block_size must be positive, got {block_size}")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be positive or None, got {prefill_chunk}"
+            )
         self.cfg = cfg
         self.slots = int(slots)
         self.max_len = int(max_len)
+        self.block_size = int(block_size)
+        self.prefill_chunk = prefill_chunk
         self.eos_id = eos_id
         self._mesh = mesh
         if param_shardings is not None:
@@ -191,7 +249,25 @@ class ServingEngine:
             qweights = jax.device_put(qweights, qweights_shardings)
         self._params = params
         self._qweights = qweights
-        self._cache = decode.init_cache(cfg, self.slots, self.max_len)
+
+        # Table width: logical blocks a max_len sequence spans.  The
+        # default pool matches the old slot-granular footprint (every
+        # slot can reach max_len unshared) plus the trash block.
+        self._table_width = -(-self.max_len // self.block_size)
+        if num_blocks is None:
+            num_blocks = 1 + self.slots * self._table_width
+        self.block_allocator = BlockAllocator(num_blocks)
+        self.prefix_cache = (
+            PrefixCache(self.block_allocator, self.block_size)
+            if prefix_cache
+            else None
+        )
+        self._pool = decode.init_block_pool(cfg, num_blocks, self.block_size)
+        # Per-slot block tables (host truth): -1 = unset, mapped to the
+        # trash block when shipped to the device.
+        self._tables = np.full(
+            (self.slots, self._table_width), -1, np.int32
+        )
 
         # Host-side per-slot state: the NEXT token to feed, its absolute
         # position, the active mask, and each slot's sampling temperature.
@@ -203,14 +279,17 @@ class ServingEngine:
 
         self.allocator = SlotAllocator(self.slots)
         self._queue: "deque[GenerationRequest]" = deque()
+        self._prefill: "deque[_PrefillJob]" = deque()
+        self._parked: List[int] = []
+        self._cancels: set = set()
         self._cv = threading.Condition()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
         self._key = jax.random.PRNGKey(seed)
         self._rng = np.random.default_rng(seed)
-        self._prefill_fns: Dict[int, Any] = {}
-        self._insert_fns: Dict[int, Any] = {}
+        self._chunk_fns: Dict[int, Any] = {}
+        self._copy_fn: Optional[Any] = None
         self._step_fn = self._build_step()
 
         # Stats: lifetime counters plus a sliding window for tokens/s;
@@ -223,8 +302,13 @@ class ServingEngine:
         self._stats_lock = threading.Lock()
         self._n_submitted = 0
         self._n_finished = 0
+        self._n_cancelled = 0
         self._n_tokens = 0
         self._n_steps = 0
+        self._n_parks = 0
+        self._n_cow = 0
+        self._backlog_chunks = 0
+        self._prefill_jobs = 0
         self._window: "deque[tuple]" = deque()  # (t, n_tokens)
         # Decode-side utilization ledger (armed in start()): device-busy
         # seconds (prefill + decode dispatch/sync) and occupancy-weighted
@@ -237,24 +321,25 @@ class ServingEngine:
     # -- compiled functions ----------------------------------------------------
 
     def _donate(self) -> tuple:
-        # Cache donation halves peak HBM for the engine's largest buffer;
-        # CPU ignores donation with a warning, so only request it on
-        # accelerator backends.
-        import jax
-
-        return (1,) if jax.default_backend() != "cpu" else ()
+        # Pool donation halves peak memory for the engine's largest
+        # buffer — and without it every chunk/step call COPIES the whole
+        # pool on its way out, a per-call cost that grows with the pool
+        # and multiplies under chunked prefill.  All current backends
+        # (CPU included) honor donation for same-shape aliasing.
+        return (1,)
 
     def _build_step(self):
         import jax
         import jax.numpy as jnp
 
-        from polyaxon_tpu.models.decode import slot_decode_step
+        from polyaxon_tpu.models.decode import paged_decode_step
 
         cfg = self.cfg
 
-        def step(params, cache, tokens, pos, active, temps, key, qweights):
-            logits, cache = slot_decode_step(
-                params, cache, tokens, pos, active, cfg, qweights=qweights
+        def step(params, pool, tables, tokens, pos, active, temps, key, qweights):
+            logits, pool = paged_decode_step(
+                params, pool, tables, tokens, pos, active, cfg,
+                qweights=qweights,
             )
             greedy_tok = jnp.argmax(logits, axis=-1)
             # Per-slot keys: a slot's sample must not depend on which
@@ -265,40 +350,38 @@ class ServingEngine:
                 keys, logits / safe[:, None]
             )
             tok = jnp.where(temps > 0, sampled, greedy_tok)
-            return jnp.where(active, tok, 0).astype(jnp.int32), cache
+            return jnp.where(active, tok, 0).astype(jnp.int32), pool
 
         return jax.jit(step, donate_argnums=self._donate())
 
-    def _get_prefill(self, t_pad: int):
+    def _get_chunk(self, c_pad: int):
         import jax
-        import jax.numpy as jnp
 
-        from polyaxon_tpu.models.transformer import forward
+        from polyaxon_tpu.models.decode import paged_prefill_chunk
 
-        if t_pad not in self._prefill_fns:
+        if c_pad not in self._chunk_fns:
             cfg = self.cfg
 
-            def pre(params, tokens, last):
-                logits, (k, v) = forward(params, tokens, cfg, return_kv=True)
-                # Right-padded prompt: the real last-token logits sit at
-                # index ``last`` (causal attention keeps them independent
-                # of the pad tail).
-                return jnp.take(logits[0], last, axis=0), k[:, 0], v[:, 0]
+            def chunk_fn(params, pool, table, tokens, start, length):
+                return paged_prefill_chunk(
+                    params, pool, table, tokens, start, length, cfg
+                )
 
-            self._prefill_fns[t_pad] = jax.jit(pre)
-        return self._prefill_fns[t_pad]
+            self._chunk_fns[c_pad] = jax.jit(
+                chunk_fn, donate_argnums=(1,) if self._donate() else ()
+            )
+        return self._chunk_fns[c_pad]
 
-    def _get_insert(self, t_pad: int):
+    def _get_copy(self):
         import jax
 
-        from polyaxon_tpu.models.decode import insert_prompt
+        from polyaxon_tpu.models.decode import copy_block
 
-        if t_pad not in self._insert_fns:
-            self._insert_fns[t_pad] = jax.jit(
-                lambda cache, slot, k, v: insert_prompt(cache, slot, k, v),
-                donate_argnums=(0,) if self._donate() else (),
+        if self._copy_fn is None:
+            self._copy_fn = jax.jit(
+                copy_block, donate_argnums=(0,) if self._donate() else ()
             )
-        return self._insert_fns[t_pad]
+        return self._copy_fn
 
     # -- public API ------------------------------------------------------------
 
@@ -322,14 +405,31 @@ class ServingEngine:
             self._thread.join(timeout=30)
             self._thread = None
         if self._ledger is not None:
-            self._ledger.merge_extra(**self._utilization_snapshot())
+            paging = self._paging_snapshot()
+            self._ledger.merge_extra(
+                **self._utilization_snapshot(),
+                block_occupancy=paging["block_occupancy"],
+                prefix_cache_hit_rate=paging["prefix_cache_hit_rate"],
+                prefill_backlog_chunks=paging["prefill_backlog_chunks"],
+            )
             self._ledger.flush(final=True)
             self._ledger = None
-        # Fail anything still queued or in flight so waiters unblock.
+        # Deterministic drain: every request still holding a waiter gets
+        # its error and exactly ONE None stream sentinel — queued,
+        # mid-prefill, parked, or actively decoding alike (requests in
+        # the prefill deque also sit in _slot_req; the id-keyed dict
+        # de-dupes them).
         with self._cv:
             pending = list(self._queue)
             self._queue.clear()
-        for req in pending + [r for r in self._slot_req if r is not None]:
+        drain: Dict[int, GenerationRequest] = {r.id: r for r in pending}
+        for job in self._prefill:
+            drain.setdefault(job.req.id, job.req)
+        self._prefill.clear()
+        for req in self._slot_req:
+            if req is not None:
+                drain.setdefault(req.id, req)
+        for req in drain.values():
             if not req.done.is_set():
                 req.error = "engine stopped"
                 req.stream.put(None)
@@ -354,6 +454,13 @@ class ServingEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds the engine's max_len ({self.max_len})"
             )
+        needed = -(-(len(prompt) + max_new_tokens) // self.block_size)
+        usable = self.block_allocator.num_blocks - 1
+        if needed > usable:
+            raise ValueError(
+                f"request spans {needed} KV blocks but the pool only has "
+                f"{usable}; raise num_blocks or shorten the request"
+            )
         req = GenerationRequest(prompt, max_new_tokens, temperature)
         with self._cv:
             if self._stop.is_set():
@@ -362,6 +469,37 @@ class ServingEngine:
             self._n_submitted += 1
             self._cv.notify_all()
         return req
+
+    def cancel(self, request_id: int) -> bool:
+        """Best-effort immediate release of one request.
+
+        A queued request fails in place; an in-flight one (prefilling,
+        parked, or decoding) is failed by the scheduler thread on its
+        next iteration, releasing its slot, KV blocks, and prefix-cache
+        references.  Returns ``False`` for unknown or already-finished
+        ids.  The cancelled request's waiters observe a ``RuntimeError``
+        ("request cancelled") and one ``None`` stream sentinel.
+        """
+        with self._cv:
+            for req in list(self._queue):
+                if req.id == request_id:
+                    self._queue.remove(req)
+                    with self._stats_lock:
+                        self._n_cancelled += 1
+                    req.error = "request cancelled"
+                    req.stream.put(None)
+                    req.done.set()
+                    return True
+            for req in self._slot_req:
+                if (
+                    req is not None
+                    and req.id == request_id
+                    and not req.done.is_set()
+                ):
+                    self._cancels.add(request_id)
+                    self._cv.notify_all()
+                    return True
+        return False
 
     def generate(
         self,
@@ -391,6 +529,36 @@ class ServingEngine:
             "decode_utilization": round(busy_frac * occ, 6),
         }
 
+    def _paging_snapshot(self) -> Dict[str, Any]:
+        """Block-pool / prefix-cache / prefill-backlog state, shared by
+        ``stats()``, the Prometheus gauges, and the final ledger row."""
+        alloc = self.block_allocator
+        total = alloc.num_blocks - 1
+        pc = self.prefix_cache
+        with self._stats_lock:
+            backlog = self._backlog_chunks
+            jobs = self._prefill_jobs
+            parks = self._n_parks
+            cow = self._n_cow
+            cancelled = self._n_cancelled
+        return {
+            "block_size": self.block_size,
+            "blocks_total": total,
+            "blocks_free": alloc.n_free,
+            "block_occupancy": (
+                round(alloc.n_used / total, 6) if total else 0.0
+            ),
+            "prefix_cache_blocks": len(pc) if pc is not None else 0,
+            "prefix_cache_hit_rate": (
+                round(pc.hit_rate, 6) if pc is not None else 0.0
+            ),
+            "prefill_backlog_chunks": backlog,
+            "prefill_jobs": jobs,
+            "block_parks": parks,
+            "cow_copies": cow,
+            "requests_cancelled": cancelled,
+        }
+
     def _ledger_account(self, dt: float, occ_frac: float, tokens: int) -> None:
         """Fold one device-busy interval into the utilization ledger."""
         with self._stats_lock:
@@ -407,6 +575,7 @@ class ServingEngine:
 
     def stats(self) -> Dict[str, Any]:
         util = self._utilization_snapshot()
+        paging = self._paging_snapshot()
         with self._stats_lock:
             now = time.time()
             while self._window and now - self._window[0][0] > 10.0:
@@ -426,6 +595,7 @@ class ServingEngine:
                 "decode_steps": self._n_steps,
                 "tokens_per_s": round(tps, 1),
                 "max_len": self.max_len,
+                **paging,
                 **util,
             }
 
@@ -451,24 +621,74 @@ class ServingEngine:
     def _loop(self) -> None:
         tracer = get_tracer()
         while not self._stop.is_set():
+            self._process_cancels()
             self._admit()
-            if not self._active.any():
-                with self._cv:
-                    if not self._queue and not self._stop.is_set():
-                        self._cv.wait(timeout=0.2)
+            progressed = self._resume_parked()
+            # Prefill under a per-iteration TOKEN BUDGET of one chunk:
+            # either a single chunk of a long prompt, or several whole
+            # short prompts coalesced — a burst of shorts doesn't pay a
+            # decode-step round-trip each, while device time between
+            # decode steps stays bounded.  Jobs are picked shortest-
+            # remaining-work-first: chunk boundaries are preemption
+            # points, so a short prompt arriving behind a half-done long
+            # one overtakes it instead of waiting out the whole thing.
+            # (min() is stable — equal-length jobs stay FIFO.)
+            budget = self.prefill_chunk or 0
+            spent = 0
+            while self._prefill:
+                job = min(
+                    self._prefill,
+                    key=lambda j: len(j.req.prompt) - j.next_pos,
+                )
+                if job is not self._prefill[0]:
+                    self._prefill.remove(job)
+                    self._prefill.appendleft(job)
+                remaining = len(job.req.prompt) - job.next_pos
+                spent += min(remaining, budget) if budget else remaining
+                try:
+                    # Per-iteration span at the hot sample rate, like the
+                    # decode step below: prefill runs per CHUNK.
+                    with tracer.span(
+                        "serving:prefill",
+                        sample=tracer.hot_sample,
+                        request_id=job.req.id,
+                    ):
+                        did = self._prefill_tick()
+                except Exception as e:
+                    if self._prefill and self._prefill[0] is job:
+                        self._prefill.popleft()
+                    self._fail_slot(job.slot, f"prefill failed: {e!r}")
+                    progressed = True
+                    break
+                if not did:
+                    break  # blocked on the block pool; retry next iteration
+                progressed = True
+                if not budget or spent >= budget:
+                    break
+            if self._active.any():
+                try:
+                    with tracer.span("serving:step", sample=tracer.hot_sample):
+                        self._step_once()
+                except Exception as e:  # fail in-flight, keep serving
+                    for slot in np.nonzero(self._active)[0]:
+                        self._fail_slot(int(slot), f"decode step failed: {e!r}")
                 continue
-            try:
-                # Per-iteration span, sampled at the hot rate: the decode
-                # loop runs per generated token, full tracing would cost
-                # more than the histograms it duplicates.
-                with tracer.span("serving:step", sample=tracer.hot_sample):
-                    self._step_once()
-            except Exception as e:  # fail in-flight requests, keep serving
-                for slot in np.nonzero(self._active)[0]:
-                    self._fail_slot(int(slot), f"decode step failed: {e!r}")
+            if progressed:
+                continue
+            if self._parked or self._prefill:
+                # Nothing active, nothing moved, eviction already tried:
+                # the requests still waiting on blocks are deadlocked —
+                # shed one so the rest can make progress.
+                self._resolve_block_deadlock()
+                continue
+            with self._cv:
+                if not self._queue and not self._stop.is_set():
+                    self._cv.wait(timeout=0.2)
 
     def _admit(self) -> None:
-        """Prefill waiting requests into free slots (queue order)."""
+        """Move queued requests into free slots (queue order) and enqueue
+        their prefill jobs; the prefix cache shortens a job to its first
+        uncached block."""
         while True:
             with self._cv:
                 if not self._queue:
@@ -477,52 +697,125 @@ class ServingEngine:
                 if slot is None:
                     return
                 req = self._queue.popleft()
-            try:
-                tracer = get_tracer()
-                with tracer.span(
-                    "serving:admit", sample=tracer.hot_sample, request_id=req.id
-                ):
-                    self._prefill_into(slot, req)
-            except Exception as e:
-                self._slot_req[slot] = None
-                self.allocator.free(slot)
-                req.error = f"prefill failed: {e!r}"
-                req.stream.put(None)
-                req.done.set()
+            req.started_at = time.time()
+            self.stats_registry.timing(
+                "serving.queue_wait_s", req.started_at - req.submitted_at
+            )
+            self._slot_req[slot] = req
+            job = _PrefillJob(req, slot)
+            if self.prefix_cache is not None:
+                matched = self.prefix_cache.match(req.prompt)
+                for i, block in enumerate(matched):
+                    self._tables[slot, i] = block
+                m = len(matched) * self.block_size
+                if m and m == len(req.prompt):
+                    # Every prompt block hit.  The last token's LOGITS
+                    # still must be recomputed, and its KV row lands in
+                    # the final SHARED block — copy it private first
+                    # (copy-on-write), then re-run just that one token.
+                    job.cow_pending = True
+                    job.next_pos = m - 1
+                else:
+                    job.next_pos = m
+            self._prefill.append(job)
+            self._record_gauges()
 
-    def _prefill_into(self, slot: int, req: GenerationRequest) -> None:
+    def _alloc_block(self) -> Optional[int]:
+        """Allocate one pool block, evicting a cold cached prefix if the
+        free list is empty."""
+        block = self.block_allocator.alloc()
+        if block is None and self.prefix_cache is not None:
+            if self.prefix_cache.evict(1):
+                block = self.block_allocator.alloc()
+        return block
+
+    def _prefill_tick(self) -> bool:
+        """Run ONE chunk of the oldest pending prefill.  Returns True if
+        the device did work; False means the job is blocked on the block
+        pool (it stays at the head and retries next iteration)."""
         import jax.numpy as jnp
 
-        t0 = time.perf_counter()
-        req.started_at = time.time()
-        self.stats_registry.timing(
-            "serving.queue_wait_s", req.started_at - req.submitted_at
-        )
+        job = self._prefill[0]
+        req, slot = job.req, job.slot
+        bs = self.block_size
         t = len(req.prompt)
-        t_pad = self._bucket(t, self.max_len)
-        padded = np.zeros((1, t_pad), np.int32)
-        padded[0, :t] = req.prompt
-        last_logits, k, v = self._get_prefill(t_pad)(
-            self._params, jnp.asarray(padded), jnp.int32(t - 1)
+        t0 = time.perf_counter()
+        if job.cow_pending:
+            fresh = self._alloc_block()
+            if fresh is None:
+                return False
+            bi = (t - 1) // bs
+            shared = int(self._tables[slot, bi])
+            self._pool = self._get_copy()(
+                self._pool, jnp.int32(shared), jnp.int32(fresh)
+            )
+            self.block_allocator.decref(shared)
+            self._tables[slot, bi] = fresh
+            job.cow_pending = False
+            with self._stats_lock:
+                self._n_cow += 1
+        n = t - job.next_pos
+        if self.prefill_chunk:
+            n = min(n, self.prefill_chunk)
+        # Lazy block faults for the chunk's span; partial allocations are
+        # kept on exhaustion (the retry only fills what's still unset).
+        first_bi = job.next_pos // bs
+        last_bi = (job.next_pos + n - 1) // bs
+        for bi in range(first_bi, last_bi + 1):
+            if self._tables[slot, bi] < 0:
+                fresh = self._alloc_block()
+                if fresh is None:
+                    return False
+                self._tables[slot, bi] = fresh
+        c_pad = self._bucket(n, self.max_len)
+        chunk = np.zeros(c_pad, np.int32)
+        chunk[:n] = req.prompt[job.next_pos : job.next_pos + n]
+        table = np.where(self._tables[slot] >= 0, self._tables[slot], 0)
+        logits, self._pool = self._get_chunk(c_pad)(
+            self._params,
+            self._pool,
+            jnp.asarray(table.astype(np.int32)),
+            jnp.asarray(chunk),
+            jnp.int32(job.next_pos),
+            jnp.int32(n),
         )
-        self._cache = self._get_insert(t_pad)(
-            self._cache, jnp.int32(slot), k, v
+        job.next_pos += n
+        done = job.next_pos >= t
+        # Chunk compute is device-busy time serving one request; only the
+        # final chunk emits a token.
+        self._ledger_account(
+            time.perf_counter() - t0, 1.0 / self.slots,
+            tokens=1 if done else 0,
         )
-        first = self._pick_first(np.asarray(last_logits), req.temperature)
+        if done:
+            self._prefill.popleft()
+            self._finalize_prefill(job, np.asarray(logits))
+        self._record_gauges()
+        self._progress.beat(step=self._n_steps)
+        return True
+
+    def _finalize_prefill(self, job: _PrefillJob, logits: np.ndarray) -> None:
+        """Prompt fully inserted: publish its blocks, pick the first
+        token from the last chunk's logits, activate the slot."""
+        req, slot = job.req, job.slot
+        t = len(req.prompt)
+        if self.prefix_cache is not None:
+            full = t // self.block_size
+            self.prefix_cache.offer(
+                req.prompt,
+                [int(self._tables[slot, i]) for i in range(full)],
+            )
+        first = self._pick_first(logits, req.temperature)
         # Time-to-first-token: prefill produced it, the client can read it.
-        self.stats_registry.timing("serving.ttft_s", time.time() - req.submitted_at)
-        self._slot_req[slot] = req
+        self.stats_registry.timing(
+            "serving.ttft_s", time.time() - req.submitted_at
+        )
         self._emit(slot, req, first)
         if not req.done.is_set():
             self._tok[slot] = first
             self._pos[slot] = t
             self._temps[slot] = req.temperature
             self._active[slot] = True
-        # Prefill is device-busy time serving one request (+ its first
-        # emitted token).
-        self._ledger_account(
-            time.perf_counter() - t0, 1.0 / self.slots, tokens=1
-        )
 
     def _pick_first(self, logits: np.ndarray, temperature: float) -> int:
         """First generated token comes from the prefill logits (exactly
@@ -535,15 +828,89 @@ class ServingEngine:
         p /= p.sum()
         return int(self._rng.choice(len(p), p=p))
 
+    def _park(self, slot: int) -> None:
+        """Pool exhausted at a block boundary: deactivate the slot with
+        its state and blocks intact.  The active mask is data, so parking
+        and resuming never recompile."""
+        self._active[slot] = False
+        self._parked.append(slot)
+        with self._stats_lock:
+            self._n_parks += 1
+
+    def _resume_parked(self) -> bool:
+        """Give parked slots another shot at their faulted block."""
+        resumed = False
+        for slot in list(self._parked):
+            bi = int(self._pos[slot]) // self.block_size
+            if self._tables[slot, bi] < 0:
+                fresh = self._alloc_block()
+                if fresh is None:
+                    continue
+                self._tables[slot, bi] = fresh
+            self._parked.remove(slot)
+            self._active[slot] = True
+            resumed = True
+        return resumed
+
+    def _resolve_block_deadlock(self) -> None:
+        """Nobody active, nobody progressing, eviction exhausted: shed
+        the newest parked request (it holds blocks, so shedding is
+        guaranteed to free some), else the head prefill job."""
+        if self._parked:
+            self._fail_slot(
+                self._parked[-1], "KV block pool exhausted (request shed)"
+            )
+            return
+        if self._prefill:
+            job = self._prefill.popleft()
+            self._fail_slot(
+                job.slot, "KV block pool exhausted (request shed)"
+            )
+
+    def _process_cancels(self) -> None:
+        """Apply cancellations to in-flight requests (scheduler thread:
+        it owns the tables and allocators)."""
+        with self._cv:
+            if not self._cancels:
+                return
+            ids, self._cancels = self._cancels, set()
+        for rid in ids:
+            for job in list(self._prefill):
+                if job.req.id == rid:
+                    self._prefill.remove(job)
+            for slot, req in enumerate(self._slot_req):
+                if req is not None and req.id == rid:
+                    self._fail_slot(slot, "request cancelled")
+                    with self._stats_lock:
+                        self._n_cancelled += 1
+        self._record_gauges()
+
     def _step_once(self) -> None:
         import jax
         import jax.numpy as jnp
 
+        bs = self.block_size
+        # Block-boundary faults: a slot whose next write crosses into an
+        # unallocated block needs one now — or parks until the pool can
+        # provide it.
+        for slot in np.nonzero(self._active)[0]:
+            slot = int(slot)
+            bi = int(self._pos[slot]) // bs
+            if self._tables[slot, bi] < 0:
+                fresh = self._alloc_block()
+                if fresh is None:
+                    self._park(slot)
+                else:
+                    self._tables[slot, bi] = fresh
+        if not self._active.any():
+            return
         t0 = time.perf_counter()
         self._key, sub = jax.random.split(self._key)
-        toks, self._cache = self._step_fn(
+        tables = np.where(self._tables >= 0, self._tables, 0).astype(np.int32)
+        toks, self._pool = self._step_fn(
             self._params,
-            self._cache,
+            self._pool,
+            jnp.asarray(tables),
             jnp.asarray(self._tok),
             jnp.asarray(self._pos),
             jnp.asarray(self._active),
@@ -569,7 +936,35 @@ class ServingEngine:
         self.stats_registry.timing("serving.decode_step_s", step_dt)
         self.stats_registry.observe("serving.batch_occupancy", float(n_live))
         self._ledger_account(step_dt, n_live / self.slots, tokens=n_live)
+        self._record_gauges()
         self._progress.beat(step=self._n_steps)
+
+    def _record_gauges(self) -> None:
+        """Refresh paging gauges + backlog counters (scheduler thread)."""
+        backlog = 0
+        for job in self._prefill:
+            remaining = len(job.req.prompt) - job.next_pos
+            step = self.prefill_chunk or max(remaining, 1)
+            backlog += max(1, -(-remaining // step))
+        with self._stats_lock:
+            self._backlog_chunks = backlog
+            self._prefill_jobs = len(self._prefill)
+        gauge = getattr(self.stats_registry, "gauge", None)
+        if gauge is None:
+            return
+        alloc = self.block_allocator
+        total = alloc.num_blocks - 1
+        gauge(
+            "serving.block_occupancy",
+            round(alloc.n_used / total, 6) if total else 0.0,
+        )
+        gauge("serving.blocks_free", float(alloc.n_free))
+        pc = self.prefix_cache
+        gauge(
+            "serving.prefix_cache_hit_rate",
+            round(pc.hit_rate, 6) if pc is not None else 0.0,
+        )
+        gauge("serving.prefill_backlog_chunks", float(backlog))
 
     def _emit(self, slot: int, req: GenerationRequest, tok: int) -> None:
         """Record one generated token; retire the slot when done."""
@@ -581,11 +976,24 @@ class ServingEngine:
         if len(req.tokens) >= req.max_new_tokens or hit_eos:
             self._retire(slot, req)
 
+    def _release_slot_blocks(self, slot: int) -> None:
+        """Drop the slot's reference on every block in its table.  Blocks
+        a neighbor or the prefix cache still references stay allocated —
+        the defining safety property of sharing."""
+        for bi in range(self._table_width):
+            block = int(self._tables[slot, bi])
+            if block >= 0:
+                self.block_allocator.decref(block)
+        self._tables[slot, :] = -1
+
     def _retire(self, slot: int, req: GenerationRequest) -> None:
         req.finished_at = time.time()
         req.stream.put(None)
         req.done.set()
         self._active[slot] = False
+        if slot in self._parked:
+            self._parked.remove(slot)
+        self._release_slot_blocks(slot)
         self._slot_req[slot] = None
         self.allocator.free(slot)
         with self._stats_lock:
@@ -598,6 +1006,9 @@ class ServingEngine:
     def _fail_slot(self, slot: int, msg: str) -> None:
         req = self._slot_req[slot]
         self._active[slot] = False
+        if slot in self._parked:
+            self._parked.remove(slot)
+        self._release_slot_blocks(slot)
         self._slot_req[slot] = None
         self.allocator.free(slot)
         if req is not None and not req.done.is_set():

@@ -1,0 +1,123 @@
+"""Poisson-arrival load harness for the serving engine.
+
+The existing ``serving_tokens_per_s`` bench number compares sequential
+vs concurrent submission of the SAME instant — it says nothing about
+tail latency under sustained load.  This harness drives the engine the
+way traffic actually arrives: exponential inter-arrival gaps at a
+target rate, one watcher thread per request reading its token STREAM
+(so TTFT is measured at the moment the first token is readable by a
+client, not when ``wait()`` returns), and aggregate tokens/s over the
+loaded wall clock.
+
+The interesting output is ``ttft_p99_s``: with full-prompt prefill a
+request that arrives behind a long prompt waits the WHOLE prefill
+before its own; with chunked prefill it waits at most one chunk —
+bench.py runs this harness twice at the same offered load and schedule
+to show exactly that difference.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(np.ceil(q / 100.0 * len(sorted_vals))) - 1)
+    return sorted_vals[max(idx, 0)]
+
+
+def poisson_load(
+    engine: Any,
+    prompts: Sequence[Sequence[int]],
+    max_new_tokens: int,
+    *,
+    rate_rps: float,
+    temperature: float = 0.0,
+    seed: int = 0,
+    timeout_s: float = 600.0,
+) -> Dict[str, Any]:
+    """Offer ``prompts`` to a RUNNING engine at ``rate_rps`` Poisson
+    arrivals; returns loaded-throughput and TTFT-percentile metrics.
+
+    The arrival schedule is drawn up front from ``seed``, so two runs
+    with the same (prompts, rate, seed) offer the identical load — the
+    property that makes chunked-vs-full prefill A/B comparisons fair.
+    """
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be positive, got {rate_rps}")
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=len(prompts))
+
+    results: List[Optional[tuple]] = [None] * len(prompts)
+
+    def watch(i: int, req: Any, t_submit: float) -> None:
+        ttft = None
+        n_tokens = 0
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                tok = req.stream.get(timeout=remaining)
+            except Exception:
+                break
+            if tok is None:
+                break
+            if ttft is None:
+                ttft = time.perf_counter() - t_submit
+            n_tokens += 1
+        results[i] = (ttft, n_tokens, time.perf_counter() - t_submit, req.error)
+
+    threads: List[threading.Thread] = []
+    t_start = time.perf_counter()
+    for i, prompt in enumerate(prompts):
+        time.sleep(float(gaps[i]))
+        t_submit = time.perf_counter()
+        req = engine.submit(list(prompt), max_new_tokens, temperature)
+        th = threading.Thread(
+            target=watch, args=(i, req, t_submit), daemon=True
+        )
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=timeout_s)
+    wall = time.perf_counter() - t_start
+
+    done = [r for r in results if r is not None]
+    ttfts = sorted(r[0] for r in done if r[0] is not None)
+    total_tokens = sum(r[1] for r in done)
+    completed = sum(
+        1 for r in done if r[3] is None and r[1] >= max_new_tokens
+    )
+    errors = sum(1 for r in done if r[3] is not None)
+    return {
+        "n_requests": len(prompts),
+        "completed": completed,
+        "errors": errors,
+        "offered_rps": round(float(rate_rps), 4),
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(total_tokens / wall, 1) if wall > 0 else 0.0,
+        "total_tokens": total_tokens,
+        "ttft_mean_s": (
+            round(float(np.mean(ttfts)), 6) if ttfts else 0.0
+        ),
+        "ttft_p50_s": round(_pct(ttfts, 50), 6),
+        "ttft_p95_s": round(_pct(ttfts, 95), 6),
+        "ttft_p99_s": round(_pct(ttfts, 99), 6),
+        # Per-request TTFT by submission index (None = no first token),
+        # so callers can compute percentiles over request CLASSES —
+        # e.g. interactive shorts vs batch longs, which chunked prefill
+        # deliberately trades against each other.
+        "ttft_s": [
+            (round(r[0], 6) if r is not None and r[0] is not None else None)
+            for r in results
+        ],
+    }

@@ -88,6 +88,26 @@ class TestEngineLatencyHistograms:
         engine = ServingEngine(params, CFG, slots=2, max_len=48)
         assert isinstance(engine.stats_registry, MemoryStats)
 
+    def test_paging_gauges_in_registry(self, params):
+        registry = MemoryStats()
+        engine = ServingEngine(
+            params, CFG, slots=2, max_len=48, stats=registry
+        ).start()
+        try:
+            _run_requests(engine, n=2)
+        finally:
+            engine.stop()
+        gauges = registry.snapshot()["gauges"]
+        for key in (
+            "serving.block_occupancy",
+            "serving.blocks_free",
+            "serving.prefix_cache_hit_rate",
+            "serving.prefill_backlog_chunks",
+        ):
+            assert key in gauges, gauges.keys()
+        assert 0.0 <= gauges["serving.block_occupancy"] <= 1.0
+        assert 0.0 <= gauges["serving.prefix_cache_hit_rate"] <= 1.0
+
 
 class TestLmMetricsRoute:
     @pytest.fixture()
@@ -121,6 +141,10 @@ class TestLmMetricsRoute:
         ]
         assert buckets and buckets == sorted(buckets)
         assert buckets[-1] == 2.0  # +Inf bucket == request count
+        # Paging gauges ride along on the same scrape.
+        assert "polyaxon_tpu_serving_block_occupancy" in text
+        assert "polyaxon_tpu_serving_prefix_cache_hit_rate" in text
+        assert "polyaxon_tpu_serving_prefill_backlog_chunks" in text
 
     def test_stats_payload_gains_latency_block(self, server):
         base, engine = server

@@ -50,9 +50,9 @@ def server():
     engine.stop()
 
 
-def _post(base, payload, timeout=120):
+def _post_to(base, path, payload, timeout=120):
     req = urllib.request.Request(
-        base + "/generate",
+        base + path,
         data=json.dumps(payload).encode(),
         headers={"Content-Type": "application/json"},
     )
@@ -61,6 +61,10 @@ def _post(base, payload, timeout=120):
             return resp.status, json.loads(resp.read())
     except urllib.error.HTTPError as e:
         return e.code, json.loads(e.read())
+
+
+def _post(base, payload, timeout=120):
+    return _post_to(base, "/generate", payload, timeout)
 
 
 def _get(base, path):
@@ -147,6 +151,13 @@ class TestLMHttp:
             assert status == 400, payload
             assert "error" in body
 
+    def test_stats_expose_paging_gauges(self, server):
+        base, _ = server
+        status, body = _get(base, "/v1/stats")
+        assert status == 200
+        assert {"block_occupancy", "blocks_free", "prefix_cache_hit_rate",
+                "prefill_backlog_chunks", "requests_cancelled"} <= set(body)
+
     def test_unknown_paths_404(self, server):
         base, _ = server
         for make in (
@@ -159,3 +170,67 @@ class TestLMHttp:
             except urllib.error.HTTPError as e:
                 status = e.code
             assert status == 404
+
+
+class TestCancellation:
+    """The /v1/cancel route + the server-side wait()-timeout abandonment
+    path: both must release the request's slot and blocks immediately."""
+
+    @pytest.fixture()
+    def own_server(self):
+        params = init_params(jax.random.PRNGKey(1), CFG)
+        engine = ServingEngine(params, CFG, slots=1, max_len=48).start()
+        handler = _make_lm_handler(
+            engine, CFG,
+            {"checkpoint_step": None, "default_max_new": 8,
+             "request_timeout_s": 0.5},
+        )
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        yield f"http://127.0.0.1:{httpd.server_address[1]}", engine
+        httpd.shutdown()
+        httpd.server_close()
+        engine.stop()
+
+    def _await_idle(self, engine, timeout=30):
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            s = engine.stats()
+            if s["slots_active"] == 0 and s["blocks_free"] == s["blocks_total"]:
+                return s
+            time.sleep(0.05)
+        return engine.stats()
+
+    def test_cancel_route_roundtrip(self, own_server):
+        base, engine = own_server
+        req = engine.submit([1, 2, 3], 40)
+        assert req.stream.get(timeout=60) is not None  # in flight
+        status, body = _post_to(base, "/v1/cancel", {"request_id": req.id})
+        assert status == 200 and body["cancelled"] is True
+        with pytest.raises(RuntimeError, match="cancelled"):
+            req.wait(timeout=30)
+        s = self._await_idle(engine)
+        assert s["slots_active"] == 0
+        assert s["blocks_free"] == s["blocks_total"]
+
+    def test_cancel_unknown_id_and_bad_payload(self, own_server):
+        base, _ = own_server
+        status, body = _post_to(base, "/v1/cancel", {"request_id": 10**9})
+        assert status == 200 and body["cancelled"] is False
+        status, body = _post_to(base, "/v1/cancel", {})
+        assert status == 400 and "error" in body
+
+    def test_generate_timeout_cancels_abandoned_request(self, own_server):
+        """meta.request_timeout_s = 0.5s but the request wants 40 tokens:
+        the client gets a 503 and the engine must NOT keep decoding to
+        max_new_tokens for nobody — slot and blocks free promptly."""
+        base, engine = own_server
+        status, body = _post(base, {"prompts": [[1, 2, 3]], "max_new_tokens": 40})
+        assert status == 503
+        s = self._await_idle(engine)
+        assert s["slots_active"] == 0
+        assert s["blocks_free"] == s["blocks_total"]
+        assert s["requests_cancelled"] >= 1
