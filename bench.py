@@ -420,6 +420,10 @@ def main() -> None:
     # the budget is absolute-error with compile-amortization slack.
     reported_mfu_abs_err = None
     reported_mfu_ok = None
+    first_step_s_cold = None
+    first_step_s_warm = None
+    first_step_warm_ok = None
+    warm_cache_hits = None
     try:
         import sys
         import tempfile
@@ -431,37 +435,67 @@ def main() -> None:
             tempfile.mkdtemp(), monitor_interval=0.05, heartbeat_interval=0.2
         )
         try:
-            run = orch.submit(
-                {
-                    "kind": "experiment",
-                    "run": {
-                        "entrypoint": "polyaxon_tpu.builtins.trainers:lm_train"
-                    },
-                    "declarations": {
-                        "steps": 30,
-                        "batch": 4,
-                        "seq": 64,
-                        "vocab_size": 256,
-                        "d_model": 64,
-                        "n_layers": 2,
-                        "n_heads": 4,
-                        "head_dim": 16,
-                        "d_ff": 128,
-                    },
-                    "environment": {
-                        "topology": {
-                            "accelerator": "cpu-1",
-                            "num_devices": 1,
-                            "num_hosts": 1,
-                        }
-                    },
-                }
-            )
+            smoke_spec = {
+                "kind": "experiment",
+                "run": {
+                    "entrypoint": "polyaxon_tpu.builtins.trainers:lm_train"
+                },
+                "declarations": {
+                    "steps": 30,
+                    "batch": 4,
+                    "seq": 64,
+                    "vocab_size": 256,
+                    "d_model": 64,
+                    "n_layers": 2,
+                    "n_heads": 4,
+                    "head_dim": 16,
+                    "d_ff": 128,
+                },
+                "environment": {
+                    "topology": {
+                        "accelerator": "cpu-1",
+                        "num_devices": 1,
+                        "num_hosts": 1,
+                    }
+                },
+            }
+            run = orch.submit(smoke_spec)
             orch.wait(run.id, timeout=300)
             g = goodput_status(orch.registry, run.id)
             last = orch.registry.get_run(run.id).last_metric or {}
+            # Cold/warm A/B on the SAME store layout: the first gang
+            # compiled fresh and wrote the persistent compile cache; a
+            # second, identical gang is a NEW worker process that should
+            # load its step executable from disk instead of compiling.
+            # first_step_s (AOT compile/cache-load + first step wall) is
+            # the cold-start metric; the warm run must be materially
+            # below the cold one and its ledger must show cache hits.
+            run2 = orch.submit(smoke_spec)
+            orch.wait(run2.id, timeout=300)
+            g2 = goodput_status(orch.registry, run2.id)
+            last2 = orch.registry.get_run(run2.id).last_metric or {}
         finally:
             orch.stop()
+        first_step_s_cold = last.get("first_step_s")
+        first_step_s_warm = last2.get("first_step_s")
+        warm_cache_hits = g2.get("compile_cache_hits")
+        if first_step_s_cold and first_step_s_warm:
+            # Budget: the warm restart must recoup a real fraction of the
+            # cold compile bill (cache load + dispatch isn't free, so not
+            # ~0 — but well under a fresh compile).
+            first_step_warm_ok = (
+                first_step_s_warm < 0.8 * first_step_s_cold
+                and (warm_cache_hits or 0) > 0
+            )
+            if not first_step_warm_ok:
+                print(
+                    f"bench: warm first_step_s={first_step_s_warm:.3f} "
+                    f"(cache hits={warm_cache_hits}) did not materially "
+                    f"beat cold first_step_s={first_step_s_cold:.3f} — "
+                    "the persistent compile cache is not being reused "
+                    "across worker processes",
+                    file=sys.stderr,
+                )
         if g["rows"] and g["wall_s"] > 0 and last.get("tokens_per_s"):
             smoke_peak = PEAK_FLOPS.get(g["device_kind"], 197e12) * max(
                 1, g["devices"]
@@ -511,6 +545,7 @@ def main() -> None:
     # warmed first (per prompt-length bucket) so this measures steady
     # state, not compilation.
     serving = None
+    serving_ready_s = None
     try:
         from polyaxon_tpu.models import decode as decode_mod
         from polyaxon_tpu.serving import ServingEngine
@@ -567,7 +602,14 @@ def main() -> None:
         # one-request-at-a-time vs all-at-once.  The delta is what
         # continuous batching itself buys.
         eng = ServingEngine(sparams, scfg, slots=slots, max_len=scfg.max_seq)
+        t0 = time.perf_counter()
         eng.start()
+        # Readiness gate: start() warms the whole bucket family in the
+        # scheduler thread; ready means the first request compiles
+        # nothing.  With the persistent cache primed by an earlier
+        # process this is a disk load, not a compile.
+        eng.wait_ready(timeout=600)
+        serving_ready_s = time.perf_counter() - t0
         try:
             for t in lengths:
                 eng.submit([1] * t, 2).wait(timeout=600)
@@ -590,6 +632,7 @@ def main() -> None:
             "offline_generate_tokens_per_s": round(total / offline_dt),
             "n_requests": n_req,
             "slots": slots,
+            "ready_s": round(serving_ready_s, 3),
         }
     except Exception:
         import sys
@@ -976,6 +1019,23 @@ def main() -> None:
                     else None
                 ),
                 "reported_mfu_ok": reported_mfu_ok,
+                "first_step_s_cold": (
+                    round(first_step_s_cold, 3)
+                    if first_step_s_cold is not None
+                    else None
+                ),
+                "first_step_s_warm": (
+                    round(first_step_s_warm, 3)
+                    if first_step_s_warm is not None
+                    else None
+                ),
+                "first_step_warm_ok": first_step_warm_ok,
+                "compile_cache_hits_warm": warm_cache_hits,
+                "serving_ready_s": (
+                    round(serving_ready_s, 3)
+                    if serving_ready_s is not None
+                    else None
+                ),
             }
         )
     )

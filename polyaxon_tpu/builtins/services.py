@@ -215,10 +215,15 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
                         "max_seq": cfg.max_seq,
                         "n_kv_heads": cfg.kv_heads,
                     },
+                    # "warming" until the start()-time warmup has
+                    # pre-compiled the whole bucket family; LBs should
+                    # gate traffic on state == "ready".
+                    "state": stats["state"],
                     "engine": {
                         "slots": stats["slots"],
                         "slots_active": stats["slots_active"],
                         "queue_depth": stats["queue_depth"],
+                        "warmup": stats["warmup"],
                     },
                     **meta,
                 },
@@ -301,7 +306,10 @@ def lm_server(ctx: Context) -> None:
     - ``POST /v1/cancel`` ``{"request_id": N}`` → ``{"cancelled": bool}``
       — release an in-flight or queued request's slot, KV blocks, and
       prefix-cache references immediately.
-    - ``GET /healthz`` → model/checkpoint metadata + engine occupancy.
+    - ``GET /healthz`` → model/checkpoint metadata + engine occupancy +
+      readiness ``state`` (``"warming"`` until the start()-time warmup
+      has pre-compiled the decode step and every prefill bucket,
+      ``"ready"`` after).
     - ``GET /v1/stats`` → queue depth, slot occupancy, tokens/s, block
       pool occupancy, prefix-cache hit rate, prefill backlog, latency
       percentiles (queue wait / TTFT / per-token decode).

@@ -60,6 +60,33 @@ def check_heartbeats(orch) -> Tuple[bool, str]:
     )
 
 
+def check_compile_cache(orch) -> Tuple[bool, str]:
+    """Persistent compile cache readiness: the per-layout cache dir must
+    be creatable and writable (workers of every gang root their cache
+    there).  Whether THIS process enabled it is diagnostic only — the
+    control plane never compiles; workers arm it at boot."""
+    from polyaxon_tpu.runtime.compilecache import cache_status
+
+    cache_dir = orch.layout.compile_cache_dir
+    try:
+        cache_dir.mkdir(parents=True, exist_ok=True)
+    except OSError as e:
+        return False, f"cache dir {cache_dir} not creatable: {e}"
+    if not os.access(cache_dir, os.W_OK):
+        return False, f"cache dir {cache_dir} not writable"
+    try:
+        entries = sum(1 for _ in cache_dir.iterdir())
+    except OSError:
+        entries = 0
+    st = cache_status()
+    local = (
+        f"enabled at {st.cache_dir}"
+        if st.enabled
+        else f"this process: {st.reason}"
+    )
+    return True, f"{entries} cached executable(s) at {cache_dir}; {local}"
+
+
 def check_devices(orch) -> Tuple[bool, str]:
     """Accelerator visibility — only meaningful in-process on a worker/bench
     host; the control plane itself may legitimately be CPU-only."""
@@ -78,6 +105,7 @@ CHECKS: Dict[str, Callable] = {
     "bus": check_bus,
     "stores": check_stores,
     "heartbeats": check_heartbeats,
+    "compile_cache": check_compile_cache,
 }
 
 

@@ -133,6 +133,8 @@ def goodput_status(
         "tokens_per_device_s": 0.0,
         "compile_s": 0.0,
         "compile_events": 0,
+        "compile_cache_hits": 0,
+        "compile_cache_misses": 0,
         "hbm_peak_bytes": 0.0,
         "devices": 0,
         "device_kind": "",
@@ -152,6 +154,16 @@ def goodput_status(
     out["steps"] = max(r["steps"] or 0 for r in per_proc)
     out["compile_s"] = sum(r["compile_s"] or 0.0 for r in per_proc)
     out["compile_events"] = sum(r["compile_events"] or 0 for r in per_proc)
+    # Cache hit/miss counts ride the attrs JSON (the registry folds
+    # unknown ledger-row keys there rather than growing the schema).
+    out["compile_cache_hits"] = sum(
+        int((r.get("attrs") or {}).get("compile_cache_hits") or 0)
+        for r in per_proc
+    )
+    out["compile_cache_misses"] = sum(
+        int((r.get("attrs") or {}).get("compile_cache_misses") or 0)
+        for r in per_proc
+    )
     out["hbm_peak_bytes"] = sum(r["hbm_peak_bytes"] or 0.0 for r in per_proc)
     out["devices"] = sum(r["devices"] or 0 for r in per_proc)
     out["device_kind"] = next(

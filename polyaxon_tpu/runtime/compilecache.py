@@ -1,0 +1,206 @@
+"""Persistent XLA compile cache: cold-start elimination for workers.
+
+The goodput ledger (PR 5) attributes ``xla_compile_s`` per run, and it
+shows every gang member, serving replica, and hpsearch trial paying the
+full XLA compile bill fresh — pure overhead, and for short trials the
+dominant cost.  This module wires JAX's persistent compilation cache
+into worker startup, rooted at a per-:class:`StoreLayout` shared
+directory (``<base_dir>/compile_cache``) so gang members and successive
+runs of the same store share compiled executables: a restarted run comes
+back warm.
+
+Knobs (all env, spawner-propagated like ``POLYAXON_TPU_DATA_DIR``):
+
+- ``POLYAXON_TPU_COMPILE_CACHE`` — ``0``/``false``/``off`` disables
+  (default on).
+- ``POLYAXON_TPU_COMPILE_CACHE_DIR`` — cache directory; the spawner
+  resolves it from the store layout, hand-launched workers derive it
+  from the run dir.
+- ``POLYAXON_TPU_COMPILE_CACHE_MIN_COMPILE_S`` — only persist compiles
+  that took at least this long (default 0: persist everything; the CPU
+  smoke configs compile in milliseconds and cross-process reuse is the
+  point).
+
+Same graceful-degradation contract as the ledger's ``jax.monitoring``
+hooks: on JAX versions/backends without the persistent-cache API,
+:func:`enable_compile_cache` returns a no-op status carrying the reason
+(surfaced by ``checks/health.py:check_compile_cache``) and never raises.
+Never imports jax itself when it isn't already loaded — the worker
+defers the jax import deliberately, so the pre-import path arms the
+cache through env vars that jax's config reads at import time.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple
+
+__all__ = [
+    "CacheStatus",
+    "enable_compile_cache",
+    "cache_status",
+    "aot_compile",
+]
+
+ENV_ENABLE = "POLYAXON_TPU_COMPILE_CACHE"
+ENV_DIR = "POLYAXON_TPU_COMPILE_CACHE_DIR"
+ENV_MIN_COMPILE_S = "POLYAXON_TPU_COMPILE_CACHE_MIN_COMPILE_S"
+
+
+@dataclass(frozen=True)
+class CacheStatus:
+    """Outcome of the most recent :func:`enable_compile_cache` attempt."""
+
+    enabled: bool
+    cache_dir: Optional[str]
+    reason: str
+    min_compile_s: float = 0.0
+
+
+_lock = threading.Lock()
+_status: Optional[CacheStatus] = None
+
+
+def _truthy(value: str) -> bool:
+    return value.strip().lower() not in ("0", "false", "off", "no", "")
+
+
+def enable_compile_cache(
+    cache_dir: Optional[str] = None,
+    *,
+    min_compile_s: Optional[float] = None,
+) -> CacheStatus:
+    """Enable JAX's persistent compilation cache for this process.
+
+    ``POLYAXON_TPU_COMPILE_CACHE_DIR`` wins over the ``cache_dir``
+    argument (callers pass their layout-derived fallback).  Idempotent:
+    re-enabling with the same directory returns the cached status.
+    Never raises — failures come back as a disabled status with the
+    reason.
+    """
+    global _status
+    with _lock:
+        if not _truthy(os.environ.get(ENV_ENABLE, "1")):
+            _status = CacheStatus(
+                False, None, f"disabled by {ENV_ENABLE}"
+            )
+            return _status
+        resolved = os.environ.get(ENV_DIR) or cache_dir
+        if not resolved:
+            _status = CacheStatus(
+                False,
+                None,
+                f"no cache dir (set {ENV_DIR} or pass cache_dir)",
+            )
+            return _status
+        resolved = str(resolved)
+        if (
+            _status is not None
+            and _status.enabled
+            and _status.cache_dir == resolved
+        ):
+            return _status
+        if min_compile_s is None:
+            try:
+                min_compile_s = float(os.environ.get(ENV_MIN_COMPILE_S, "0"))
+            except ValueError:
+                min_compile_s = 0.0
+        try:
+            os.makedirs(resolved, exist_ok=True)
+            if not os.access(resolved, os.W_OK):
+                raise OSError("not writable")
+        except OSError as e:
+            _status = CacheStatus(
+                False, resolved, f"cache dir {resolved} unusable: {e}"
+            )
+            return _status
+
+        # Arm through env first: jax reads these at import, so workers
+        # that haven't paid the jax import yet (the common boot path)
+        # get the cache for free on first use.  min_entry_size -1 means
+        # "persist regardless of size" — the compile-time threshold is
+        # the only gate we expose.
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = resolved
+        os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = str(
+            min_compile_s
+        )
+        os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "-1"
+
+        if "jax" in sys.modules:
+            # Already-imported jax ignores env: go through the config
+            # API, then reset the cache singleton — is_cache_used() and
+            # the backing LRUCache latch on first compile, so without
+            # the reset a process that compiled anything pre-enable
+            # would silently never read or write the cache.
+            try:
+                import jax
+                from jax._src import compilation_cache as _cc
+
+                jax.config.update("jax_compilation_cache_dir", resolved)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs",
+                    float(min_compile_s),
+                )
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", -1
+                )
+                _cc.reset_cache()
+            except Exception as e:
+                _status = CacheStatus(
+                    False,
+                    resolved,
+                    f"jax persistent-cache API unavailable: {e!r}",
+                    float(min_compile_s),
+                )
+                return _status
+            # Hit/miss counters ride the same monitoring channel as the
+            # ledger's compile-seconds attribution.
+            try:
+                from polyaxon_tpu.tracking.ledger import install_compile_hooks
+
+                install_compile_hooks()
+            except Exception:
+                pass
+            reason = "enabled (config API)"
+        else:
+            reason = "armed via env (jax not imported yet)"
+        _status = CacheStatus(True, resolved, reason, float(min_compile_s))
+        return _status
+
+
+def cache_status() -> CacheStatus:
+    """The last :func:`enable_compile_cache` outcome for this process
+    (a disabled placeholder when it was never called — e.g. the control
+    plane, which never compiles)."""
+    with _lock:
+        if _status is not None:
+            return _status
+        return CacheStatus(False, None, "not enabled in this process")
+
+
+def _reset_for_tests() -> None:
+    global _status
+    with _lock:
+        _status = None
+
+
+def aot_compile(jitted: Callable, *args: Any) -> Tuple[Callable, float]:
+    """AOT-compile a jitted fn: ``(executable, compile_seconds)``.
+
+    The returned executable must be *called directly* — ``lower().
+    compile()`` does not populate the jit dispatch cache, so calling the
+    original ``jitted`` afterwards would compile a second time.  Falls
+    back to ``(jitted, 0.0)`` wherever lowering is unavailable, so
+    callers can use the result unconditionally.  Donation declared on
+    the jit is preserved through the AOT path.
+    """
+    t0 = time.perf_counter()
+    try:
+        compiled = jitted.lower(*args).compile()
+    except Exception:
+        return jitted, 0.0
+    return compiled, time.perf_counter() - t0

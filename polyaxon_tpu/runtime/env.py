@@ -33,6 +33,9 @@ class EnvVars:
     HEARTBEAT_INTERVAL = "POLYAXON_TPU_HEARTBEAT_INTERVAL"
     SEED = "POLYAXON_TPU_SEED"
     DATA_DIR = "POLYAXON_TPU_DATA_DIR"
+    #: doubles as the runtime/compilecache.py knob — the spawner writing
+    #: it IS the enablement channel, no separate plumbing.
+    COMPILE_CACHE_DIR = "POLYAXON_TPU_COMPILE_CACHE_DIR"
 
 
 @dataclass
@@ -58,6 +61,9 @@ class GangInfo:
     #: The store layout's shared data/ dir (registered datasets); the
     #: spawner resolves it so workers never re-derive layout structure.
     data_dir: Optional[str] = None
+    #: The store layout's shared compile_cache/ dir (persistent XLA
+    #: compile cache); same spawner-resolved contract as data_dir.
+    compile_cache_dir: Optional[str] = None
 
     @classmethod
     def from_env(cls, env: Optional[Dict[str, str]] = None) -> "GangInfo":
@@ -80,6 +86,7 @@ class GangInfo:
             heartbeat_interval=float(e.get(EnvVars.HEARTBEAT_INTERVAL, "5.0")),
             seed=int(seed) if seed not in (None, "") else None,
             data_dir=e.get(EnvVars.DATA_DIR) or None,
+            compile_cache_dir=e.get(EnvVars.COMPILE_CACHE_DIR) or None,
         )
 
 
@@ -101,6 +108,7 @@ def gang_env(
     heartbeat_interval: float = 5.0,
     seed: Optional[int] = None,
     data_dir: Optional[str] = None,
+    compile_cache_dir: Optional[str] = None,
 ) -> Dict[str, str]:
     """Spawner-side encoder (inverse of ``GangInfo.from_env``)."""
     env = {
@@ -124,4 +132,6 @@ def gang_env(
         env[EnvVars.SEED] = str(seed)
     if data_dir:
         env[EnvVars.DATA_DIR] = data_dir
+    if compile_cache_dir:
+        env[EnvVars.COMPILE_CACHE_DIR] = compile_cache_dir
     return env

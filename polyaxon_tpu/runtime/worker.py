@@ -140,6 +140,16 @@ def main() -> int:
 
     try:
         _configure_jax_env(info)
+        # Persistent XLA compile cache: env-armed here (before any jax
+        # import) so gang members and warm restarts share executables.
+        # Spawner-resolved dir wins; hand-launched workers fall back to
+        # the layout-conventional path next to runs/.
+        from polyaxon_tpu.runtime.compilecache import enable_compile_cache
+
+        enable_compile_cache(
+            info.compile_cache_dir
+            or str(paths.root.parent.parent / "compile_cache")
+        )
 
         spec_data = json.loads(Path(info.spec_path).read_text())
         from polyaxon_tpu.schemas.specifications import specification_for_kind

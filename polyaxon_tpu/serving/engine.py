@@ -47,6 +47,7 @@ step — the block pool lives on the gang mesh.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 import time
@@ -181,6 +182,18 @@ class ServingEngine:
         GSPMD propagates the sharding through prefill and the step.
     eos_id : optional token id that retires a slot early.
     seed : RNG seed for the sampling path (greedy ignores it).
+    warmup : pre-compile the whole compiled-fn family (the decode step,
+        every prefill chunk bucket up to ``prefill_chunk``, the COW copy
+        fn) at the top of the scheduler loop before serving traffic, so
+        the first request never eats a compile.  ``wait_ready()`` blocks
+        on the gate; ``stats()['state']`` reports ``warming|ready``.
+        With the persistent compile cache armed
+        (``runtime/compilecache.py``) a restarted replica warms from
+        disk instead of compiling cold.  ``False`` skips straight to
+        ready — compiles then happen lazily mid-traffic and show up on
+        the ``serving.steady_state_compiles`` counter.  Default (None)
+        reads ``POLYAXON_TPU_SERVING_WARMUP`` (on unless ``0``/``false``
+        /``off``).
     stats : a stats backend receiving latency histograms
         (``serving.queue_wait_s`` / ``serving.ttft_s`` /
         ``serving.decode_step_s`` / ``serving.batch_occupancy``) and
@@ -218,6 +231,7 @@ class ServingEngine:
         eos_id: Optional[int] = None,
         seed: int = 0,
         stats: Optional[Any] = None,
+        warmup: Optional[bool] = None,
     ) -> None:
         import jax
 
@@ -285,6 +299,24 @@ class ServingEngine:
         self._cv = threading.Condition()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+        # Warmup / readiness gate: the scheduler thread compiles the fn
+        # family before its first iteration; requests submitted while
+        # warming just queue.  The steady-state compile counter watches
+        # total jit cache size growth after ready — the "zero
+        # steady-state recompiles" invariant, monitored in production
+        # rather than only asserted in tests.
+        if warmup is None:
+            warmup = os.environ.get(
+                "POLYAXON_TPU_SERVING_WARMUP", "1"
+            ).strip().lower() not in ("0", "false", "off", "no", "")
+        self._warmup = bool(warmup)
+        self._ready = threading.Event()
+        self._warmup_total = 0
+        self._warmup_done = 0
+        self._warmup_s = 0.0
+        self._n_steady_compiles = 0
+        self._compiled_baseline: Optional[int] = None
 
         self._key = jax.random.PRNGKey(seed)
         self._rng = np.random.default_rng(seed)
@@ -383,7 +415,141 @@ class ServingEngine:
             )
         return self._copy_fn
 
+    def _compiled_count(self) -> int:
+        """Total compiled entries across the engine's jitted fns (0 when
+        the jax version exposes no ``_cache_size``)."""
+        fns = [self._step_fn, *self._chunk_fns.values()]
+        if self._copy_fn is not None:
+            fns.append(self._copy_fn)
+        n = 0
+        for fn in fns:
+            try:
+                n += int(fn._cache_size())
+            except Exception:
+                pass
+        return n
+
+    def _warmup_buckets(self) -> List[int]:
+        """The chunk-bucket family live traffic can mint: every
+        ``_bucket`` value for chunk lengths up to ``prefill_chunk`` (the
+        whole prompt when unchunked), capped at ``max_len``."""
+        cap = min(self.prefill_chunk or self.max_len, self.max_len)
+        out = set()
+        b = 8
+        while True:
+            out.add(min(b, self.max_len))
+            if b >= cap:
+                break
+            b *= 2
+        return sorted(out)
+
+    def _run_warmup(self) -> None:
+        """Compile the whole fn family before serving traffic (scheduler
+        thread, before its first iteration — it owns the pool, so there
+        is no device race with live requests, which queue meanwhile).
+
+        Every call EXECUTES its fn — ``lower().compile()`` would not
+        populate the jit dispatch cache — with arguments whose writes
+        all land in the reserved trash block 0: the decode step with an
+        all-inactive mask, each chunk bucket with ``length=0``, and the
+        COW copy as a trash self-copy.  Failures degrade to lazy
+        compiles (counted by the steady-state monitor) rather than
+        killing the engine; the readiness gate opens regardless.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        tracer = get_tracer()
+        t0 = time.perf_counter()
+        buckets = self._warmup_buckets() if self._warmup else []
+        self._warmup_total = len(buckets) + 2 if self._warmup else 0
+        gauge = getattr(self.stats_registry, "gauge", None)
+
+        def _tick() -> None:
+            self._warmup_done += 1
+            if gauge is not None and self._warmup_total:
+                gauge(
+                    "serving.warmup_progress",
+                    self._warmup_done / self._warmup_total,
+                )
+
+        try:
+            if self._warmup:
+                with tracer.span("serving:warmup", buckets=len(buckets)):
+                    self._key, sub = jax.random.split(self._key)
+                    tables = np.where(
+                        self._tables >= 0, self._tables, 0
+                    ).astype(np.int32)
+                    toks, self._pool = self._step_fn(
+                        self._params,
+                        self._pool,
+                        jnp.asarray(tables),
+                        jnp.asarray(self._tok),
+                        jnp.asarray(self._pos),
+                        jnp.asarray(self._active),
+                        jnp.asarray(self._temps),
+                        sub,
+                        self._qweights,
+                    )
+                    jax.block_until_ready(toks)
+                    _tick()
+                    table0 = jnp.zeros(self._table_width, jnp.int32)
+                    for c_pad in buckets:
+                        if self._stop.is_set():
+                            break
+                        logits, self._pool = self._get_chunk(c_pad)(
+                            self._params,
+                            self._pool,
+                            table0,
+                            jnp.zeros(c_pad, jnp.int32),
+                            jnp.int32(0),
+                            jnp.int32(0),
+                        )
+                        jax.block_until_ready(logits)
+                        _tick()
+                    self._pool = self._get_copy()(
+                        self._pool, jnp.int32(0), jnp.int32(0)
+                    )
+                    jax.block_until_ready(self._pool)
+                    _tick()
+        except Exception:
+            pass
+        finally:
+            self._warmup_s = time.perf_counter() - t0
+            self._compiled_baseline = self._compiled_count()
+            self._ready.set()
+            if gauge is not None:
+                gauge("serving.warmup_progress", 1.0)
+
+    def _check_steady_compiles(self) -> None:
+        """Post-ready jit cache growth = a steady-state compile stalled
+        the batch (a config edge bucket, a changed donation layout):
+        record an ``engine.compile`` span + counter so the invariant is
+        observable, not just asserted in tests."""
+        if self._compiled_baseline is None:
+            return
+        n = self._compiled_count()
+        grew = n - self._compiled_baseline
+        if grew <= 0:
+            return
+        self._compiled_baseline = n
+        with self._stats_lock:
+            self._n_steady_compiles += grew
+        incr = getattr(self.stats_registry, "incr", None)
+        if incr is not None:
+            try:
+                incr("serving.steady_state_compiles", grew)
+            except Exception:
+                pass
+        with get_tracer().span("engine.compile", n=grew, total=n):
+            pass
+
     # -- public API ------------------------------------------------------------
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until the warmup pass has run (or was skipped/failed);
+        True when the engine is ready to serve without compiling."""
+        return self._ready.wait(timeout)
 
     def start(self) -> "ServingEngine":
         if self._thread is None:
@@ -586,6 +752,13 @@ class ServingEngine:
             )
             tps = window_tokens / window_span if window_span > 0 else 0.0
             return {
+                "state": "ready" if self._ready.is_set() else "warming",
+                "warmup": {
+                    "done": self._warmup_done,
+                    "total": self._warmup_total,
+                    "ready_s": round(self._warmup_s, 6),
+                },
+                "steady_state_compiles": self._n_steady_compiles,
                 "slots": self.slots,
                 "slots_active": self.allocator.n_active,
                 "queue_depth": len(self._queue),
@@ -620,6 +793,7 @@ class ServingEngine:
 
     def _loop(self) -> None:
         tracer = get_tracer()
+        self._run_warmup()
         while not self._stop.is_set():
             self._process_cancels()
             self._admit()
@@ -941,6 +1115,7 @@ class ServingEngine:
 
     def _record_gauges(self) -> None:
         """Refresh paging gauges + backlog counters (scheduler thread)."""
+        self._check_steady_compiles()
         backlog = 0
         for job in self._prefill:
             remaining = len(job.req.prompt) - job.next_pos

@@ -176,6 +176,7 @@ class GangSpawner:
                 heartbeat_interval=self.heartbeat_interval,
                 seed=run.spec.environment.seed,
                 data_dir=str(self.layout.data_dir),
+                compile_cache_dir=str(self.layout.compile_cache_dir),
             )
         )
         return env

@@ -76,6 +76,13 @@ class StoreLayout:
     def data_dir(self) -> Path:
         return self.base_dir / "data"
 
+    @property
+    def compile_cache_dir(self) -> Path:
+        """Shared persistent XLA compile cache: gang members and
+        successive runs of the same store reuse compiled executables
+        (see ``runtime/compilecache.py``)."""
+        return self.base_dir / "compile_cache"
+
     def run_paths(self, run_uuid: str) -> RunPaths:
         return RunPaths(self.runs_dir / run_uuid)
 

@@ -35,7 +35,9 @@ __all__ = [
     "configure",
     "install_compile_hooks",
     "compile_telemetry",
+    "compile_cache_telemetry",
     "compiled_flops",
+    "executable_flops",
     "transformer_flops_per_token",
     "conv_classifier_flops_per_image",
     "BUCKETS",
@@ -76,6 +78,8 @@ _UNSET = object()
 _compile_lock = threading.Lock()
 _compile_seconds = 0.0
 _compile_events = 0
+_cache_hits = 0
+_cache_misses = 0
 _hooks_installed: Optional[bool] = None  # None = not yet attempted
 
 
@@ -104,8 +108,19 @@ def install_compile_hooks() -> bool:
                     _compile_seconds += float(duration)
 
         def _on_event(event: str, **kw: Any) -> None:
-            if "compile_requests" in event or "cache_miss" in event:
-                global _compile_events
+            # With the persistent cache armed (runtime/compilecache.py)
+            # a cold compile fires BOTH compile_requests and cache_miss;
+            # counting either-or (the pre-cache behaviour) would double
+            # count, so requests carry compile_events and hit/miss feed
+            # their own counters.
+            global _compile_events, _cache_hits, _cache_misses
+            if "cache_hit" in event:
+                with _compile_lock:
+                    _cache_hits += 1
+            elif "cache_miss" in event:
+                with _compile_lock:
+                    _cache_misses += 1
+            elif "compile_requests" in event:
                 with _compile_lock:
                     _compile_events += 1
 
@@ -123,7 +138,33 @@ def compile_telemetry() -> Tuple[float, int]:
         return _compile_seconds, _compile_events
 
 
+def compile_cache_telemetry() -> Tuple[int, int]:
+    """(persistent-cache hits, misses) so far — both stay 0 when the
+    cache is disabled or the jax version emits no cache events."""
+    with _compile_lock:
+        return _cache_hits, _cache_misses
+
+
 # -- FLOPs accounting ----------------------------------------------------------
+
+def executable_flops(compiled: Any) -> Optional[float]:
+    """Total FLOPs from an ALREADY-COMPILED executable's cost analysis.
+
+    The free probe: callers that AOT-compiled their step anyway
+    (``runtime/compilecache.aot_compile``) get the number without paying
+    a second compile.  Returns None when the object has no analysis
+    (e.g. it is still a plain jitted fn because AOT fell back)."""
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        flops = analysis.get("flops") if hasattr(analysis, "get") else None
+        if flops is not None and float(flops) > 0:
+            return float(flops)
+    except Exception:
+        pass
+    return None
+
 
 def compiled_flops(jitted: Callable, *args: Any) -> Optional[float]:
     """Total FLOPs of one compiled call, from XLA's cost analysis.
@@ -135,15 +176,9 @@ def compiled_flops(jitted: Callable, *args: Any) -> Optional[float]:
     estimates below).
     """
     try:
-        analysis = jitted.lower(*args).compile().cost_analysis()
-        if isinstance(analysis, (list, tuple)):
-            analysis = analysis[0] if analysis else {}
-        flops = analysis.get("flops") if hasattr(analysis, "get") else None
-        if flops is not None and float(flops) > 0:
-            return float(flops)
+        return executable_flops(jitted.lower(*args).compile())
     except Exception:
-        pass
-    return None
+        return None
 
 
 def transformer_flops_per_token(
@@ -229,6 +264,7 @@ class UtilizationLedger:
         self._seq = 0
         self._last_flush = 0.0
         self._compile0: Tuple[float, int] = (0.0, 0)
+        self._cache0: Tuple[int, int] = (0, 0)
         self._compile_preloop: Optional[float] = None
 
     def reset(self) -> None:
@@ -271,6 +307,7 @@ class UtilizationLedger:
             self._p0 = time.perf_counter()
             self._last_flush = self._p0
             self._compile0 = compile_telemetry()
+            self._cache0 = compile_cache_telemetry()
         if "jax" in sys.modules:
             try:
                 import jax
@@ -372,6 +409,7 @@ class UtilizationLedger:
         to ``wall_s``), goodput ratio, MFU, throughput, compile and HBM
         telemetry."""
         compile_now, events_now = compile_telemetry()
+        hits_now, misses_now = compile_cache_telemetry()
         with self._lock:
             wall = time.perf_counter() - self._p0 if self.armed else 0.0
             hooks_compile = max(0.0, compile_now - self._compile0[0])
@@ -426,6 +464,11 @@ class UtilizationLedger:
                 "tokens_per_device_s": tpds,
                 "compile_s": compile_s,
                 "compile_events": compile_events,
+                # Persistent-cache efficacy: how much of compile_s was a
+                # disk read vs a cold XLA compile (registry folds these
+                # into row attrs — no schema change).
+                "compile_cache_hits": max(0, hits_now - self._cache0[0]),
+                "compile_cache_misses": max(0, misses_now - self._cache0[1]),
                 "hbm_peak_bytes": self._hbm_peak_bytes,
                 "devices": self.devices,
                 "device_kind": self.device_kind,

@@ -1,0 +1,216 @@
+"""Persistent compile cache: arming, graceful no-op, and actual reuse.
+
+The contract under test (runtime/compilecache.py): enabling is
+idempotent and never raises; a process that compiled before enabling
+still reads/writes the cache (the reset_cache() fix); identical
+programs hit — in the same process and, the point of the feature,
+across processes sharing a StoreLayout's ``compile_cache`` dir.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from polyaxon_tpu.runtime import compilecache as cc
+from polyaxon_tpu.stores.layout import StoreLayout
+
+_JAX_ENV = (
+    "JAX_COMPILATION_CACHE_DIR",
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+    "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES",
+)
+
+
+@pytest.fixture()
+def cache_env(monkeypatch):
+    """Snapshot/restore everything enable_compile_cache mutates: module
+    status, the knob env vars, jax's env mirror, jax config, and the
+    cache singleton — so the suite's other tests never see an armed
+    cache."""
+    import jax
+    from jax._src import compilation_cache as jcc
+
+    for var in (cc.ENV_ENABLE, cc.ENV_DIR, cc.ENV_MIN_COMPILE_S):
+        monkeypatch.delenv(var, raising=False)
+    saved_env = {k: os.environ.get(k) for k in _JAX_ENV}
+    saved_cfg = (
+        jax.config.jax_compilation_cache_dir,
+        jax.config.jax_persistent_cache_min_compile_time_secs,
+        jax.config.jax_persistent_cache_min_entry_size_bytes,
+    )
+    cc._reset_for_tests()
+    yield cc
+    cc._reset_for_tests()
+    for k, v in saved_env.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    jax.config.update("jax_compilation_cache_dir", saved_cfg[0])
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", saved_cfg[1]
+    )
+    jax.config.update(
+        "jax_persistent_cache_min_entry_size_bytes", saved_cfg[2]
+    )
+    jcc.reset_cache()
+
+
+class TestEnable:
+    def test_knob_off_disables(self, cache_env, monkeypatch, tmp_path):
+        monkeypatch.setenv(cc.ENV_ENABLE, "0")
+        st = cc.enable_compile_cache(str(tmp_path / "cc"))
+        assert not st.enabled
+        assert cc.ENV_ENABLE in st.reason
+        assert os.environ.get("JAX_COMPILATION_CACHE_DIR") is None
+
+    def test_no_dir_disables(self, cache_env):
+        st = cc.enable_compile_cache()
+        assert not st.enabled
+        assert "no cache dir" in st.reason
+
+    def test_env_dir_wins_over_argument(self, cache_env, monkeypatch, tmp_path):
+        env_dir = tmp_path / "from_env"
+        monkeypatch.setenv(cc.ENV_DIR, str(env_dir))
+        st = cc.enable_compile_cache(str(tmp_path / "from_arg"))
+        assert st.enabled
+        assert st.cache_dir == str(env_dir)
+        assert env_dir.is_dir()
+
+    def test_enabled_and_idempotent(self, cache_env, tmp_path):
+        d = str(tmp_path / "cc")
+        st = cc.enable_compile_cache(d)
+        assert st.enabled and st.cache_dir == d
+        assert os.environ["JAX_COMPILATION_CACHE_DIR"] == d
+        # min_entry_size -1: persist regardless of executable size (the
+        # CPU smoke configs compile tiny modules).
+        assert os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] == "-1"
+        assert cc.enable_compile_cache(d) is st  # cached status
+        assert cc.cache_status() is st
+
+    def test_unwritable_dir_is_noop_not_raise(self, cache_env, tmp_path):
+        blocked = tmp_path / "file_not_dir"
+        blocked.write_text("occupied")
+        st = cc.enable_compile_cache(str(blocked / "cc"))
+        assert not st.enabled
+        assert "unusable" in st.reason
+
+    def test_missing_jax_api_is_noop_not_raise(self, cache_env, tmp_path):
+        """Older-JAX degradation: config API failures come back as a
+        disabled status with the reason, never an exception."""
+        import jax
+
+        def boom(*a, **k):
+            raise AttributeError("no persistent cache here")
+
+        # Patch scoped INSIDE the test: cache_env's teardown needs the
+        # real jax.config.update to restore state.
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setattr(jax.config, "update", boom)
+            st = cc.enable_compile_cache(str(tmp_path / "cc"))
+        assert not st.enabled
+        assert "unavailable" in st.reason
+
+    def test_status_placeholder_when_never_enabled(self, cache_env):
+        st = cc.cache_status()
+        assert not st.enabled
+        assert "not enabled" in st.reason
+
+
+def test_layout_compile_cache_dir(tmp_path):
+    """One cache per StoreLayout, shared by every gang of that store."""
+    layout = StoreLayout(tmp_path / "stores")
+    assert layout.compile_cache_dir == tmp_path / "stores" / "compile_cache"
+
+
+class TestReuse:
+    def test_in_process_hit_after_reset(self, cache_env, tmp_path):
+        """Arm AFTER this process already compiled plenty (the whole
+        test session) — reset_cache() must still make writes and reads
+        work: first compile of a novel program misses (entry written),
+        an identical fresh jit hits."""
+        import jax
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.tracking.ledger import compile_cache_telemetry
+
+        d = tmp_path / "cc"
+        assert cc.enable_compile_cache(str(d)).enabled
+        h0, m0 = compile_cache_telemetry()
+        jax.jit(lambda x: (x * 3.0 - 1.0).sum())(jnp.arange(11.0))
+        h1, m1 = compile_cache_telemetry()
+        assert m1 > m0, "cold compile should write a cache entry"
+        assert any(d.iterdir()), "cache dir should hold the entry"
+        # A DIFFERENT function object, identical program → same XLA
+        # module → persistent-cache read, not a recompile.
+        jax.jit(lambda x: (x * 3.0 - 1.0).sum())(jnp.arange(11.0))
+        h2, _ = compile_cache_telemetry()
+        assert h2 > h1, "identical program should hit the cache"
+
+    def test_aot_compile_returns_executable(self, cache_env, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        cc.enable_compile_cache(str(tmp_path / "cc"))
+        jitted = jax.jit(lambda x: x * 2.0 + 0.5)
+        x = jnp.arange(5.0)
+        fn, secs = cc.aot_compile(jitted, x)
+        assert fn is not jitted and secs > 0
+        np.testing.assert_allclose(np.asarray(fn(x)), np.asarray(x) * 2.0 + 0.5)
+
+    def test_aot_compile_falls_back_on_plain_fn(self, cache_env):
+        def plain(x):
+            return x + 1
+
+        fn, secs = cc.aot_compile(plain, 1)
+        assert fn is plain and secs == 0.0
+        assert fn(1) == 2
+
+
+_CHILD = textwrap.dedent(
+    """
+    import sys
+    import jax, jax.numpy as jnp
+    from polyaxon_tpu.runtime.compilecache import enable_compile_cache
+    from polyaxon_tpu.tracking.ledger import (
+        compile_cache_telemetry, install_compile_hooks,
+    )
+    st = enable_compile_cache(sys.argv[1])
+    assert st.enabled, st
+    install_compile_hooks()
+    out = jax.jit(lambda x: (x @ x.T).sum() * 0.25)(
+        jnp.arange(64.0).reshape(8, 8)
+    )
+    jax.block_until_ready(out)
+    hits, misses = compile_cache_telemetry()
+    print(f"HITS={hits} MISSES={misses}")
+    """
+)
+
+
+@pytest.mark.slow
+def test_cross_process_reuse(tmp_path):
+    """The feature's reason to exist: a SECOND process compiling the
+    same program loads it from the shared dir instead of compiling."""
+    d = str(tmp_path / "cc")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+
+    def run():
+        p = subprocess.run(
+            [sys.executable, "-c", _CHILD, d],
+            capture_output=True, text=True, timeout=240, env=env,
+        )
+        assert p.returncode == 0, p.stderr
+        line = [l for l in p.stdout.splitlines() if l.startswith("HITS=")][-1]
+        hits, misses = (int(part.split("=")[1]) for part in line.split())
+        return hits, misses
+
+    hits1, misses1 = run()
+    assert misses1 > 0 and hits1 == 0, (hits1, misses1)
+    hits2, misses2 = run()
+    assert hits2 > 0 and misses2 == 0, (hits2, misses2)

@@ -1,0 +1,16 @@
+"""Serving-test defaults.
+
+Engine warmup (pre-compiling the decode step + every chunk bucket +
+the COW copy fn at start()) is production behavior, but it would add
+seconds of compile time to every engine fixture in this tree — compile
+cost the tests already pay lazily for exactly the fns they use.  Turn
+the env default off here; warmup coverage lives in test_warmup.py,
+which opts in explicitly with ``warmup=True``.
+"""
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _no_engine_warmup(monkeypatch):
+    monkeypatch.setenv("POLYAXON_TPU_SERVING_WARMUP", "0")

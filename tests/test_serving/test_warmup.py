@@ -1,0 +1,121 @@
+"""Start()-time warmup: the readiness gate and its zero-compile promise.
+
+Contract (serving/engine.py): ``start()`` pre-executes the decode step,
+every prefill chunk bucket, and the COW copy fn in the scheduler thread;
+``stats()["state"]`` is ``"warming"`` until that finishes and
+``"ready"`` after, and the FIRST request served after ``ready`` performs
+no compilation at all.  The tree-wide conftest turns warmup off for the
+other serving tests — everything here opts back in with ``warmup=True``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models import TransformerConfig, decode, init_params
+from polyaxon_tpu.serving import ServingEngine
+
+CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    head_dim=8,
+    d_ff=64,
+    max_seq=48,
+    dtype=jnp.float32,
+)
+KEY = jax.random.PRNGKey(0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(KEY, CFG)
+
+
+@pytest.fixture(scope="module")
+def warm_engine(params):
+    # Module-scoped: the warmup costs seconds, and the tests below only
+    # ever ASSERT nothing compiles after it — safe to share.
+    eng = ServingEngine(params, CFG, slots=2, max_len=48, warmup=True).start()
+    assert eng.wait_ready(timeout=300), "warmup never finished"
+    yield eng
+    eng.stop()
+
+
+def test_env_knob_resolves_default(params, monkeypatch):
+    """The conftest env opt-out reaches the ctor default; an explicit
+    warmup= argument always wins over the env."""
+    assert ServingEngine(params, CFG, slots=2, max_len=48)._warmup is False
+    monkeypatch.setenv("POLYAXON_TPU_SERVING_WARMUP", "1")
+    assert ServingEngine(params, CFG, slots=2, max_len=48)._warmup is True
+    monkeypatch.setenv("POLYAXON_TPU_SERVING_WARMUP", "0")
+    assert (
+        ServingEngine(params, CFG, slots=2, max_len=48, warmup=True)._warmup
+        is True
+    )
+
+
+def test_warming_until_warmup_completes(params):
+    eng = ServingEngine(params, CFG, slots=2, max_len=48, warmup=True)
+    # Not started: the gate is closed and stats say so.
+    assert eng.stats()["state"] == "warming"
+    assert eng.wait_ready(timeout=0.05) is False
+    eng.start()
+    try:
+        assert eng.wait_ready(timeout=300)
+        st = eng.stats()
+        assert st["state"] == "ready"
+        assert st["warmup"]["total"] > 0
+        assert st["warmup"]["done"] == st["warmup"]["total"]
+        assert st["warmup"]["ready_s"] > 0
+    finally:
+        eng.stop()
+
+
+def test_first_request_after_ready_compiles_nothing(params, warm_engine):
+    """The acceptance bar: ready means READY — the first real request
+    adds zero entries to any jit cache and the steady-state compile
+    counter stays at zero."""
+    baseline = warm_engine._compiled_count()
+    assert baseline > 0  # warmup actually compiled the family
+    rng = np.random.default_rng(7)
+    prompt = list(rng.integers(0, CFG.vocab_size, 9))
+    out = warm_engine.submit(prompt, 6).wait(timeout=120)
+    ref = decode.generate(
+        params, jnp.asarray([prompt]), CFG, max_new_tokens=6
+    )
+    assert out == np.asarray(ref)[0].tolist()
+    assert warm_engine._compiled_count() == baseline
+    assert warm_engine.stats()["steady_state_compiles"] == 0
+
+
+def test_mixed_lengths_after_ready_compile_nothing(params, warm_engine):
+    """Every chunk bucket was warmed, so prompts landing in different
+    pad buckets still add no compiles."""
+    baseline = warm_engine._compiled_count()
+    rng = np.random.default_rng(8)
+    reqs = [
+        warm_engine.submit(list(rng.integers(0, CFG.vocab_size, t)), mn)
+        for t, mn in [(3, 4), (17, 2), (30, 3)]
+    ]
+    [r.wait(timeout=120) for r in reqs]
+    assert warm_engine._compiled_count() == baseline
+    assert warm_engine.stats()["steady_state_compiles"] == 0
+
+
+def test_no_warmup_counts_lazy_compiles(params):
+    """warmup=False keeps the old lazy behavior but MONITORS it: the
+    gate opens immediately and the first request's compiles land on the
+    steady-state counter (the alert signal warmup exists to keep at 0)."""
+    eng = ServingEngine(params, CFG, slots=2, max_len=48, warmup=False).start()
+    try:
+        assert eng.wait_ready(timeout=30)
+        st = eng.stats()
+        assert st["state"] == "ready"
+        assert st["warmup"]["total"] == 0
+        eng.submit([1, 2, 3], 4).wait(timeout=120)
+        assert eng.stats()["steady_state_compiles"] > 0
+    finally:
+        eng.stop()
