@@ -408,6 +408,130 @@ def main() -> None:
 
         traceback.print_exc(file=sys.stderr)
 
+    # On-demand profiling round trip, measured through the REAL path:
+    # request_profile → command file in the worker mailbox → heartbeat
+    # poll → windowed jax trace in the live train loop → capture report
+    # line → watcher ingest → COMPLETE command row.  The budget covers
+    # one heartbeat of delivery latency, the 3-step window, and ingest
+    # slack.  Alongside it, the idle cost of the bus itself: a mailbox
+    # poll with nothing queued must be microseconds — it rides every
+    # worker heartbeat forever.
+    profile_roundtrip_s = None
+    profile_roundtrip_ok = None
+    idle_bus_poll_us = None
+    idle_bus_overhead_ok = None
+    try:
+        import sys
+        import tempfile
+
+        from polyaxon_tpu.db.registry import CommandStatus
+        from polyaxon_tpu.orchestrator import Orchestrator
+        from polyaxon_tpu.tracking.capture import CaptureAgent
+
+        # Idle-bus overhead first (no gang needed): poll an empty mailbox
+        # the way the Reporter heartbeat does.
+        import pathlib
+
+        idle_dir = pathlib.Path(tempfile.mkdtemp()) / "proc0"
+        idle_dir.mkdir(parents=True)
+        idle_agent = CaptureAgent().configure(
+            reporter=None, mailbox=idle_dir, profiles_root=None, process_id=0
+        )
+        n_polls = 2000
+        t0 = time.perf_counter()
+        for _ in range(n_polls):
+            idle_agent.poll()
+        idle_bus_poll_us = (time.perf_counter() - t0) / n_polls * 1e6
+        idle_bus_overhead_ok = idle_bus_poll_us < 500.0
+        if not idle_bus_overhead_ok:
+            print(
+                f"bench: idle_bus_poll_us={idle_bus_poll_us:.1f} over the "
+                "500us budget — the command mailbox is taxing every "
+                "worker heartbeat",
+                file=sys.stderr,
+            )
+
+        orch = Orchestrator(
+            tempfile.mkdtemp(), monitor_interval=0.05, heartbeat_interval=0.2
+        )
+        try:
+            run = orch.submit(
+                {
+                    "kind": "experiment",
+                    "run": {
+                        "entrypoint": "polyaxon_tpu.builtins.trainers:lm_train"
+                    },
+                    "declarations": {
+                        "steps": 4000,
+                        "batch": 4,
+                        "seq": 64,
+                        "vocab_size": 256,
+                        "d_model": 64,
+                        "n_layers": 2,
+                        "n_heads": 4,
+                        "head_dim": 16,
+                        "d_ff": 128,
+                    },
+                    "environment": {
+                        "topology": {
+                            "accelerator": "cpu-1",
+                            "num_devices": 1,
+                            "num_hosts": 1,
+                        }
+                    },
+                }
+            )
+            deadline = time.time() + 240
+            stepping = False
+            while time.time() < deadline:
+                orch.pump(0.05)
+                r = orch.registry.get_run(run.id)
+                if r.is_done:
+                    break
+                prog = orch.registry.get_progress(run.id)
+                if r.status == "running" and prog and prog[0]["step"] >= 1:
+                    stepping = True
+                    break
+            if stepping:
+                t0 = time.perf_counter()
+                cmd = orch.request_profile(run.id, num_steps=3)
+                deadline = time.time() + 60
+                while time.time() < deadline:
+                    orch.pump(0.05)
+                    row = orch.registry.get_command(cmd["uuid"])
+                    if row["status"] in CommandStatus.TERMINAL:
+                        break
+                if row["status"] == CommandStatus.COMPLETE:
+                    caps = orch.registry.get_captures(
+                        run.id, capture_id=cmd["capture_id"]
+                    )
+                    if caps and caps[0]["artifacts"]:
+                        profile_roundtrip_s = time.perf_counter() - t0
+                orch.stop_run(run.id)
+                orch.wait(run.id, timeout=120)
+        finally:
+            orch.stop()
+        if profile_roundtrip_s is not None:
+            # heartbeat delivery (0.2s) + 3-step window + ingest slack.
+            profile_roundtrip_ok = 0.0 < profile_roundtrip_s < 10.0
+            if not profile_roundtrip_ok:
+                print(
+                    f"bench: profile_roundtrip_s={profile_roundtrip_s:.2f} "
+                    "over the 10s budget — on-demand capture is too slow "
+                    "to be an incident tool",
+                    file=sys.stderr,
+                )
+        else:
+            print(
+                "bench: profile round trip produced no completed capture",
+                file=sys.stderr,
+            )
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+
     # Ledger ground-truth check: run an lm_train smoke gang through the
     # REAL platform path (worker ledger → report line → watcher ingest →
     # goodput roll-up) and compare the platform's MFU against this
@@ -1013,6 +1137,18 @@ def main() -> None:
                     else None
                 ),
                 "stall_detect_ok": stall_detect_ok,
+                "profile_roundtrip_s": (
+                    round(profile_roundtrip_s, 2)
+                    if profile_roundtrip_s is not None
+                    else None
+                ),
+                "profile_roundtrip_ok": profile_roundtrip_ok,
+                "idle_bus_poll_us": (
+                    round(idle_bus_poll_us, 1)
+                    if idle_bus_poll_us is not None
+                    else None
+                ),
+                "idle_bus_overhead_ok": idle_bus_overhead_ok,
                 "reported_mfu_abs_err": (
                     round(reported_mfu_abs_err, 5)
                     if reported_mfu_abs_err is not None

@@ -462,6 +462,115 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             status.update(stalled=False, stall_age_s=0.0, stragglers=[])
         return web.json_response({"results": rows, "status": status})
 
+    # -- on-demand device profiling (run command bus) -------------------------
+    @routes.post(f"{API_PREFIX}/runs/{{run_id}}/profile")
+    async def post_profile(request):
+        # Trigger a gang-wide windowed capture (xplane + device memory +
+        # HLO) over the command bus.  A finished run answers immediately
+        # with a typed EXPIRED command row — never a hang.
+        run = _run_or_404(request)
+        body = await request.json() if request.can_read_body else {}
+        num_steps = body.get("num_steps")
+        duration_s = body.get("duration_s")
+        processes = body.get("processes")
+        if processes is not None and (
+            not isinstance(processes, list)
+            or not all(isinstance(p, int) for p in processes)
+        ):
+            return web.json_response(
+                {"error": "'processes' must be a list of gang process ids"},
+                status=400,
+            )
+        try:
+            cmd = await asyncio.to_thread(
+                orch.request_profile,
+                run.id,
+                num_steps=int(num_steps) if num_steps is not None else None,
+                duration_s=float(duration_s) if duration_s is not None else None,
+                processes=processes,
+                actor=request.get("actor"),
+            )
+        except (TypeError, ValueError) as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response(cmd, status=202)
+
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/profiles")
+    async def list_profiles(request):
+        # Capture index: every profile command (bus lifecycle rollup) plus
+        # the per-host capture rows the watcher ingested so far.
+        run = _run_or_404(request)
+        commands = reg.get_commands(
+            run.id,
+            kind="profile",
+            since_id=_int_param(request, "since_id", 0),
+            limit=_int_param(request, "limit"),
+        )
+        captures = reg.get_captures(run.id)
+        by_capture: Dict[str, list] = {}
+        for row in captures:
+            by_capture.setdefault(row["capture_id"], []).append(row)
+        results = []
+        for cmd in commands:
+            results.append(
+                {
+                    **cmd,
+                    "capture_id": cmd["uuid"],
+                    "captures": by_capture.get(cmd["uuid"], []),
+                }
+            )
+        return web.json_response({"results": results})
+
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/profiles/{{capture_id}}")
+    async def get_profile(request):
+        # Per-capture manifest: bus command state, per-host capture rows
+        # (status + artifact keys fetchable via the artifacts API), and a
+        # merged chrome-trace of the gang's span ring over the capture
+        # window (?format=chrome for the raw trace document).
+        run = _run_or_404(request)
+        capture_id = request.match_info["capture_id"]
+        cmd = reg.get_command(capture_id)
+        if cmd is None or cmd["run_id"] != run.id or cmd["kind"] != "profile":
+            raise _json_error(web.HTTPNotFound, "no such capture")
+        captures = reg.get_captures(run.id, capture_id=capture_id)
+        window_start = min(
+            (c["started_at"] for c in captures if c.get("started_at")),
+            default=None,
+        )
+        window_end = max(
+            (c["finished_at"] for c in captures if c.get("finished_at")),
+            default=None,
+        )
+        trace = None
+        if window_start is not None:
+            end = window_end if window_end is not None else float("inf")
+            spans = [
+                s
+                for s in reg.get_spans(run.id)
+                if s["start"] < end
+                and s["start"] + (s.get("duration") or 0.0) >= window_start
+            ]
+            trace = chrome_trace(spans)
+        fmt = request.rel_url.query.get("format", "manifest")
+        if fmt == "chrome":
+            if trace is None:
+                return web.json_response(
+                    {"error": "capture has no span window yet"}, status=404
+                )
+            return web.json_response(trace)
+        if fmt != "manifest":
+            return web.json_response(
+                {"error": f"unknown profile format {fmt!r}"}, status=400
+            )
+        return web.json_response(
+            {
+                "capture_id": capture_id,
+                "command": cmd,
+                "captures": captures,
+                "window": {"start": window_start, "end": window_end},
+                "trace": trace,
+            }
+        )
+
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/heartbeat")
     async def post_heartbeat(request):
         run = _run_or_404(request)

@@ -256,6 +256,11 @@ def _train_image_classifier(
         start_step=int(ctx.get_param("profile_start", -1)),
         num_steps=int(ctx.get_param("profile_steps", 0)),
     )
+    # On-demand capture (control-plane `profile` commands): same per-step
+    # hook as the launch-time profiler, armed only when a command arrives.
+    from polyaxon_tpu.tracking.capture import get_capture_agent
+
+    capture = get_capture_agent()
     drain = MetricsDrain(lambda step, vals: ctx.log_metrics(step=step, **vals))
     clock = StepClock()
     tracer = get_tracer()
@@ -292,6 +297,8 @@ def _train_image_classifier(
             step_fn, aot_s = aot_compile(
                 ts.step, params, opt_state, warm_batch, key
             )
+        if step_fn is not ts.step:
+            capture.register_executable("train_step", step_fn)
         if measure_flops:
             from polyaxon_tpu.tracking.ledger import executable_flops
 
@@ -308,6 +315,7 @@ def _train_image_classifier(
         with tracer.span("train:loop", steps=steps - start_step):
             for i in range(start_step, steps):
                 profiler.on_step(i)
+                capture.on_step(i)
                 with tracer.span("train:step", sample=tracer.hot_sample, step=i):
                     if warm_batch is not None:
                         batch, warm_batch = warm_batch, None
@@ -701,6 +709,11 @@ def lm_train(ctx: Context) -> None:
         start_step=int(ctx.get_param("profile_start", -1)),
         num_steps=int(ctx.get_param("profile_steps", 0)),
     )
+    # On-demand capture (control-plane `profile` commands): same per-step
+    # hook as the launch-time profiler, armed only when a command arrives.
+    from polyaxon_tpu.tracking.capture import get_capture_agent
+
+    capture = get_capture_agent()
     # Metrics leave the loop as device arrays; a drain thread does the
     # host reads — even logging steps no longer serialize dispatch.
     drain = MetricsDrain(lambda step, vals: ctx.log_metrics(step=step, **vals))
@@ -728,6 +741,8 @@ def lm_train(ctx: Context) -> None:
     # afterwards would compile a second time.
     with tracer.span("train:aot_compile"):
         step_fn, aot_s = aot_compile(ts.step, params, opt_state, batch, key)
+    if step_fn is not ts.step:
+        capture.register_executable("train_step", step_fn)
     measured = (
         (
             executable_flops(step_fn)
@@ -745,6 +760,7 @@ def lm_train(ctx: Context) -> None:
         with tracer.span("train:loop", steps=steps - start_step):
             for i in range(start_step, steps):
                 profiler.on_step(i)
+                capture.on_step(i)
                 with tracer.span("train:step", sample=tracer.hot_sample, step=i):
                     params, opt_state, metrics = step_fn(
                         params, opt_state, batch, key

@@ -289,7 +289,61 @@ CREATE TABLE IF NOT EXISTS device_claims (
 );
 CREATE INDEX IF NOT EXISTS ix_claims_device ON device_claims (device_id);
 CREATE INDEX IF NOT EXISTS ix_claims_run ON device_claims (run_id);
+
+CREATE TABLE IF NOT EXISTS commands (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_id INTEGER NOT NULL,
+    uuid TEXT UNIQUE NOT NULL,
+    kind TEXT NOT NULL,
+    process_id INTEGER,
+    payload TEXT NOT NULL DEFAULT '{}',
+    status TEXT NOT NULL,
+    message TEXT,
+    acks TEXT NOT NULL DEFAULT '{}',
+    expected INTEGER NOT NULL DEFAULT 1,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_commands_run ON commands (run_id);
+
+CREATE TABLE IF NOT EXISTS captures (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_id INTEGER NOT NULL,
+    capture_id TEXT NOT NULL,
+    process_id INTEGER NOT NULL,
+    status TEXT NOT NULL,
+    start_step INTEGER,
+    num_steps INTEGER,
+    started_at REAL,
+    finished_at REAL,
+    artifacts TEXT NOT NULL DEFAULT '[]',
+    message TEXT,
+    attrs TEXT,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL,
+    UNIQUE (run_id, capture_id, process_id)
+);
+CREATE INDEX IF NOT EXISTS ix_captures_run ON captures (run_id);
 """
+
+
+class CommandStatus:
+    """Lifecycle of a worker-directed command (the run command bus).
+
+    PENDING (enqueued, mailbox files written) → ACKED (at least one worker
+    picked it up) → COMPLETE/FAILED (every targeted worker reported a
+    terminal per-process state).  EXPIRED is the control plane's own
+    verdict: the run finished (or was already finished) before the gang
+    honored the command — a typed answer instead of a hang.
+    """
+
+    PENDING = "pending"
+    ACKED = "acked"
+    COMPLETE = "complete"
+    FAILED = "failed"
+    EXPIRED = "expired"
+
+    TERMINAL = (COMPLETE, FAILED, EXPIRED)
 
 
 def accelerator_family(accelerator: str) -> str:
@@ -681,6 +735,8 @@ class RunRegistry:
                 ("progress", "run_id"),
                 ("anomalies", "run_id"),
                 ("utilization", "run_id"),
+                ("commands", "run_id"),
+                ("captures", "run_id"),
                 ("heartbeats", "run_id"),
                 ("processes", "run_id"),
                 ("bookmarks", "run_id"),
@@ -1170,6 +1226,231 @@ class RunRegistry:
             out.append(row)
         return out
 
+    # -- commands (control plane → worker bus) --------------------------------
+    def enqueue_command(
+        self,
+        run_id: int,
+        kind: str,
+        *,
+        payload: Optional[Dict[str, Any]] = None,
+        process_id: Optional[int] = None,
+        expected: int = 1,
+        uuid: Optional[str] = None,
+        status: str = CommandStatus.PENDING,
+        message: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Record a worker-directed command (the durable side of the bus;
+        delivery is the per-process mailbox the spawner provisions).
+        ``expected`` is how many processes must report a terminal state
+        before the roll-up resolves; ``process_id`` pins single-host
+        commands (None = whole gang)."""
+        import uuid as uuid_mod
+
+        cmd_uuid = uuid or uuid_mod.uuid4().hex
+        now = time.time()
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO commands
+                   (run_id, uuid, kind, process_id, payload, status, message,
+                    acks, expected, created_at, updated_at)
+                   VALUES (?, ?, ?, ?, ?, ?, ?, '{}', ?, ?, ?)""",
+                (
+                    run_id,
+                    cmd_uuid,
+                    str(kind),
+                    process_id,
+                    json.dumps(payload or {}, default=str),
+                    status,
+                    message,
+                    int(expected),
+                    now,
+                    now,
+                ),
+            )
+        return self.get_command(cmd_uuid)
+
+    def get_command(self, uuid: str) -> Optional[Dict[str, Any]]:
+        row = self._conn().execute(
+            "SELECT * FROM commands WHERE uuid = ?", (uuid,)
+        ).fetchone()
+        return self._command_row(row) if row is not None else None
+
+    def get_commands(
+        self,
+        run_id: int,
+        *,
+        kind: Optional[str] = None,
+        status: Optional[str] = None,
+        since_id: int = 0,
+        limit: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        sql = "SELECT * FROM commands WHERE run_id = ? AND id > ?"
+        params: List[Any] = [run_id, since_id]
+        if kind is not None:
+            sql += " AND kind = ?"
+            params.append(kind)
+        if status is not None:
+            sql += " AND status = ?"
+            params.append(status)
+        sql += " ORDER BY id"
+        if limit is not None:
+            sql += f" LIMIT {int(limit)}"
+        rows = self._conn().execute(sql, params).fetchall()
+        return [self._command_row(r) for r in rows]
+
+    @staticmethod
+    def _command_row(row: sqlite3.Row) -> Dict[str, Any]:
+        out = dict(row)
+        out["payload"] = json.loads(out["payload"]) if out["payload"] else {}
+        out["acks"] = json.loads(out["acks"]) if out["acks"] else {}
+        return out
+
+    def mark_command(
+        self,
+        uuid: str,
+        process_id: int,
+        state: str,
+        *,
+        message: Optional[str] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Fold one process's command state into the row and recompute the
+        gang roll-up.  Per-process states are acked/complete/failed; the
+        roll-up goes COMPLETE once ``expected`` processes are terminal and
+        none failed, FAILED if any did.  A command the control plane
+        already resolved (EXPIRED) never un-resolves — late worker lines
+        land in ``acks`` for forensics but don't flip the status."""
+        with self._lock, self._conn() as conn:
+            conn.execute("BEGIN IMMEDIATE")
+            row = conn.execute(
+                "SELECT * FROM commands WHERE uuid = ?", (uuid,)
+            ).fetchone()
+            if row is None:
+                return None
+            acks = json.loads(row["acks"]) if row["acks"] else {}
+            acks[str(int(process_id))] = state
+            status = row["status"]
+            if status not in CommandStatus.TERMINAL:
+                terminal = [
+                    s
+                    for s in acks.values()
+                    if s in (CommandStatus.COMPLETE, CommandStatus.FAILED)
+                ]
+                if len(terminal) >= max(1, row["expected"]):
+                    status = (
+                        CommandStatus.FAILED
+                        if CommandStatus.FAILED in terminal
+                        else CommandStatus.COMPLETE
+                    )
+                elif acks:
+                    status = CommandStatus.ACKED
+            conn.execute(
+                """UPDATE commands SET acks = ?, status = ?, updated_at = ?,
+                                       message = COALESCE(?, message)
+                   WHERE uuid = ?""",
+                (json.dumps(acks), status, time.time(), message, uuid),
+            )
+        return self.get_command(uuid)
+
+    def expire_commands(
+        self, run_id: int, *, message: str = "run finished before the gang honored the command"
+    ) -> int:
+        """Resolve every still-open command on a run to EXPIRED — called
+        when the run goes terminal so a command never hangs un-answered."""
+        placeholders = ",".join("?" * len(CommandStatus.TERMINAL))
+        with self._lock, self._conn() as conn:
+            return conn.execute(
+                f"""UPDATE commands SET status = ?, message = ?, updated_at = ?
+                    WHERE run_id = ? AND status NOT IN ({placeholders})""",
+                (
+                    CommandStatus.EXPIRED,
+                    message,
+                    time.time(),
+                    run_id,
+                    *CommandStatus.TERMINAL,
+                ),
+            ).rowcount
+
+    # -- captures (on-demand profiling results) -------------------------------
+    def upsert_capture(
+        self,
+        run_id: int,
+        capture_id: str,
+        process_id: int,
+        *,
+        status: Optional[str] = None,
+        start_step: Optional[int] = None,
+        num_steps: Optional[int] = None,
+        started_at: Optional[float] = None,
+        finished_at: Optional[float] = None,
+        artifacts: Optional[List[str]] = None,
+        message: Optional[str] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Latest-wins per-(run, capture, process) profiling record — the
+        watcher folds workers' typed ``capture`` report lines here, so a
+        capture's lifecycle (started → complete/failed) is one row per
+        host, like ``progress``."""
+        now = time.time()
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO captures
+                   (run_id, capture_id, process_id, status, start_step,
+                    num_steps, started_at, finished_at, artifacts, message,
+                    attrs, created_at, updated_at)
+                   VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)
+                   ON CONFLICT (run_id, capture_id, process_id) DO UPDATE SET
+                     status = COALESCE(excluded.status, status),
+                     start_step = COALESCE(excluded.start_step, start_step),
+                     num_steps = COALESCE(excluded.num_steps, num_steps),
+                     started_at = COALESCE(excluded.started_at, started_at),
+                     finished_at = COALESCE(excluded.finished_at, finished_at),
+                     artifacts = CASE WHEN excluded.artifacts != '[]'
+                                      THEN excluded.artifacts ELSE artifacts END,
+                     message = COALESCE(excluded.message, message),
+                     attrs = COALESCE(excluded.attrs, attrs),
+                     updated_at = excluded.updated_at""",
+                (
+                    run_id,
+                    str(capture_id),
+                    int(process_id),
+                    status,
+                    start_step,
+                    num_steps,
+                    started_at,
+                    finished_at,
+                    json.dumps(artifacts or [], default=str),
+                    message,
+                    json.dumps(attrs, default=str) if attrs else None,
+                    now,
+                    now,
+                ),
+            )
+
+    def get_captures(
+        self,
+        run_id: int,
+        *,
+        capture_id: Optional[str] = None,
+        since_id: int = 0,
+        limit: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        sql = "SELECT * FROM captures WHERE run_id = ? AND id > ?"
+        params: List[Any] = [run_id, since_id]
+        if capture_id is not None:
+            sql += " AND capture_id = ?"
+            params.append(capture_id)
+        sql += " ORDER BY id"
+        if limit is not None:
+            sql += f" LIMIT {int(limit)}"
+        rows = self._conn().execute(sql, params).fetchall()
+        out: List[Dict[str, Any]] = []
+        for r in rows:
+            row = dict(r)
+            row["artifacts"] = json.loads(row["artifacts"]) if row["artifacts"] else []
+            row["attrs"] = json.loads(row["attrs"]) if row["attrs"] else {}
+            out.append(row)
+        return out
+
     def stale_queued_runs(
         self, ttl_seconds: float, now: Optional[float] = None
     ) -> List[Run]:
@@ -1628,12 +1909,24 @@ class RunRegistry:
                    (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
                 (cutoff, cutoff),
             ).rowcount
+            commands = conn.execute(
+                """DELETE FROM commands WHERE created_at < ? AND run_id IN
+                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
+                (cutoff, cutoff),
+            ).rowcount
+            captures = conn.execute(
+                """DELETE FROM captures WHERE created_at < ? AND run_id IN
+                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
+                (cutoff, cutoff),
+            ).rowcount
         return {
             "activity": act,
             "logs": logs,
             "spans": spans,
             "anomalies": anomalies,
             "utilization": utilization,
+            "commands": commands,
+            "captures": captures,
         }
 
     # -- projects (entity metadata over runs.project) --------------------------

@@ -36,6 +36,8 @@ class EventTypes:
     EXPERIMENT_STOPPED = "experiment.stopped"
     EXPERIMENT_DONE = "experiment.done"
     EXPERIMENT_ZOMBIE = "experiment.zombie"
+    EXPERIMENT_COMMAND_SENT = "experiment.command_sent"
+    EXPERIMENT_PROFILE_REQUESTED = "experiment.profile_requested"
     EXPERIMENT_ARTIFACTS_SYNCED = "experiment.artifacts_synced"
     EXPERIMENT_ARCHIVED = "experiment.archived"
     EXPERIMENT_RESTORED = "experiment.restored"

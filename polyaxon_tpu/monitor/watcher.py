@@ -359,6 +359,48 @@ class GangWatcher:
                 attrs=attrs,
                 created_at=event.get("ts"),
             )
+        elif etype == "command":
+            # A worker's per-process lifecycle state for a bus command
+            # (acked/complete/failed) — folded into the command roll-up.
+            uuid = event.get("uuid")
+            state = event.get("state")
+            if not uuid or not state:
+                logger.warning(
+                    "Command report without uuid/state from proc %d", process_id
+                )
+                return
+            self.registry.mark_command(
+                str(uuid), process_id, str(state), message=event.get("message")
+            )
+        elif etype == "capture":
+            # On-demand profiling record: one latest-wins row per
+            # (capture, host).  A torn/partial record (no capture_id) is a
+            # malformed line, not a poll-fatal error.
+            capture_id = event.get("capture_id")
+            if not capture_id:
+                logger.warning(
+                    "Capture report without capture_id from proc %d", process_id
+                )
+                return
+            artifacts = event.get("artifacts")
+            self.registry.upsert_capture(
+                run_id,
+                str(capture_id),
+                process_id,
+                status=event.get("status"),
+                start_step=event.get("start_step"),
+                num_steps=event.get("num_steps"),
+                started_at=event.get("started_at"),
+                finished_at=event.get("finished_at"),
+                artifacts=list(artifacts) if artifacts else None,
+                message=event.get("message"),
+                attrs=event.get("attrs") or None,
+            )
+            if self.stats is not None and event.get("status") in (
+                "complete",
+                "failed",
+            ):
+                self.stats.incr("profile_captures")
         elif etype == "service":
             # A service refining its own URL (jupyter appends its token
             # as a query string; an absolute url replaces outright).
@@ -383,6 +425,14 @@ class GangWatcher:
                 self.registry.add_log(
                     run_id, f"[proc {process_id}] {status}: {message}", process_id=process_id
                 )
+        else:
+            # Version skew (a newer worker's line kind against an older
+            # control plane) is skip-and-warn, never poll-fatal.
+            logger.warning(
+                "Unknown report line type %r from proc %d; skipping",
+                etype,
+                process_id,
+            )
 
     # -- liveness reconcile ---------------------------------------------------
     def reconcile(self, handle: GangHandle) -> List[str]:
@@ -511,6 +561,22 @@ class GangWatcher:
         self.stats.gauge("run_compile_s_total", float(status["compile_s"]))
         self.stats.gauge("run_hbm_peak_bytes", float(status["hbm_peak_bytes"]))
 
+    def _refresh_command_gauges(self, handle: GangHandle) -> None:
+        """``profile_capture_active``: profile commands still in flight
+        (pending/acked) on this gang — pairs with the
+        ``profile_captures`` counter the ingest path increments."""
+        if self.stats is None:
+            return
+        try:
+            cmds = self.registry.get_commands(handle.run_id, kind="profile")
+        except Exception:
+            logger.warning(
+                "Command roll-up failed for run %d", handle.run_id, exc_info=True
+            )
+            return
+        active = sum(1 for c in cmds if c["status"] in ("pending", "acked"))
+        self.stats.gauge("profile_capture_active", float(active))
+
     def observe(self, handle: GangHandle) -> Optional[str]:
         """One poll: ingest reports, reconcile liveness, return gang roll-up."""
         tracer = get_tracer()
@@ -534,6 +600,7 @@ class GangWatcher:
                         exc_info=True,
                     )
                 self._refresh_goodput_gauges(handle)
+                self._refresh_command_gauges(handle)
             elif self.stats is not None:
                 # A run that goes terminal mid-episode must not pin the
                 # alarm gauges at its last stalled value.
@@ -547,6 +614,9 @@ class GangWatcher:
                 # ledger rows ingested this same poll, then stops — the
                 # gauges keep reporting what the run achieved.
                 if not getattr(handle, "goodput_frozen", False):
+                    # In-flight profile commands expire with the run (see
+                    # _record_done) — the gauge must not stay pinned.
+                    self.stats.gauge("profile_capture_active", 0.0)
                     self._refresh_goodput_gauges(handle)
                     try:
                         handle.goodput_frozen = True

@@ -16,10 +16,11 @@ Two operating modes:
 
 from __future__ import annotations
 
+import json
 import logging
 import time
 from pathlib import Path
-from typing import Any, Dict, Optional, Union
+from typing import Any, Dict, List, Optional, Union
 
 from polyaxon_tpu.auditor import Auditor
 from polyaxon_tpu.db import Run, RunRegistry
@@ -528,6 +529,100 @@ class Orchestrator:
 
     def get_run(self, run_id: Union[int, str]) -> Run:
         return self.registry.get_run(run_id)
+
+    # -- run command bus (control plane → workers) -----------------------------
+    def send_command(
+        self,
+        run_id: int,
+        kind: str,
+        *,
+        payload: Optional[Dict[str, Any]] = None,
+        processes: Optional[List[int]] = None,
+        actor: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Enqueue a worker-directed command and deliver it to the gang's
+        per-process mailboxes.  Returns the registry command row; a command
+        to a finished run resolves immediately to a typed EXPIRED row
+        instead of waiting on a gang that will never answer."""
+        from polyaxon_tpu.db.registry import CommandStatus
+
+        run = self.registry.get_run(run_id)
+        extra = {"actor": actor} if actor else {}
+        if run.is_done:
+            cmd = self.registry.enqueue_command(
+                run.id,
+                kind,
+                payload=payload,
+                expected=0,
+                status=CommandStatus.EXPIRED,
+                message=f"run already finished ({run.status})",
+            )
+            self.auditor.record(
+                EventTypes.EXPERIMENT_COMMAND_SENT,
+                run_id=run.id,
+                kind=kind,
+                status=CommandStatus.EXPIRED,
+                **extra,
+            )
+            return cmd
+        if processes is None:
+            handle = self.ctx.gangs.get(run.id)
+            if handle is not None:
+                targets = list(range(handle.plan.num_hosts))
+            else:
+                rows = self.registry.get_processes(run.id)
+                targets = [p["process_id"] for p in rows] or [0]
+        else:
+            targets = sorted({int(p) for p in processes})
+        cmd = self.registry.enqueue_command(
+            run.id,
+            kind,
+            payload=payload,
+            process_id=targets[0] if len(targets) == 1 else None,
+            expected=len(targets),
+        )
+        paths = self.layout.run_paths(run.uuid)
+        body = json.dumps(
+            {"uuid": cmd["uuid"], "kind": kind, "payload": payload or {}},
+            default=str,
+        )
+        for process_id in targets:
+            mailbox = paths.command_dir(process_id)
+            mailbox.mkdir(parents=True, exist_ok=True)
+            # Atomic drop: the worker's poll must never read a torn file.
+            tmp = mailbox / f".{cmd['uuid']}.tmp"
+            tmp.write_text(body)
+            tmp.rename(mailbox / f"{cmd['uuid']}.json")
+        self.auditor.record(
+            EventTypes.EXPERIMENT_COMMAND_SENT,
+            run_id=run.id,
+            kind=kind,
+            processes=targets,
+            **extra,
+        )
+        return cmd
+
+    def request_profile(
+        self,
+        run_id: int,
+        *,
+        num_steps: Optional[int] = None,
+        duration_s: Optional[float] = None,
+        processes: Optional[List[int]] = None,
+        actor: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """On-demand gang-wide device profiling: a ``profile`` command whose
+        uuid doubles as the capture id (one handle for the command row, the
+        capture rows, and the ``profiles/<id>/`` artifact tree)."""
+        payload: Dict[str, Any] = {}
+        if num_steps is not None:
+            payload["num_steps"] = int(num_steps)
+        if duration_s is not None:
+            payload["duration_s"] = float(duration_s)
+        cmd = self.send_command(
+            run_id, "profile", payload=payload, processes=processes, actor=actor
+        )
+        return {**cmd, "capture_id": cmd["uuid"]}
 
     # -- CI (per-project trigger; reference api/ci/ + ci/service.py) -----------
     def set_project_ci(

@@ -115,6 +115,21 @@ def main() -> int:
     from polyaxon_tpu.tracking import ledger as ledger_mod
 
     ledger_mod.configure(sink=reporter.ledger, process_id=info.process_id)
+    # Command-bus receiver: the control plane drops command files into this
+    # process's mailbox; the agent's poll rides the heartbeat thread (no
+    # extra thread, near-zero idle cost) and on-demand profile captures
+    # hook the workload step loops via get_capture_agent().on_step.
+    from polyaxon_tpu.tracking import capture as capture_mod
+
+    mailbox = paths.command_dir(info.process_id)
+    mailbox.mkdir(parents=True, exist_ok=True)
+    capture_agent = capture_mod.configure(
+        reporter=reporter,
+        mailbox=mailbox,
+        profiles_root=paths.profiles,
+        process_id=info.process_id,
+    )
+    reporter.add_beat_hook(capture_agent.poll)
     reporter.status("starting")
     reporter.start_heartbeat(info.heartbeat_interval)
     from polyaxon_tpu.tracking.flightrec import FlightRecorder, get_progress
@@ -245,6 +260,12 @@ def main() -> int:
     finally:
         recorder.stop()
         sampler.stop()
+        # A capture the gang is mid-way through must resolve (failed) —
+        # an exiting worker must not leave its command hanging ACKED.
+        try:
+            capture_agent.close()
+        except Exception:
+            pass
         # Final ledger row (no-op if the workload never armed it): the
         # run's last cumulative truth, flagged final for consumers.
         try:

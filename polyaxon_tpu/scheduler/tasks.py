@@ -66,6 +66,12 @@ def _record_done(
     # capacity immediately re-dispatches runs queued at admission.
     if ctx.registry.release_devices(run_id):
         ctx.bus.send(SchedulerTasks.ADMISSION_CHECK, {})
+    # Resolve any still-open bus commands (profile etc.) to a typed EXPIRED
+    # state — a command against a gang that just finished must answer, not
+    # hang PENDING forever.
+    expired = ctx.registry.expire_commands(run_id)
+    if expired:
+        logger.info("Expired %d open command(s) on finished run %s", expired, run_id)
     run = ctx.registry.get_run(run_id)
     if run.service_url:
         # A terminal service must stop advertising its (now dead) URL.

@@ -331,6 +331,14 @@ class ServingEngine:
         # Decode ticks feed the process's stall watchdog: a serving worker
         # that stops emitting tokens is as stuck as a hung train step.
         self._progress = get_progress()
+        # On-demand capture (control-plane `profile` commands): decode
+        # iterations drive the same per-step hook trainers use, so a
+        # capture window is N decode steps.  Gated on the readiness event
+        # in _step_once — a warmup compile storm is not steady-state
+        # serving and must not satisfy a profile command's window.
+        from polyaxon_tpu.tracking.capture import get_capture_agent
+
+        self._capture = get_capture_agent()
         self._stats_lock = threading.Lock()
         self._n_submitted = 0
         self._n_finished = 0
@@ -517,9 +525,35 @@ class ServingEngine:
         finally:
             self._warmup_s = time.perf_counter() - t0
             self._compiled_baseline = self._compiled_count()
+            # Lazy HLO source for on-demand captures: lowering text is only
+            # produced if a profile command actually fires (no extra
+            # compile — .lower() stops before XLA).
+            self._capture.register_executable(
+                "serving_decode_step",
+                type("_LazyHLO", (), {"as_text": lambda _s: self._decode_hlo_text()})(),
+            )
             self._ready.set()
             if gauge is not None:
                 gauge("serving.warmup_progress", 1.0)
+
+    def _decode_hlo_text(self) -> str:
+        """Lower the decode step against the engine's live shapes and
+        render its HLO text (capture-time only; best-effort)."""
+        import jax.numpy as jnp
+
+        tables = np.where(self._tables >= 0, self._tables, 0).astype(np.int32)
+        lowered = self._step_fn.lower(
+            self._params,
+            self._pool,
+            jnp.asarray(tables),
+            jnp.asarray(self._tok),
+            jnp.asarray(self._pos),
+            jnp.asarray(self._active),
+            jnp.asarray(self._temps),
+            self._key,
+            self._qweights,
+        )
+        return lowered.as_text()
 
     def _check_steady_compiles(self) -> None:
         """Post-ready jit cache growth = a steady-state compile stalled
@@ -1111,6 +1145,8 @@ class ServingEngine:
         self.stats_registry.observe("serving.batch_occupancy", float(n_live))
         self._ledger_account(step_dt, n_live / self.slots, tokens=n_live)
         self._record_gauges()
+        if self._ready.is_set():
+            self._capture.on_step(self._n_steps)
         self._progress.beat(step=self._n_steps)
 
     def _record_gauges(self) -> None:

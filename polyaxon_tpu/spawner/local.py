@@ -185,6 +185,11 @@ class GangSpawner:
     def start(self, run: Run, plan: GangPlan) -> GangHandle:
         """Create the run dir, write the spec, launch all gang processes."""
         paths = self.layout.run_paths(run.uuid).ensure()
+        # Per-process command mailboxes (the control-plane→worker bus):
+        # provisioned before launch so a command can never race a worker
+        # that hasn't created its own dir yet.
+        for process_id in range(plan.num_hosts):
+            paths.command_dir(process_id).mkdir(parents=True, exist_ok=True)
         paths.spec_path.write_text(json.dumps(run.spec_data))
         if run.code_ref:
             materialize_snapshot(run.code_ref, self.layout.snapshots_dir, paths.code)

@@ -26,9 +26,12 @@ from typing import BinaryIO, Callable, List, Optional, Sequence, Union
 
 from polyaxon_tpu.exceptions import PolyaxonTPUError
 
-#: Run subdirectories that sync to/from the store (reports/ is the live
-#: worker→control-plane channel and stays local; code/ is snapshot-addressed).
-RUN_SYNC_SUBDIRS = ("outputs", "checkpoints", "logs")
+#: Run subdirectories that sync to/from the store (reports/ and commands/
+#: are the live worker↔control-plane channels and stay local; code/ is
+#: snapshot-addressed).  profiles/ carries on-demand capture artifacts
+#: (xplane traces, device-memory snapshots, HLO text) — durable like
+#: outputs, so a capture survives its host.
+RUN_SYNC_SUBDIRS = ("outputs", "checkpoints", "logs", "profiles")
 
 
 def run_prefix(run_uuid: str) -> str:

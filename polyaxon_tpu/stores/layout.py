@@ -42,6 +42,18 @@ class RunPaths:
         return self.root / "checkpoints"
 
     @property
+    def commands(self) -> Path:
+        """Control-plane→worker command bus root: the inverse of reports/.
+        The control plane drops ``<uuid>.json`` files into per-process
+        mailboxes; each worker's heartbeat thread polls its own."""
+        return self.root / "commands"
+
+    @property
+    def profiles(self) -> Path:
+        """On-demand capture artifacts: ``profiles/<capture_id>/proc<N>/``."""
+        return self.root / "profiles"
+
+    @property
     def code(self) -> Path:
         return self.root / "code"
 
@@ -51,8 +63,12 @@ class RunPaths:
     def log_file(self, process_id: int) -> Path:
         return self.logs / f"proc{process_id}.log"
 
+    def command_dir(self, process_id: int) -> Path:
+        return self.commands / f"proc{process_id}"
+
     def ensure(self) -> "RunPaths":
-        for p in (self.root, self.outputs, self.logs, self.reports, self.checkpoints):
+        for p in (self.root, self.outputs, self.logs, self.reports,
+                  self.checkpoints, self.commands):
             p.mkdir(parents=True, exist_ok=True)
         return self
 

@@ -9,12 +9,23 @@ annotation helpers for named trace spans.
 
 from __future__ import annotations
 
+import contextlib
+import logging
 from pathlib import Path
 from typing import Optional, Union
 
+logger = logging.getLogger(__name__)
+
 
 class StepProfiler:
-    """Capture a jax.profiler trace for steps [start, start+num_steps)."""
+    """Capture a jax.profiler trace for steps [start, start+num_steps).
+
+    Failure policy: profiling is diagnostics, never the workload — any
+    ``start_trace``/``stop_trace`` failure (profiler unavailable, trace
+    dir unwritable, another trace already active) warns and DISABLES the
+    profiler instead of crashing the train loop.  ``close()`` is
+    idempotent.
+    """
 
     def __init__(
         self,
@@ -26,37 +37,63 @@ class StepProfiler:
         self.start_step = start_step
         self.num_steps = num_steps
         self._active = False
+        self._broken = False
 
     @property
     def enabled(self) -> bool:
-        return self.num_steps > 0 and self.start_step >= 0
+        return self.num_steps > 0 and self.start_step >= 0 and not self._broken
+
+    def _disable(self, op: str, exc: Exception) -> None:
+        logger.warning(
+            "StepProfiler %s failed (%s: %s); disabling profiling for this run",
+            op,
+            type(exc).__name__,
+            exc,
+        )
+        self._broken = True
+        self._active = False
 
     def on_step(self, step: int) -> None:
         """Call once per train step (before dispatch)."""
         if not self.enabled:
             return
-        import jax
-
         if not self._active and step == self.start_step:
-            jax.profiler.start_trace(self.trace_dir)
-            self._active = True
+            try:
+                import jax
+
+                jax.profiler.start_trace(self.trace_dir)
+                self._active = True
+            except Exception as e:
+                self._disable("start_trace", e)
         elif self._active and step >= self.start_step + self.num_steps:
-            jax.profiler.stop_trace()
-            self._active = False
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+                self._active = False
+            except Exception as e:
+                self._disable("stop_trace", e)
 
     def close(self) -> None:
         if self._active:
-            import jax
-
-            jax.profiler.stop_trace()
             self._active = False
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception as e:
+                self._disable("stop_trace", e)
 
 
 def annotate(name: str):
-    """Named trace span context manager (no-op cost when not tracing)."""
-    import jax
+    """Named trace span context manager (no-op cost when not tracing;
+    no-op entirely when jax.profiler is unavailable)."""
+    try:
+        import jax
 
-    return jax.profiler.TraceAnnotation(name)
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
 
 
 class StepClock:

@@ -26,11 +26,13 @@ class Reporter:
     # Event types that must survive a host crash: lifecycle transitions
     # drive scheduling decisions, so they are fsynced to disk.  Anomaly
     # lines are fsynced too — they are rare and often immediately precede
-    # the crash they describe.  Everything else (metrics/logs/spans) is
+    # the crash they describe.  Command/capture lines are rare (one per
+    # bus command) and drive control-plane lifecycle roll-ups, so they
+    # get the same durability.  Everything else (metrics/logs/spans) is
     # flushed to the OS only — losing the last few lines of telemetry on a
     # power cut is fine, but an fsync per metric line serializes the train
     # loop on disk latency.
-    FSYNC_TYPES = ("status", "anomaly")
+    FSYNC_TYPES = ("status", "anomaly", "command", "capture")
 
     def __init__(
         self,
@@ -46,6 +48,11 @@ class Reporter:
         self._lock = threading.Lock()
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
+        #: Callbacks the heartbeat thread runs every beat — how the command
+        #: mailbox gets polled without its own thread.  Must be cheap (the
+        #: idle cost is one listdir of a usually-empty dir) and must not
+        #: raise (guarded anyway: a hook failure must not kill heartbeats).
+        self._beat_hooks: list = []
 
     def _emit(self, type_: str, **payload: Any) -> None:
         line = json.dumps({"type": type_, "ts": time.time(), **payload}, default=str)
@@ -123,6 +130,24 @@ class Reporter:
         token without the control plane ever knowing it ahead of time."""
         self._emit("service", url=url, query=query)
 
+    def command_event(
+        self,
+        uuid: str,
+        state: str,
+        message: Optional[str] = None,
+        **attrs: Any,
+    ) -> None:
+        """Report this process's lifecycle state for a bus command
+        (acked/complete/failed) — the watcher folds these into the
+        registry's ``commands`` roll-up."""
+        self._emit("command", uuid=uuid, state=state, message=message, **attrs)
+
+    def capture(self, record: Dict[str, Any]) -> None:
+        """Ship an on-demand profiling capture record (see
+        tracking/capture.py) upstream — the watcher ingests these into the
+        registry's ``captures`` table (one latest-wins row per host)."""
+        self._emit("capture", **record)
+
     def error(self, exc: BaseException) -> None:
         self._emit(
             "status",
@@ -132,14 +157,32 @@ class Reporter:
         )
 
     # -- heartbeat thread -----------------------------------------------------
+    def add_beat_hook(self, hook) -> None:
+        """Run ``hook()`` on the heartbeat thread every beat interval.
+
+        The command-bus mailbox poll rides here: the heartbeat cadence is
+        already the worker's control-plane contact rhythm, so command
+        delivery costs no extra thread and no extra wakeups."""
+        self._beat_hooks.append(hook)
+
+    def _run_beat_hooks(self) -> None:
+        for hook in self._beat_hooks:
+            try:
+                hook()
+            except Exception:
+                # A broken hook must not take the liveness signal with it.
+                pass
+
     def start_heartbeat(self, interval: float) -> None:
         if self._hb_thread is not None or interval <= 0:
             return
         self.heartbeat()  # immediate first beat: no zombie window at startup
+        self._run_beat_hooks()
 
         def beat() -> None:
             while not self._hb_stop.wait(interval):
                 self.heartbeat()
+                self._run_beat_hooks()
 
         self._hb_thread = threading.Thread(target=beat, name="heartbeat", daemon=True)
         self._hb_thread.start()

@@ -1,0 +1,259 @@
+"""The profiles surface: ``POST /runs/<id>/profile`` (command bus
+trigger), ``GET /runs/<id>/profiles`` (capture index), and the
+per-capture manifest with its merged chrome-trace window.
+"""
+
+import asyncio
+
+import pytest
+
+from polyaxon_tpu.api.app import create_app
+from polyaxon_tpu.orchestrator import Orchestrator
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(
+        tmp_path / "plat",
+        monitor_interval=0.05,
+        heartbeat_interval=0.2,
+        heartbeat_ttl=30.0,
+    )
+    yield o
+    o.stop()
+
+
+def drive(orch, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        app = create_app(orch)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+class TestProfileTrigger:
+    def test_404_for_unknown_run(self, orch):
+        async def body(client):
+            assert (await client.post("/api/v1/runs/999/profile")).status == 404
+            assert (await client.get("/api/v1/runs/999/profiles")).status == 404
+            return True
+
+        assert drive(orch, body)
+
+    def test_post_enqueues_and_delivers_to_mailboxes(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            resp = await client.post(
+                f"/api/v1/runs/{run['id']}/profile",
+                json={"num_steps": 3, "duration_s": 5.0},
+            )
+            assert resp.status == 202
+            cmd = await resp.json()
+            assert cmd["kind"] == "profile"
+            assert cmd["status"] == "pending"
+            assert cmd["capture_id"] == cmd["uuid"]
+            assert cmd["payload"] == {"num_steps": 3, "duration_s": 5.0}
+            # The command file landed in the per-process mailbox.
+            paths = orch.layout.run_paths(run["uuid"])
+            files = list(paths.command_dir(0).glob("*.json"))
+            assert [f.stem for f in files] == [cmd["uuid"]]
+            # ... and the capture index lists the in-flight command.
+            doc = await (
+                await client.get(f"/api/v1/runs/{run['id']}/profiles")
+            ).json()
+            assert [c["uuid"] for c in doc["results"]] == [cmd["uuid"]]
+            assert doc["results"][0]["captures"] == []
+            return True
+
+        assert drive(orch, body)
+
+    def test_post_to_finished_run_is_typed_expired(self, orch):
+        """Acceptance edge: a profile command against a FINISHED run must
+        come back as a typed EXPIRED command, not an error or a hang."""
+        run = orch.submit(SPEC, name="done-before-profile")
+        done = orch.wait(run.id, timeout=120)
+        assert done.is_done
+
+        async def body(client):
+            resp = await client.post(f"/api/v1/runs/{run.id}/profile")
+            assert resp.status == 202
+            cmd = await resp.json()
+            assert cmd["status"] == "expired"
+            assert "finished" in cmd["message"]
+            doc = await (
+                await client.get(f"/api/v1/runs/{run.id}/profiles")
+            ).json()
+            assert doc["results"][0]["status"] == "expired"
+            return True
+
+        assert drive(orch, body)
+
+    def test_bad_processes_param_is_400(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            resp = await client.post(
+                f"/api/v1/runs/{run['id']}/profile",
+                json={"processes": "all"},
+            )
+            assert resp.status == 400
+            resp = await client.post(
+                f"/api/v1/runs/{run['id']}/profile",
+                json={"num_steps": "many"},
+            )
+            assert resp.status == 400
+            return True
+
+        assert drive(orch, body)
+
+
+class TestProfileManifest:
+    def _seed(self, orch, run_id):
+        cmd = orch.registry.enqueue_command(run_id, "profile", expected=2)
+        cid = cmd["uuid"]
+        orch.registry.upsert_capture(
+            run_id,
+            cid,
+            0,
+            status="complete",
+            start_step=10,
+            num_steps=5,
+            started_at=100.0,
+            finished_at=110.0,
+            artifacts=["profiles/%s/proc0/memory.prof" % cid],
+        )
+        orch.registry.upsert_capture(
+            run_id, cid, 1, status="started", started_at=101.0
+        )
+        # One span inside the capture window, one far outside it.
+        orch.registry.add_span(
+            run_id,
+            {"name": "train:step", "start": 105.0, "duration": 0.5, "process_id": 0},
+        )
+        orch.registry.add_span(
+            run_id,
+            {"name": "startup", "start": 5.0, "duration": 1.0, "process_id": 0},
+        )
+        return cid
+
+    def test_manifest_groups_hosts_and_windows_the_trace(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            cid = self._seed(orch, run["id"])
+            doc = await (
+                await client.get(f"/api/v1/runs/{run['id']}/profiles/{cid}")
+            ).json()
+            assert doc["capture_id"] == cid
+            assert doc["command"]["expected"] == 2
+            by_proc = {c["process_id"]: c for c in doc["captures"]}
+            assert by_proc[0]["status"] == "complete"
+            assert by_proc[0]["artifacts"] == [f"profiles/{cid}/proc0/memory.prof"]
+            assert by_proc[1]["status"] == "started"
+            assert doc["window"] == {"start": 100.0, "end": 110.0}
+            # Merged chrome-trace: only spans overlapping the window.
+            names = [
+                e["name"]
+                for e in doc["trace"]["traceEvents"]
+                if e.get("ph") == "X"
+            ]
+            assert names == ["train:step"]
+            # ?format=chrome serves the raw trace document.
+            chrome = await (
+                await client.get(
+                    f"/api/v1/runs/{run['id']}/profiles/{cid}?format=chrome"
+                )
+            ).json()
+            assert chrome["traceEvents"]
+            resp = await client.get(
+                f"/api/v1/runs/{run['id']}/profiles/{cid}?format=hex"
+            )
+            assert resp.status == 400
+            return True
+
+        assert drive(orch, body)
+
+    def test_profiler_dirs_visible_in_artifacts_api(self, orch):
+        """Satellite: both the launch-time StepProfiler tree
+        (outputs/profile/) and on-demand capture trees (profiles/) are
+        browsable through the artifacts endpoint."""
+
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            paths = orch.layout.run_paths(run["uuid"])
+            launch = paths.outputs / "profile" / "plugins"
+            launch.mkdir(parents=True)
+            (launch / "host.xplane.pb").write_bytes(b"xp")
+            ondemand = paths.profiles / "cap1" / "proc0"
+            ondemand.mkdir(parents=True)
+            (ondemand / "memory.prof").write_bytes(b"mem")
+            doc = await (
+                await client.get(f"/api/v1/runs/{run['id']}/artifacts")
+            ).json()
+            assert "outputs/profile/plugins/host.xplane.pb" in doc["results"]
+            assert "profiles/cap1/proc0/memory.prof" in doc["results"]
+            resp = await client.get(
+                f"/api/v1/runs/{run['id']}/artifacts/profiles/cap1/proc0/memory.prof"
+            )
+            assert resp.status == 200 and await resp.read() == b"mem"
+            return True
+
+        assert drive(orch, body)
+
+    def test_unknown_capture_404(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            resp = await client.get(
+                f"/api/v1/runs/{run['id']}/profiles/nope"
+            )
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)
+
+    def test_windowless_capture_manifest(self, orch):
+        """A capture with no started_at yet has no span window — the
+        manifest serves with trace=None and ?format=chrome 404s."""
+
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            cmd = orch.registry.enqueue_command(run["id"], "profile")
+            doc = await (
+                await client.get(
+                    f"/api/v1/runs/{run['id']}/profiles/{cmd['uuid']}"
+                )
+            ).json()
+            assert doc["trace"] is None
+            assert doc["window"] == {"start": None, "end": None}
+            resp = await client.get(
+                f"/api/v1/runs/{run['id']}/profiles/{cmd['uuid']}?format=chrome"
+            )
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)

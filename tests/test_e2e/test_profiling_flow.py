@@ -1,0 +1,174 @@
+"""End-to-end on-demand profiling: POST a profile against a RUNNING
+lm_train gang, get back a COMPLETE capture with xplane + memory + HLO
+artifacts, all fetchable through the profiles and artifacts APIs.
+
+This is the tentpole acceptance path: command file → worker mailbox →
+heartbeat poll → windowed jax trace in the step loop → typed report
+lines → registry rows → API.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from polyaxon_tpu.api.app import create_app
+from polyaxon_tpu.db.registry import CommandStatus
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.orchestrator import Orchestrator
+
+# A long, cheap stepping window: thousands of sub-10ms steps give the
+# command several seconds of RUNNING train loop to land in.
+STEPS = 4000
+
+
+def lm_spec(steps=STEPS):
+    return {
+        "kind": "experiment",
+        "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:lm_train"},
+        "declarations": {
+            "steps": steps,
+            "batch": 4,
+            "seq": 64,
+            "vocab_size": 256,
+            "d_model": 64,
+            "n_layers": 2,
+            "n_heads": 4,
+            "head_dim": 16,
+            "d_ff": 128,
+        },
+        "environment": {
+            "topology": {"accelerator": "cpu", "num_devices": 4, "num_hosts": 1}
+        },
+    }
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(
+        tmp_path / "plat",
+        monitor_interval=0.05,
+        heartbeat_interval=0.2,
+        heartbeat_ttl=60.0,
+    )
+    yield o
+    o.stop()
+
+
+def _pump_until(orch, predicate, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        orch.pump(0.05)
+        result = predicate()
+        if result:
+            return result
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.mark.e2e
+class TestProfilingFlow:
+    def test_profile_running_gang_end_to_end(self, orch):
+        run = orch.submit(lm_spec(), name="profile-e2e")
+
+        def _stepping():
+            r = orch.registry.get_run(run.id)
+            if r.is_done:
+                raise AssertionError(
+                    "run finished before a profile could be requested:\n"
+                    + "\n".join(
+                        l["line"] for l in orch.registry.get_logs(run.id)
+                    )
+                )
+            prog = orch.registry.get_progress(run.id)
+            return r.status == S.RUNNING and prog and prog[0]["step"] >= 1
+
+        _pump_until(orch, _stepping, 240, "the gang to start stepping")
+
+        cmd = orch.request_profile(run.id, num_steps=3)
+        cid = cmd["capture_id"]
+        assert cmd["status"] == CommandStatus.PENDING
+
+        row = _pump_until(
+            orch,
+            lambda: (
+                lambda c: c if c["status"] in CommandStatus.TERMINAL else None
+            )(orch.registry.get_command(cid)),
+            120,
+            "the profile command to resolve",
+        )
+        assert row["status"] == CommandStatus.COMPLETE, row
+        assert row["acks"] == {"0": "complete"}
+
+        (capture,) = orch.registry.get_captures(run.id, capture_id=cid)
+        assert capture["status"] == "complete", capture
+        assert capture["attrs"]["xplane"] is True, capture
+        arts = capture["artifacts"]
+        assert any(a.endswith("memory.prof") for a in arts), arts
+        assert any(a.endswith("hlo.txt") for a in arts), arts
+        assert any(f"profiles/{cid}/proc0/xplane/" in a for a in arts), arts
+
+        # The artifact tree is on disk under the run root...
+        paths = orch.layout.run_paths(run.uuid)
+        out = paths.profiles / cid / "proc0"
+        assert (out / "memory.prof").stat().st_size > 0
+        assert "train_step" in (out / "hlo.txt").read_text()
+        assert any(out.joinpath("xplane").rglob("*.xplane.pb"))
+        # ... and visible through the artifacts listing.
+        keys = orch.list_artifacts(run.id)
+        assert f"profiles/{cid}/proc0/memory.prof" in keys
+        assert f"profiles/{cid}/proc0/manifest.json" in keys
+
+        # Fetchable over HTTP: the per-capture manifest (with its merged
+        # chrome-trace window) and the raw artifact bytes.
+        async def fetch():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(create_app(orch)))
+            await client.start_server()
+            try:
+                doc = await (
+                    await client.get(f"/api/v1/runs/{run.id}/profiles/{cid}")
+                ).json()
+                resp = await client.get(
+                    f"/api/v1/runs/{run.id}/artifacts/profiles/{cid}/proc0/memory.prof"
+                )
+                blob = await resp.read()
+                return doc, resp.status, blob
+            finally:
+                await client.close()
+
+        doc, status, blob = asyncio.run(fetch())
+        assert doc["command"]["status"] == "complete"
+        assert doc["captures"][0]["process_id"] == 0
+        assert doc["window"]["start"] == capture["started_at"]
+        assert doc["trace"] is not None
+        assert status == 200 and len(blob) > 0
+
+        # Done diagnosing — the run doesn't need to finish 4000 steps.
+        orch.stop_run(run.id)
+        orch.wait(run.id, timeout=120)
+
+    def test_command_to_finished_run_expires(self, orch):
+        run = orch.submit(lm_spec(steps=2), name="expired-profile-e2e")
+        done = orch.wait(run.id, timeout=300)
+        assert done.is_done
+        cmd = orch.request_profile(run.id)
+        assert cmd["status"] == CommandStatus.EXPIRED
+        assert "finished" in cmd["message"]
+
+    def test_inflight_command_expires_when_run_dies(self, orch):
+        """A command the gang never honors (stopped mid-flight) resolves
+        to EXPIRED at terminal bookkeeping — never a hang."""
+        run = orch.submit(lm_spec(), name="stop-mid-profile-e2e")
+        _pump_until(
+            orch,
+            lambda: orch.registry.get_run(run.id).status == S.RUNNING,
+            240,
+            "the run to start",
+        )
+        cmd = orch.send_command(run.id, "profile", processes=[0])
+        orch.stop_run(run.id)
+        orch.wait(run.id, timeout=120)
+        row = orch.registry.get_command(cmd["uuid"])
+        assert row["status"] in (CommandStatus.EXPIRED, CommandStatus.COMPLETE)
+        assert row["status"] in CommandStatus.TERMINAL

@@ -1,0 +1,112 @@
+"""On-demand capture inside the serving decode loop.
+
+The engine honors a ``profile`` command for N decode iterations behind
+its readiness gate: the capture agent is armed via the mailbox, the
+scheduler thread drives the window, and the finalized record carries the
+decode step's HLO text alongside the memory snapshot.
+"""
+
+import json
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models import TransformerConfig, init_params
+from polyaxon_tpu.serving import ServingEngine
+from polyaxon_tpu.tracking.capture import configure as configure_capture
+
+CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    head_dim=8,
+    d_ff=64,
+    max_seq=48,
+    dtype=jnp.float32,
+)
+
+
+class _Reporter:
+    def __init__(self):
+        self.captures = []
+        self.commands = []
+
+    def capture(self, record):
+        self.captures.append(dict(record))
+
+    def command_event(self, uuid, state, message=None, **attrs):
+        self.commands.append({"uuid": uuid, "state": state, "message": message})
+
+
+@pytest.fixture()
+def capture_rig(tmp_path):
+    reporter = _Reporter()
+    mailbox = tmp_path / "commands" / "proc0"
+    mailbox.mkdir(parents=True)
+    agent = configure_capture(
+        reporter=reporter,
+        mailbox=mailbox,
+        profiles_root=tmp_path / "profiles",
+        process_id=0,
+    )
+    yield SimpleNamespace(
+        agent=agent, reporter=reporter, mailbox=mailbox, run_root=tmp_path
+    )
+    agent.close()
+    configure_capture(reporter=None, mailbox=None, profiles_root=None, process_id=0)
+
+
+@pytest.mark.e2e
+class TestServingCapture:
+    def test_decode_loop_honors_profile_command(self, capture_rig):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        eng = ServingEngine(params, CFG, slots=2, max_len=48).start()
+        try:
+            assert eng.wait_ready(timeout=60)
+            (capture_rig.mailbox / "servcap.json").write_text(
+                json.dumps(
+                    {
+                        "uuid": "servcap",
+                        "kind": "profile",
+                        "payload": {"num_steps": 3, "duration_s": 60.0},
+                    }
+                )
+            )
+            capture_rig.agent.poll()
+            assert capture_rig.reporter.commands[-1]["state"] == "acked"
+            # Decode traffic drives the window from the scheduler thread.
+            rng = np.random.default_rng(0)
+            req = eng.submit(list(rng.integers(0, CFG.vocab_size, 5)), 8)
+            req.wait(timeout=120)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                done = [
+                    c
+                    for c in capture_rig.reporter.captures
+                    if c.get("status") in ("complete", "failed")
+                ]
+                if done:
+                    break
+                time.sleep(0.05)
+            assert done, capture_rig.reporter.captures
+            record = done[-1]
+            assert record["status"] == "complete", record
+            assert record["num_steps"] == 3
+            out = capture_rig.run_root / "profiles" / "servcap" / "proc0"
+            assert (out / "memory.prof").stat().st_size > 0
+            # The decode step's lowered HLO text rode along.
+            hlo = (out / "hlo.txt").read_text()
+            assert "serving_decode_step" in hlo and len(hlo) > 100
+            assert (out / "manifest.json").exists()
+            assert capture_rig.reporter.commands[-1] == {
+                "uuid": "servcap",
+                "state": "complete",
+                "message": None,
+            }
+        finally:
+            eng.stop()

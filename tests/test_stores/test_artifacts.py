@@ -92,6 +92,25 @@ class TestRunSync:
         assert (paths.checkpoints / "ckpt-1").read_text() == "state"
         assert (paths.outputs / "model.bin").read_bytes() == b"\x00\x01"
 
+    def test_profiles_tree_is_store_synced(self, tmp_path):
+        """On-demand capture artifacts (profiles/<cid>/proc<N>/...) ride
+        the same run sync as outputs — durable past the local disk."""
+        layout = StoreLayout(tmp_path / "plat")
+        store = LocalArtifactStore(tmp_path / "store")
+        paths = layout.run_paths("u-2").ensure()
+        cap = paths.profiles / "cap1" / "proc0"
+        cap.mkdir(parents=True)
+        (cap / "memory.prof").write_bytes(b"mem")
+        # The launch-time StepProfiler dir rides along under outputs/.
+        prof = paths.outputs / "profile" / "plugins"
+        prof.mkdir(parents=True)
+        (prof / "host.xplane.pb").write_bytes(b"xp")
+        assert sync_run_up(store, paths, "u-2") == 2
+        assert store.exists(f"{run_prefix('u-2')}/profiles/cap1/proc0/memory.prof")
+        assert store.exists(
+            f"{run_prefix('u-2')}/outputs/profile/plugins/host.xplane.pb"
+        )
+
 
 class TestUrlDispatch:
     def test_file_url(self, tmp_path):

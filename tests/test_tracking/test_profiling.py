@@ -1,0 +1,198 @@
+"""StepProfiler window edges + failure hardening, annotate fallback,
+StepClock accounting.
+
+Profiling is diagnostics, never the workload: a broken profiler must
+warn-and-disable rather than take down the train loop, and ``close()``
+must be safe to call any number of times from any state.
+"""
+
+import contextlib
+
+import pytest
+
+from polyaxon_tpu.tracking import profiling as profiling_mod
+from polyaxon_tpu.tracking.profiling import StepClock, StepProfiler, annotate
+
+
+class _FakeProfiler:
+    """Records start/stop calls; optionally raises on either."""
+
+    def __init__(self, fail_start=False, fail_stop=False):
+        self.starts = []
+        self.stops = 0
+        self.fail_start = fail_start
+        self.fail_stop = fail_stop
+
+    def start_trace(self, path):
+        if self.fail_start:
+            raise RuntimeError("profiler already active")
+        self.starts.append(path)
+
+    def stop_trace(self):
+        if self.fail_stop:
+            raise RuntimeError("no trace running")
+        self.stops += 1
+
+
+@pytest.fixture()
+def fake_jax(monkeypatch):
+    """Patch the in-function ``import jax`` with a stub profiler."""
+    import sys
+    from types import SimpleNamespace
+
+    prof = _FakeProfiler()
+    stub = SimpleNamespace(profiler=prof)
+    monkeypatch.setitem(sys.modules, "jax", stub)
+    return prof
+
+
+class TestStepProfilerWindow:
+    def test_disabled_by_default(self, fake_jax, tmp_path):
+        p = StepProfiler(tmp_path)
+        assert not p.enabled
+        for i in range(5):
+            p.on_step(i)
+        p.close()
+        assert fake_jax.starts == [] and fake_jax.stops == 0
+
+    def test_exact_window(self, fake_jax, tmp_path):
+        p = StepProfiler(tmp_path, start_step=2, num_steps=3)
+        for i in range(10):
+            p.on_step(i)
+        assert len(fake_jax.starts) == 1
+        assert fake_jax.starts[0].endswith("profile")
+        assert fake_jax.stops == 1
+        p.close()
+        assert fake_jax.stops == 1  # window already closed; close() is a no-op
+
+    def test_start_at_step_zero(self, fake_jax, tmp_path):
+        p = StepProfiler(tmp_path, start_step=0, num_steps=1)
+        p.on_step(0)
+        p.on_step(1)
+        assert len(fake_jax.starts) == 1 and fake_jax.stops == 1
+
+    def test_window_past_end_closed_by_close(self, fake_jax, tmp_path):
+        """Loop ends mid-window — close() must stop the dangling trace."""
+        p = StepProfiler(tmp_path, start_step=3, num_steps=100)
+        for i in range(5):
+            p.on_step(i)
+        assert len(fake_jax.starts) == 1 and fake_jax.stops == 0
+        p.close()
+        assert fake_jax.stops == 1
+
+    def test_step_jump_past_window_stops_trace(self, fake_jax, tmp_path):
+        """A resumed loop can skip steps; landing past the window end must
+        still stop the trace."""
+        p = StepProfiler(tmp_path, start_step=1, num_steps=2)
+        p.on_step(1)
+        p.on_step(50)
+        assert fake_jax.stops == 1
+
+    def test_never_started_close_is_noop(self, fake_jax, tmp_path):
+        p = StepProfiler(tmp_path, start_step=90, num_steps=5)
+        p.on_step(1)
+        p.close()
+        p.close()
+        assert fake_jax.starts == [] and fake_jax.stops == 0
+
+
+class TestStepProfilerHardening:
+    def test_start_failure_warns_and_disables(self, monkeypatch, tmp_path, caplog):
+        import sys
+        from types import SimpleNamespace
+
+        prof = _FakeProfiler(fail_start=True)
+        monkeypatch.setitem(sys.modules, "jax", SimpleNamespace(profiler=prof))
+        p = StepProfiler(tmp_path, start_step=0, num_steps=2)
+        with caplog.at_level("WARNING", logger=profiling_mod.logger.name):
+            p.on_step(0)
+        assert any("start_trace" in r.message for r in caplog.records)
+        assert not p.enabled
+        # Later steps in the window never retry a broken profiler.
+        prof.fail_start = False
+        p.on_step(0)
+        p.on_step(1)
+        assert prof.starts == []
+        p.close()
+
+    def test_stop_failure_disables_and_close_stays_idempotent(
+        self, monkeypatch, tmp_path
+    ):
+        import sys
+        from types import SimpleNamespace
+
+        prof = _FakeProfiler(fail_stop=True)
+        monkeypatch.setitem(sys.modules, "jax", SimpleNamespace(profiler=prof))
+        p = StepProfiler(tmp_path, start_step=0, num_steps=1)
+        p.on_step(0)
+        p.on_step(1)  # stop blows up -> disabled, not raised
+        assert not p.enabled
+        p.close()
+        p.close()
+
+    def test_close_idempotent_mid_window(self, fake_jax, tmp_path):
+        p = StepProfiler(tmp_path, start_step=0, num_steps=10)
+        p.on_step(0)
+        p.close()
+        p.close()
+        assert fake_jax.stops == 1
+
+
+class TestAnnotate:
+    def test_fallback_nullcontext_when_jax_missing(self, monkeypatch):
+        import builtins
+
+        real_import = builtins.__import__
+
+        def no_jax(name, *a, **k):
+            if name == "jax":
+                raise ImportError("no jax here")
+            return real_import(name, *a, **k)
+
+        monkeypatch.setattr(builtins, "__import__", no_jax)
+        cm = annotate("step")
+        assert isinstance(cm, contextlib.nullcontext)
+        with cm:
+            pass
+
+    def test_returns_trace_annotation_when_available(self, monkeypatch):
+        import sys
+        from types import SimpleNamespace
+
+        class _Annot:
+            def __init__(self, name):
+                self.name = name
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+        stub = SimpleNamespace(profiler=SimpleNamespace(TraceAnnotation=_Annot))
+        monkeypatch.setitem(sys.modules, "jax", stub)
+        with annotate("fwd") as cm:
+            assert cm.name == "fwd"
+
+
+class TestStepClock:
+    def test_unarmed_first_tick_returns_none(self):
+        clock = StepClock()
+        assert clock.tick() is None  # start() never called
+        assert clock.tick() is not None
+
+    def test_summary_means(self):
+        clock = StepClock()
+        fake_now = [0.0]
+        clock._clock = lambda: fake_now[0]
+        clock.start()
+        for dt in (1.0, 3.0):
+            fake_now[0] += dt
+            clock.tick()
+        clock.add("data_wait_s", 0.5)
+        summary = clock.summary()
+        assert summary["step_wall_s"] == pytest.approx(2.0)
+        assert summary["data_wait_s"] == pytest.approx(0.25)
+
+    def test_empty_summary(self):
+        assert StepClock().summary() == {}

@@ -68,6 +68,77 @@ class TestFsyncPolicy:
         r.close()
 
 
+class TestCommandBusEvents:
+    def test_command_and_capture_lines_fsync(self, tmp_path, fsync_calls):
+        """Bus lifecycle lines are rare and load-bearing (a lost ack wedges
+        the roll-up) — they pay the disk sync like statuses do."""
+        r = Reporter(tmp_path / "p0.jsonl")
+        r.command_event("u1", "acked")
+        r.capture({"capture_id": "u1", "status": "complete"})
+        assert len(fsync_calls) == 2
+        r.close()
+
+    def test_command_event_shape(self, tmp_path):
+        r = Reporter(tmp_path / "p0.jsonl")
+        r.command_event("u1", "failed", message="boom")
+        r.close()
+        (line,) = _lines(tmp_path / "p0.jsonl")
+        assert line["type"] == "command"
+        assert line["uuid"] == "u1"
+        assert line["state"] == "failed"
+        assert line["message"] == "boom"
+
+    def test_capture_record_shape(self, tmp_path):
+        r = Reporter(tmp_path / "p0.jsonl")
+        r.capture(
+            {
+                "capture_id": "c1",
+                "status": "complete",
+                "artifacts": ["profiles/c1/proc0/memory.prof"],
+                "attrs": {"xplane": True},
+            }
+        )
+        r.close()
+        (line,) = _lines(tmp_path / "p0.jsonl")
+        assert line["type"] == "capture"
+        assert line["capture_id"] == "c1"
+        assert line["artifacts"] == ["profiles/c1/proc0/memory.prof"]
+        assert line["attrs"] == {"xplane": True}
+
+
+class TestBeatHooks:
+    def test_hooks_run_on_heartbeat(self, tmp_path):
+        r = Reporter(tmp_path / "p0.jsonl")
+        beats = []
+        r.add_beat_hook(lambda: beats.append(1))
+        r.start_heartbeat(interval=0.05)
+        import time as _t
+
+        deadline = _t.time() + 2.0
+        while not beats and _t.time() < deadline:
+            _t.sleep(0.01)
+        r.close()
+        assert beats  # ran at least on the immediate first beat
+
+    def test_broken_hook_never_kills_the_beat(self, tmp_path):
+        r = Reporter(tmp_path / "p0.jsonl")
+        calls = []
+
+        def bad():
+            raise RuntimeError("hook boom")
+
+        r.add_beat_hook(bad)
+        r.add_beat_hook(lambda: calls.append(1))
+        r.start_heartbeat(interval=0.05)
+        import time as _t
+
+        deadline = _t.time() + 2.0
+        while len(calls) < 2 and _t.time() < deadline:
+            _t.sleep(0.01)
+        r.close()
+        assert len(calls) >= 2  # kept beating past the broken hook
+
+
 class TestSpanEvent:
     def test_span_line_shape(self, tmp_path):
         r = Reporter(tmp_path / "p0.jsonl", process_id=2)

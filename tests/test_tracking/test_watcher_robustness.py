@@ -349,6 +349,190 @@ class TestAnomalyDetection:
         assert len(rows) == 2
 
 
+class TestUnknownLineKinds:
+    def test_unknown_kind_skip_and_warn(self, rig, caplog):
+        """Version skew — a newer worker's line kind against an older
+        control plane — must warn once and keep draining the file."""
+        registry, handle = rig
+        _append_raw(
+            handle.paths,
+            0,
+            [
+                json.dumps({"type": "quantum_teleport", "ts": 1.0, "payload": 1}),
+                _metric(3),
+            ],
+        )
+        with caplog.at_level("WARNING"):
+            GangWatcher(registry).ingest(handle)
+        assert [m["step"] for m in registry.get_metrics(handle.run_id)] == [3]
+        assert any("quantum_teleport" in r.message for r in caplog.records)
+
+
+class TestCommandAndCaptureIngestion:
+    def test_command_lines_roll_up_to_complete(self, rig):
+        registry, handle = rig
+        cmd = registry.enqueue_command(handle.run_id, "profile", expected=1)
+        _append_raw(
+            handle.paths,
+            0,
+            [
+                json.dumps(
+                    {"type": "command", "ts": 1.0, "uuid": cmd["uuid"], "state": "acked"}
+                ),
+                json.dumps(
+                    {
+                        "type": "command",
+                        "ts": 2.0,
+                        "uuid": cmd["uuid"],
+                        "state": "complete",
+                    }
+                ),
+            ],
+        )
+        GangWatcher(registry).ingest(handle)
+        row = registry.get_command(cmd["uuid"])
+        assert row["status"] == "complete"
+        assert row["acks"] == {"0": "complete"}
+
+    def test_command_line_missing_uuid_skipped(self, rig):
+        registry, handle = rig
+        _append_raw(
+            handle.paths,
+            0,
+            [json.dumps({"type": "command", "ts": 1.0, "state": "acked"}), _metric(5)],
+        )
+        GangWatcher(registry).ingest(handle)
+        assert [m["step"] for m in registry.get_metrics(handle.run_id)] == [5]
+
+    def test_capture_line_ingested_latest_wins(self, rig):
+        registry, handle = rig
+        started = json.dumps(
+            {
+                "type": "capture",
+                "ts": 1.0,
+                "capture_id": "cap1",
+                "status": "started",
+                "start_step": 10,
+                "num_steps": 5,
+                "started_at": 1.0,
+            }
+        )
+        done = json.dumps(
+            {
+                "type": "capture",
+                "ts": 2.0,
+                "capture_id": "cap1",
+                "status": "complete",
+                "finished_at": 2.0,
+                "artifacts": ["profiles/cap1/proc0/memory.prof"],
+                "attrs": {"steps_seen": 5},
+            }
+        )
+        _append_raw(handle.paths, 0, [started, done])
+        GangWatcher(registry).ingest(handle)
+        (row,) = registry.get_captures(handle.run_id)
+        assert row["capture_id"] == "cap1"
+        assert row["status"] == "complete"
+        # latest-wins merge keeps the earlier start fields
+        assert row["start_step"] == 10 and row["num_steps"] == 5
+        assert row["started_at"] == 1.0 and row["finished_at"] == 2.0
+        assert row["artifacts"] == ["profiles/cap1/proc0/memory.prof"]
+        assert row["attrs"]["steps_seen"] == 5
+
+    def test_torn_capture_line_skipped_not_fatal(self, rig, caplog):
+        """A capture record missing its capture_id (worker died mid-emit)
+        is a malformed line, not a poll-killer."""
+        registry, handle = rig
+        _append_raw(
+            handle.paths,
+            0,
+            [
+                json.dumps({"type": "capture", "ts": 1.0, "status": "started"}),
+                _metric(8),
+            ],
+        )
+        with caplog.at_level("WARNING"):
+            GangWatcher(registry).ingest(handle)
+        assert registry.get_captures(handle.run_id) == []
+        assert [m["step"] for m in registry.get_metrics(handle.run_id)] == [8]
+
+    def test_capture_completion_bumps_counter(self, rig):
+        registry, handle = rig
+        stats = MemoryStats()
+        _append_raw(
+            handle.paths,
+            0,
+            [
+                json.dumps(
+                    {
+                        "type": "capture",
+                        "ts": 1.0,
+                        "capture_id": "c2",
+                        "status": "complete",
+                    }
+                )
+            ],
+        )
+        GangWatcher(registry, stats=stats).ingest(handle)
+        assert stats.snapshot()["counters"]["profile_captures"] == 1
+
+
+class TestRegistryCommandStore:
+    def test_lifecycle_pending_acked_complete(self, rig):
+        registry, handle = rig
+        cmd = registry.enqueue_command(
+            handle.run_id, "profile", payload={"num_steps": 3}, expected=2
+        )
+        assert cmd["status"] == "pending"
+        assert cmd["payload"] == {"num_steps": 3}
+        registry.mark_command(cmd["uuid"], 0, "acked")
+        assert registry.get_command(cmd["uuid"])["status"] == "acked"
+        registry.mark_command(cmd["uuid"], 0, "complete")
+        # Only one of two expected processes terminal — still in flight.
+        assert registry.get_command(cmd["uuid"])["status"] == "acked"
+        row = registry.mark_command(cmd["uuid"], 1, "complete")
+        assert row["status"] == "complete"
+
+    def test_any_failed_process_fails_the_rollup(self, rig):
+        registry, handle = rig
+        cmd = registry.enqueue_command(handle.run_id, "profile", expected=2)
+        registry.mark_command(cmd["uuid"], 0, "complete")
+        row = registry.mark_command(cmd["uuid"], 1, "failed", message="boom")
+        assert row["status"] == "failed"
+        assert row["message"] == "boom"
+
+    def test_expire_commands_leaves_terminal_rows(self, rig):
+        registry, handle = rig
+        open_cmd = registry.enqueue_command(handle.run_id, "profile")
+        done_cmd = registry.enqueue_command(handle.run_id, "profile")
+        registry.mark_command(done_cmd["uuid"], 0, "complete")
+        assert registry.expire_commands(handle.run_id) == 1
+        assert registry.get_command(open_cmd["uuid"])["status"] == "expired"
+        assert registry.get_command(done_cmd["uuid"])["status"] == "complete"
+        # Late worker lines never un-resolve an expired command.
+        registry.mark_command(open_cmd["uuid"], 0, "complete")
+        assert registry.get_command(open_cmd["uuid"])["status"] == "expired"
+
+    def test_get_commands_filters(self, rig):
+        registry, handle = rig
+        registry.enqueue_command(handle.run_id, "profile")
+        registry.enqueue_command(handle.run_id, "checkpoint-now")
+        assert len(registry.get_commands(handle.run_id)) == 2
+        assert len(registry.get_commands(handle.run_id, kind="profile")) == 1
+        assert len(registry.get_commands(handle.run_id, status="pending")) == 2
+
+    def test_delete_run_cascades_commands_and_captures(self, rig):
+        registry, handle = rig
+        cmd = registry.enqueue_command(handle.run_id, "profile")
+        registry.upsert_capture(
+            handle.run_id, cmd["uuid"], 0, status="started"
+        )
+        registry.delete_run(handle.run_id)
+        assert registry.get_commands(handle.run_id) == []
+        assert registry.get_captures(handle.run_id) == []
+        assert registry.get_command(cmd["uuid"]) is None
+
+
 class TestRegistryAnomalyStore:
     def test_pagination_and_kind_filter(self, rig):
         registry, handle = rig
