@@ -328,6 +328,10 @@ def main() -> None:
     # budget is the detector threshold plus ingest/poll slack.
     stall_detect_s = None
     stall_detect_ok = None
+    alert_fire_latency_s = None
+    alert_fire_ok = None
+    alert_tick_us = None
+    alert_tick_overhead_ok = None
     try:
         import os
         import sys
@@ -342,6 +346,7 @@ def main() -> None:
             "POLYAXON_TPU_WATCHDOG_INTERVAL_S": "0.05",
             "POLYAXON_TPU_WATCHDOG_FLOOR_S": "0.6",
             "POLYAXON_TPU_WATCHDOG_CEILING_S": "2.0",
+            "POLYAXON_TPU_ALERT_INTERVAL_S": "0.05",
         }
         saved_env = {k: os.environ.get(k) for k in knobs}
         os.environ.update(knobs)
@@ -379,6 +384,15 @@ def main() -> None:
                 stall_detect_s = stalls[0]["created_at"] - max(
                     b for b in beats if b <= stalls[0]["created_at"]
                 )
+            # Alert-fire latency rides the same run: injection (last beat)
+            # → detector → rule engine tick → FIRING row's fired_at.  The
+            # run_stalled row is resolved at teardown but keeps fired_at.
+            alerts = orch.registry.get_alerts(run.id, rule="run_stalled")
+            if alerts and alerts[0]["fired_at"] and beats:
+                fired_at = alerts[0]["fired_at"]
+                before = [b for b in beats if b <= fired_at]
+                if before:
+                    alert_fire_latency_s = fired_at - max(before)
         finally:
             orch.stop()
             for k, v in saved_env.items():
@@ -400,6 +414,63 @@ def main() -> None:
         else:
             print(
                 "bench: stalling gang produced no stall anomaly row",
+                file=sys.stderr,
+            )
+        if alert_fire_latency_s is not None:
+            # Detection budget plus one engine tick of slack: the rule
+            # engine rides the detector, it must not add seconds on top.
+            alert_fire_ok = 0.0 < alert_fire_latency_s < stall_after_s + 3.0
+            if not alert_fire_ok:
+                print(
+                    f"bench: alert_fire_latency_s={alert_fire_latency_s:.2f} "
+                    f"outside budget ({stall_after_s} + 3.0s slack) — the "
+                    "alert engine lags its detector",
+                    file=sys.stderr,
+                )
+        else:
+            print(
+                "bench: stalling gang produced no firing run_stalled alert",
+                file=sys.stderr,
+            )
+
+        # Idle-tick overhead: one full catalog evaluation over a healthy
+        # run (no open alerts) must stay in microsecond territory — it
+        # rides every monitor tick for every live gang forever.
+        import pathlib
+
+        from polyaxon_tpu.db.registry import RunRegistry
+        from polyaxon_tpu.monitor.alerts import AlertEngine
+        from polyaxon_tpu.stats.backends import MemoryStats
+
+        idle_reg = RunRegistry(
+            pathlib.Path(tempfile.mkdtemp()) / "bench-alerts.db"
+        )
+        try:
+            idle_run = idle_reg.create_run(
+                {
+                    "kind": "experiment",
+                    "run": {"entrypoint": "noop:main"},
+                    "environment": {
+                        "topology": {"accelerator": "cpu", "num_devices": 1}
+                    },
+                }
+            )
+            idle_engine = AlertEngine(
+                idle_reg, stats=MemoryStats(), interval_s=0
+            )
+            idle_engine.evaluate(idle_run.id)  # warm sqlite/caches
+            n_ticks = 200
+            t0 = time.perf_counter()
+            for _ in range(n_ticks):
+                idle_engine.evaluate(idle_run.id)
+            alert_tick_us = (time.perf_counter() - t0) / n_ticks * 1e6
+        finally:
+            idle_reg.close()
+        alert_tick_overhead_ok = alert_tick_us < 5000.0
+        if not alert_tick_overhead_ok:
+            print(
+                f"bench: alert_tick_us={alert_tick_us:.1f} over the 5ms "
+                "budget — rule evaluation is taxing the monitor loop",
                 file=sys.stderr,
             )
     except Exception:
@@ -1137,6 +1208,18 @@ def main() -> None:
                     else None
                 ),
                 "stall_detect_ok": stall_detect_ok,
+                "alert_fire_latency_s": (
+                    round(alert_fire_latency_s, 2)
+                    if alert_fire_latency_s is not None
+                    else None
+                ),
+                "alert_fire_ok": alert_fire_ok,
+                "alert_tick_us": (
+                    round(alert_tick_us, 1)
+                    if alert_tick_us is not None
+                    else None
+                ),
+                "alert_tick_overhead_ok": alert_tick_overhead_ok,
                 "profile_roundtrip_s": (
                     round(profile_roundtrip_s, 2)
                     if profile_roundtrip_s is not None

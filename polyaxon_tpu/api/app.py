@@ -280,6 +280,15 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         payload["anomalies"] = status
         # Goodput/MFU roll-up block (no timeline — /goodput serves that).
         payload["goodput"] = goodput_status(reg, run.id, timeline_limit=0)
+        # Alert roll-up: current lifecycle state per rule + counts, so the
+        # detail view answers "is anything paging on this run" directly.
+        alert_rows = reg.get_alerts(run.id)
+        payload["alerts"] = {
+            "firing": sum(1 for r in alert_rows if r["state"] == "firing"),
+            "pending": sum(1 for r in alert_rows if r["state"] == "pending"),
+            "resolved": sum(1 for r in alert_rows if r["state"] == "resolved"),
+            "results": alert_rows,
+        }
         return web.json_response(payload)
 
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/stop")
@@ -461,6 +470,57 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             # a finished run cannot be currently stalled or straggling.
             status.update(stalled=False, stall_age_s=0.0, stragglers=[])
         return web.json_response({"results": rows, "status": status})
+
+    # -- alerts (rule-engine lifecycle feed) ----------------------------------
+    def _visible_alert_rows(request, rows):
+        """Project-ACL filter for cluster-wide alert rows: one decision per
+        run, same invisibility rule as the run list."""
+        decided: Dict[int, bool] = {}
+        out = []
+        for row in rows:
+            rid = row["run_id"]
+            if rid not in decided:
+                try:
+                    run = reg.get_run(rid)
+                    decided[rid] = not _project_denied(request, run.project)
+                except PolyaxonTPUError:
+                    decided[rid] = False
+            if decided[rid]:
+                out.append(row)
+        return out
+
+    @routes.get(f"{API_PREFIX}/alerts")
+    async def list_alerts(request):
+        # Cluster-wide alert feed: latest state per (run, rule), pageable
+        # by transition (?since_id=), filterable by state/severity/rule/run.
+        rows = reg.get_alerts(
+            run_id=_int_param(request, "run_id"),
+            state=request.query.get("state"),
+            severity=request.query.get("severity"),
+            rule=request.query.get("rule"),
+            since_id=_int_param(request, "since_id", 0),
+            limit=_int_param(request, "limit"),
+        )
+        engine = getattr(orch, "alerts", None)
+        return web.json_response(
+            {
+                "results": _visible_alert_rows(request, rows),
+                "engine": engine.status() if engine is not None else None,
+            }
+        )
+
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/alerts")
+    async def get_run_alerts(request):
+        run = _run_or_404(request)
+        rows = reg.get_alerts(
+            run.id,
+            state=request.query.get("state"),
+            severity=request.query.get("severity"),
+            rule=request.query.get("rule"),
+            since_id=_int_param(request, "since_id", 0),
+            limit=_int_param(request, "limit"),
+        )
+        return web.json_response({"results": rows})
 
     # -- on-demand device profiling (run command bus) -------------------------
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/profile")
@@ -1033,9 +1093,13 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         )
 
     # -- live streaming (WS) --------------------------------------------------
-    async def _ws_tail(request, fetch, poll: float = 0.5):
-        """Generic WS tail loop: push new rows until the run is done."""
-        run = _run_or_404(request)
+    async def _ws_tail(request, fetch, poll: float = 0.5, scoped: bool = True):
+        """Generic WS tail loop: push new rows until the run is done.
+
+        ``scoped=False`` is the cluster-feed variant (no run in the path):
+        ``fetch`` gets None for the run id and the loop never sees a
+        terminal run, so it streams until the client hangs up."""
+        run = _run_or_404(request) if scoped else None
         # Select ONLY the fixed ``bearer`` name (browsers abort the
         # handshake if the server selects none of the offered protocols,
         # so the dashboard offers ['bearer', 'bearer.<token>']).  Echoing
@@ -1050,15 +1114,15 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 # The run can be DELETEd out from under a live tail; close
                 # the stream cleanly instead of crashing the handler.
                 try:
-                    rows = fetch(run.id, cursor)
-                    current = reg.get_run(run.id)
+                    rows = fetch(run.id if run else None, cursor)
+                    current = reg.get_run(run.id) if run else None
                 except PolyaxonTPUError:
                     await ws.send_json({"event": "deleted"})
                     break
                 for row in rows:
                     cursor = max(cursor, row.get("id", cursor))
                     await ws.send_json(row)
-                if current.is_done and not rows:
+                if current is not None and current.is_done and not rows:
                     await ws.send_json({"event": "done", "status": current.status})
                     break
                 try:
@@ -1081,6 +1145,22 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
     async def ws_metrics(request):
         return await _ws_tail(
             request, lambda rid, cur: reg.get_metrics(rid, since_id=cur)
+        )
+
+    @routes.get("/ws/v1/alerts")
+    async def ws_alerts(request):
+        # Cluster-wide live alert tail: every lifecycle transition is a
+        # fresh row id, so the generic cursor loop streams exactly the
+        # pending→firing→resolved edges (ACL-filtered like the REST feed).
+        state = request.query.get("state")
+        severity = request.query.get("severity")
+        return await _ws_tail(
+            request,
+            lambda _rid, cur: _visible_alert_rows(
+                request,
+                reg.get_alerts(since_id=cur, state=state, severity=severity),
+            ),
+            scoped=False,
         )
 
     # -- users (per-user tokens; reference scopes/ + user models) --------------

@@ -67,6 +67,11 @@ def stalling(ctx: Context) -> None:
     without beating while its peers advance ``peer_steps`` more — which is
     what distinguishes a gang-wide *stall* (everyone silent, heartbeats
     fresh) from a *straggler* (one host falling behind the gang median).
+
+    ``recover_steps`` > 0 makes the victim resume beating after the sleep
+    (``recover_interval`` apart) — a stall that *clears* while the gang is
+    still running, which is what the alert engine's firing → resolved
+    transition needs to be tested against honestly.
     """
     progress = get_progress()
     warm = int(ctx.get_param("warm_steps", 5))
@@ -75,8 +80,13 @@ def stalling(ctx: Context) -> None:
         progress.beat(step=i)
         time.sleep(interval)
     victim = int(ctx.get_param("stall_process", -1))
+    recover = int(ctx.get_param("recover_steps", 0))
     if victim in (-1, ctx.process_id):
         time.sleep(float(ctx.get_param("stall_s", 2.0)))
+        recover_interval = float(ctx.get_param("recover_interval", interval))
+        for i in range(warm, warm + recover):
+            progress.beat(step=i)
+            time.sleep(recover_interval)
     else:
         for i in range(warm, warm + int(ctx.get_param("peer_steps", 100))):
             progress.beat(step=i)

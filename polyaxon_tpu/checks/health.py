@@ -87,6 +87,35 @@ def check_compile_cache(orch) -> Tuple[bool, str]:
     return True, f"{entries} cached executable(s) at {cache_dir}; {local}"
 
 
+def check_alerts(orch) -> Tuple[bool, str]:
+    """Alert-engine liveness: is the rule engine ticking, and are rule
+    evaluations erroring (counted, never raised — so /status is the place
+    they surface).  Unhealthy only when gangs are live but the engine has
+    not ticked for many multiples of its interval — an idle control plane
+    legitimately never ticks."""
+    engine = getattr(orch, "alerts", None)
+    if engine is None:
+        return True, "alert engine not wired"
+    st = engine.status()
+    errors = f", {st['eval_errors']} rule-eval error(s)" if st["eval_errors"] else ""
+    gangs = getattr(getattr(orch, "ctx", None), "gangs", None) or {}
+    if not st["ticks"]:
+        if gangs:
+            return False, (
+                f"{len(gangs)} live gang(s) but the engine has never ticked"
+            )
+        return True, f"{len(st['rules'])} rules armed, no live runs yet{errors}"
+    age = time.time() - st["last_tick_at"]
+    if gangs and age > max(10.0, 10 * st["interval_s"]):
+        return False, (
+            f"last tick {age:.0f}s ago with {len(gangs)} live gang(s){errors}"
+        )
+    return True, (
+        f"{len(st['rules'])} rules, {st['ticks']} ticks, "
+        f"last {age:.1f}s ago{errors}"
+    )
+
+
 def check_devices(orch) -> Tuple[bool, str]:
     """Accelerator visibility — only meaningful in-process on a worker/bench
     host; the control plane itself may legitimately be CPU-only."""
@@ -106,6 +135,7 @@ CHECKS: Dict[str, Callable] = {
     "stores": check_stores,
     "heartbeats": check_heartbeats,
     "compile_cache": check_compile_cache,
+    "alerts": check_alerts,
 }
 
 

@@ -130,6 +130,10 @@ _ALL = [
     Option("notifier.email_tls", bool, False, "STARTTLS before sending"),
     Option("notifier.email_user", str, "", "SMTP login ('' = no auth)"),
     Option("notifier.email_password", str, "", "SMTP password", secret=True),
+    Option("notifier.alert_routes", str, "",
+           "severity→sink routing for alert-engine notifications, e.g. "
+           "'critical:webhook,email;warning:webhook;info:log' "
+           "('' = every severity to every sink; restart required)"),
     Option("groups.max_concurrency", int, 64,
            "upper bound on a sweep's concurrency setting"),
     Option("restarts.max_allowed", int, 10,

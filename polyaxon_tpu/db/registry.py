@@ -324,6 +324,26 @@ CREATE TABLE IF NOT EXISTS captures (
     UNIQUE (run_id, capture_id, process_id)
 );
 CREATE INDEX IF NOT EXISTS ix_captures_run ON captures (run_id);
+
+CREATE TABLE IF NOT EXISTS alerts (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_id INTEGER NOT NULL,
+    rule TEXT NOT NULL,
+    state TEXT NOT NULL,
+    severity TEXT NOT NULL,
+    message TEXT,
+    value REAL,
+    for_s REAL,
+    episodes INTEGER NOT NULL DEFAULT 0,
+    pending_since REAL,
+    fired_at REAL,
+    resolved_at REAL,
+    attrs TEXT,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL,
+    UNIQUE (run_id, rule)
+);
+CREATE INDEX IF NOT EXISTS ix_alerts_run ON alerts (run_id);
 """
 
 
@@ -344,6 +364,33 @@ class CommandStatus:
     EXPIRED = "expired"
 
     TERMINAL = (COMPLETE, FAILED, EXPIRED)
+
+
+class AlertState:
+    """Lifecycle of an alert-rule evaluation (Alertmanager-shaped).
+
+    PENDING (predicate violated, inside the ``for_s`` hold-down) → FIRING
+    (held long enough; notifications routed) → RESOLVED (predicate healthy
+    again, or the run finished mid-episode).  A pending alert that recovers
+    before the hold-down elapses is dropped silently — that is the flap
+    suppression.  One row per (run, rule) holds the latest state; every
+    state *transition* re-inserts the row with a fresh id so since_id
+    pagers and the WS tail see transitions, not steady-state churn.
+    """
+
+    PENDING = "pending"
+    FIRING = "firing"
+    RESOLVED = "resolved"
+
+    ACTIVE = (PENDING, FIRING)
+
+
+class AlertSeverity:
+    INFO = "info"
+    WARNING = "warning"
+    CRITICAL = "critical"
+
+    ALL = (INFO, WARNING, CRITICAL)
 
 
 def accelerator_family(accelerator: str) -> str:
@@ -737,6 +784,7 @@ class RunRegistry:
                 ("utilization", "run_id"),
                 ("commands", "run_id"),
                 ("captures", "run_id"),
+                ("alerts", "run_id"),
                 ("heartbeats", "run_id"),
                 ("processes", "run_id"),
                 ("bookmarks", "run_id"),
@@ -1451,6 +1499,133 @@ class RunRegistry:
             out.append(row)
         return out
 
+    # -- alerts (rule-engine lifecycle rows) -----------------------------------
+    def upsert_alert(
+        self,
+        run_id: int,
+        rule: str,
+        *,
+        state: str,
+        severity: str,
+        message: Optional[str] = None,
+        value: Optional[float] = None,
+        for_s: Optional[float] = None,
+        episodes: Optional[int] = None,
+        pending_since: Optional[float] = None,
+        fired_at: Optional[float] = None,
+        resolved_at: Optional[float] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+        now: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Latest-state-per-(run, rule) alert row, like ``captures`` — but
+        each transition REPLACEs the row so it gets a fresh autoincrement
+        id: the feed stays one-row-per-alert while since_id pagers and the
+        WS tail still observe every lifecycle edge.  ``pending_since`` /
+        ``fired_at`` / ``episodes`` carry forward from the previous row
+        when not supplied, so a resolve keeps its firing timestamp (that
+        difference IS the alert latency bench reads)."""
+        now = now or time.time()
+        with self._lock, self._conn() as conn:
+            prev = conn.execute(
+                "SELECT * FROM alerts WHERE run_id = ? AND rule = ?",
+                (run_id, str(rule)),
+            ).fetchone()
+            created_at = prev["created_at"] if prev else now
+            if episodes is None:
+                episodes = prev["episodes"] if prev else 0
+            if pending_since is None and prev is not None:
+                pending_since = prev["pending_since"]
+            if fired_at is None and prev is not None:
+                fired_at = prev["fired_at"]
+            cur = conn.execute(
+                """INSERT OR REPLACE INTO alerts
+                   (run_id, rule, state, severity, message, value, for_s,
+                    episodes, pending_since, fired_at, resolved_at, attrs,
+                    created_at, updated_at)
+                   VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)""",
+                (
+                    run_id,
+                    str(rule),
+                    str(state),
+                    str(severity),
+                    message,
+                    value,
+                    for_s,
+                    int(episodes),
+                    pending_since,
+                    fired_at,
+                    resolved_at,
+                    json.dumps(attrs, default=str) if attrs else None,
+                    created_at,
+                    now,
+                ),
+            )
+            row_id = cur.lastrowid
+        return {
+            "id": row_id,
+            "run_id": run_id,
+            "rule": str(rule),
+            "state": str(state),
+            "severity": str(severity),
+            "message": message,
+            "value": value,
+            "for_s": for_s,
+            "episodes": int(episodes),
+            "pending_since": pending_since,
+            "fired_at": fired_at,
+            "resolved_at": resolved_at,
+            "attrs": attrs or {},
+            "created_at": created_at,
+            "updated_at": now,
+        }
+
+    def get_alerts(
+        self,
+        run_id: Optional[int] = None,
+        *,
+        state: Optional[str] = None,
+        severity: Optional[str] = None,
+        rule: Optional[str] = None,
+        since_id: int = 0,
+        limit: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        """Alert rows, cluster-wide when ``run_id`` is None — the /alerts
+        feed.  since_id pages by transition (REPLACE bumps the id)."""
+        sql = "SELECT * FROM alerts WHERE id > ?"
+        params: List[Any] = [since_id]
+        if run_id is not None:
+            sql += " AND run_id = ?"
+            params.append(run_id)
+        if state is not None:
+            sql += " AND state = ?"
+            params.append(str(state))
+        if severity is not None:
+            sql += " AND severity = ?"
+            params.append(str(severity))
+        if rule is not None:
+            sql += " AND rule = ?"
+            params.append(str(rule))
+        sql += " ORDER BY id"
+        if limit is not None:
+            sql += f" LIMIT {int(limit)}"
+        rows = self._conn().execute(sql, params).fetchall()
+        out: List[Dict[str, Any]] = []
+        for r in rows:
+            row = dict(r)
+            row["attrs"] = json.loads(row["attrs"]) if row["attrs"] else {}
+            out.append(row)
+        return out
+
+    def delete_alert(self, run_id: int, rule: str) -> bool:
+        """Drop a (run, rule) alert row — a pending alert that recovered
+        inside its hold-down vanishes instead of becoming a resolve edge."""
+        with self._lock, self._conn() as conn:
+            cur = conn.execute(
+                "DELETE FROM alerts WHERE run_id = ? AND rule = ?",
+                (run_id, str(rule)),
+            )
+        return cur.rowcount > 0
+
     def stale_queued_runs(
         self, ttl_seconds: float, now: Optional[float] = None
     ) -> List[Run]:
@@ -1919,6 +2094,13 @@ class RunRegistry:
                    (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
                 (cutoff, cutoff),
             ).rowcount
+            # Retention keys off updated_at: an alert row's created_at is its
+            # FIRST transition, and a long-lived firing alert must survive.
+            alerts = conn.execute(
+                """DELETE FROM alerts WHERE updated_at < ? AND run_id IN
+                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
+                (cutoff, cutoff),
+            ).rowcount
         return {
             "activity": act,
             "logs": logs,
@@ -1927,6 +2109,7 @@ class RunRegistry:
             "utilization": utilization,
             "commands": commands,
             "captures": captures,
+            "alerts": alerts,
         }
 
     # -- projects (entity metadata over runs.project) --------------------------

@@ -66,6 +66,10 @@ class EventTypes:
     CLUSTER_NODE_UPDATED = "cluster.node_updated"
     PLATFORM_HEALTH = "platform.health"
 
+    # alerts (the monitor/alerts.py rule engine's lifecycle edges)
+    ALERT_FIRING = "alert.firing"
+    ALERT_RESOLVED = "alert.resolved"
+
     # entities (events/registry/{project,user,search,bookmark}.py)
     PROJECT_CREATED = "project.created"
     PROJECT_DELETED = "project.deleted"

@@ -1,3 +1,4 @@
+from polyaxon_tpu.monitor.alerts import AlertEngine
 from polyaxon_tpu.monitor.watcher import GangWatcher
 
-__all__ = ["GangWatcher"]
+__all__ = ["AlertEngine", "GangWatcher"]

@@ -1,0 +1,671 @@
+"""Alert & SLO engine: continuous rule evaluation over platform signals.
+
+Alertmanager-shaped, registry-backed.  The control-plane scheduler ticks
+:class:`AlertEngine` alongside the ``GangWatcher`` (same monitor task, same
+cadence); each tick evaluates a catalog of :class:`AlertRule` predicates
+over what the registry and stats layer already hold — stall/straggler
+roll-ups (``anomaly_status``), goodput/MFU ratios (``goodput_status``),
+heartbeat staleness, serving latency histogram quantiles, steady-state
+recompiles, compile-cache miss ratios — and drives each (run, rule) pair
+through a **pending → firing → resolved** lifecycle:
+
+- a violated predicate enters PENDING and must stay violated for the
+  rule's ``for_s`` hold-down before it FIRES (flap suppression: a pending
+  alert that recovers inside the hold-down vanishes without a trace);
+- FIRING and RESOLVED are *edges*, exactly like the PR 4 anomaly
+  detector: each routes one notification through the auditor
+  (``alert.firing`` / ``alert.resolved`` events → ``AlertRouter`` →
+  webhook/email/log sinks) and re-inserts the registry ``alerts`` row so
+  since_id pagers and the WS tail observe the transition;
+- gauges (``alert_state{rule,run,severity}``: 0 ok / 1 pending /
+  2 firing) recover to 0 on resolve and on a run going terminal
+  mid-episode — the same discipline as ``run_stall_age_s``.
+
+Rule parameters resolve per evaluation:
+run declarations (``alert.<rule>.<param>``) → env knob
+(``POLYAXON_TPU_ALERT_<RULE>_<PARAM>``) → rule default.  Rule evaluation
+errors are counted (``alert_eval_errors``), never raised — a broken rule
+must not take the monitor loop down with it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from polyaxon_tpu.db.registry import (
+    AlertSeverity,
+    AlertState,
+    Run,
+    RunRegistry,
+)
+from polyaxon_tpu.events import EventTypes
+from polyaxon_tpu.monitor.watcher import anomaly_status, goodput_status
+from polyaxon_tpu.stats.metrics import labeled_key
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "AlertEngine",
+    "AlertRule",
+    "RuleContext",
+    "default_rules",
+    "alert_gauge_key",
+]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def alert_gauge_key(rule: str, run_id: int, severity: str) -> str:
+    return labeled_key(
+        "alert_state", rule=rule, run=str(run_id), severity=severity
+    )
+
+
+#: Gauge values per lifecycle state (``alert_state`` exposition).
+GAUGE_OK = 0.0
+GAUGE_PENDING = 1.0
+GAUGE_FIRING = 2.0
+
+
+class RuleContext:
+    """One tick's evaluation inputs for one run.
+
+    Registry roll-ups (``anomaly_status`` / ``goodput_status`` / stats
+    snapshot) are computed lazily and cached for the tick, so a catalog of
+    N rules costs one read per *signal*, not per rule.
+    """
+
+    def __init__(
+        self,
+        registry: RunRegistry,
+        run: Run,
+        *,
+        stats: Any = None,
+        now: Optional[float] = None,
+    ) -> None:
+        self.registry = registry
+        self.run = run
+        self.stats = stats
+        self.now = now if now is not None else time.time()
+        self._anomaly: Optional[Dict[str, Any]] = None
+        self._goodput: Optional[Dict[str, Any]] = None
+        self._snapshot: Optional[Dict[str, Any]] = None
+        self._overrides: Optional[Dict[str, Any]] = None
+
+    # -- cached signal reads ---------------------------------------------------
+    @property
+    def anomaly(self) -> Dict[str, Any]:
+        if self._anomaly is None:
+            self._anomaly = anomaly_status(
+                self.registry, self.run.id, now=self.now
+            )
+        return self._anomaly
+
+    @property
+    def goodput(self) -> Dict[str, Any]:
+        if self._goodput is None:
+            self._goodput = goodput_status(
+                self.registry, self.run.id, timeline_limit=0
+            )
+        return self._goodput
+
+    @property
+    def snapshot(self) -> Dict[str, Any]:
+        if self._snapshot is None:
+            snap = getattr(self.stats, "snapshot", None)
+            self._snapshot = snap() if callable(snap) else {}
+        return self._snapshot
+
+    def counter(self, key: str) -> float:
+        return float(self.snapshot.get("counters", {}).get(key, 0) or 0)
+
+    def histogram_quantile(self, key: str, q: float) -> Optional[float]:
+        """Quantile estimate from the stats backend's histogram state, or
+        None when the series has never been observed in this process."""
+        state = self.snapshot.get("histograms", {}).get(key)
+        if not state or not state.get("count"):
+            return None
+        # Re-walk the bucket counts (Histogram.quantile over a state dict).
+        edges = state["edges"]
+        counts = state["counts"]
+        target = max(1.0, q * state["count"])
+        running = 0
+        for i, n in enumerate(counts):
+            if n and running + n >= target:
+                lo = edges[i - 1] if i > 0 else 0.0
+                hi = edges[i] if i < len(edges) else edges[-1]
+                return lo + (hi - lo) * ((target - running) / n)
+            running += n
+        return float(edges[-1])
+
+    def dump_artifact(self, kind: str) -> Optional[str]:
+        """Run-relative flight-recorder dump key from the newest anomaly
+        row of ``kind``, so the alert payload links to the postmortem."""
+        try:
+            rows = self.registry.get_anomalies(self.run.id, kind=kind)
+        except Exception:
+            return None
+        for row in reversed(rows):
+            key = (row.get("attrs") or {}).get("dump_artifact")
+            if key:
+                return str(key)
+        return None
+
+    # -- parameter resolution --------------------------------------------------
+    @property
+    def overrides(self) -> Dict[str, Any]:
+        """Per-run ``alert.*`` declarations, stripped of the prefix."""
+        if self._overrides is None:
+            decls = (self.run.spec_data or {}).get("declarations") or {}
+            self._overrides = {
+                k[len("alert."):]: v
+                for k, v in decls.items()
+                if isinstance(k, str) and k.startswith("alert.")
+            }
+        return self._overrides
+
+    def param(self, rule: str, name: str, default: float) -> float:
+        """``alert.<rule>.<name>`` declaration → env knob → default."""
+        val = self.overrides.get(f"{rule}.{name}")
+        if val is not None:
+            try:
+                return float(val)
+            except (TypeError, ValueError):
+                pass
+        return _env_float(
+            f"POLYAXON_TPU_ALERT_{rule.upper()}_{name.upper()}", default
+        )
+
+    def enabled(self, rule: str) -> bool:
+        val = self.overrides.get(f"{rule}.enabled")
+        if val is None:
+            val = os.environ.get(f"POLYAXON_TPU_ALERT_{rule.upper()}_ENABLED")
+        if val is None:
+            return True
+        return str(val).lower() not in ("0", "false", "no", "off")
+
+
+@dataclass
+class AlertRule:
+    """One predicate in the catalog.
+
+    ``check(ctx)`` returns None when healthy, or a violation dict —
+    ``{"value": float, "message": str, ...attrs}`` — when the predicate
+    holds.  ``for_s`` is the hold-down: how long the violation must
+    persist before the alert fires (overridable per run/env like every
+    other param, via ``param(rule, "for_s", ...)``).
+    """
+
+    name: str
+    severity: str
+    for_s: float
+    check: Callable[[RuleContext], Optional[Dict[str, Any]]]
+    description: str = ""
+
+
+# -- built-in rule catalog ------------------------------------------------------
+
+
+def _check_run_stalled(ctx: RuleContext) -> Optional[Dict[str, Any]]:
+    status = ctx.anomaly
+    if not status["stalled"]:
+        return None
+    out: Dict[str, Any] = {
+        "value": float(status["stall_age_s"]),
+        "message": (
+            f"gang alive but no progress for {status['stall_age_s']:.1f}s"
+        ),
+        "steps": [r["step"] for r in status["progress"]],
+    }
+    dump = ctx.dump_artifact("stall")
+    if dump:
+        out["dump_artifact"] = dump
+    return out
+
+
+def _check_gang_straggler(ctx: RuleContext) -> Optional[Dict[str, Any]]:
+    stragglers = ctx.anomaly["stragglers"]
+    if not stragglers:
+        return None
+    worst = max(stragglers, key=lambda s: s["lag_steps"])
+    return {
+        "value": float(worst["lag_steps"]),
+        "message": (
+            f"proc {worst['process_id']} lags the gang median by "
+            f"{worst['lag_steps']:.0f} steps"
+        ),
+        "stragglers": stragglers,
+    }
+
+
+def _check_heartbeat_stale(ctx: RuleContext) -> Optional[Dict[str, Any]]:
+    hb = ctx.registry.last_heartbeat(ctx.run.id)
+    if hb is None:
+        return None  # never phoned home — reconcile's problem, not an SLO's
+    threshold = ctx.param("heartbeat_stale", "threshold_s", 120.0)
+    age = ctx.now - hb
+    if age <= threshold:
+        return None
+    return {
+        "value": float(age),
+        "message": f"last gang heartbeat {age:.1f}s ago (> {threshold:.0f}s)",
+        "threshold_s": threshold,
+    }
+
+
+def _check_goodput_low(ctx: RuleContext) -> Optional[Dict[str, Any]]:
+    floor = ctx.param("goodput_low", "floor", 0.0)
+    if floor <= 0:
+        return None  # off until an SLO is declared
+    gp = ctx.goodput
+    min_wall = ctx.param("goodput_low", "min_wall_s", 60.0)
+    if not gp["rows"] or gp["wall_s"] < min_wall:
+        return None
+    if gp["goodput_ratio"] >= floor:
+        return None
+    return {
+        "value": float(gp["goodput_ratio"]),
+        "message": (
+            f"goodput {gp['goodput_ratio']:.3f} below SLO floor {floor:.3f}"
+        ),
+        "floor": floor,
+    }
+
+
+def _check_mfu_low(ctx: RuleContext) -> Optional[Dict[str, Any]]:
+    floor = ctx.param("mfu_low", "floor", 0.0)
+    if floor <= 0:
+        return None
+    gp = ctx.goodput
+    min_wall = ctx.param("mfu_low", "min_wall_s", 60.0)
+    if not gp["rows"] or gp["wall_s"] < min_wall:
+        return None
+    if gp["mfu"] >= floor:
+        return None
+    return {
+        "value": float(gp["mfu"]),
+        "message": f"MFU {gp['mfu']:.3f} below SLO floor {floor:.3f}",
+        "floor": floor,
+    }
+
+
+def _check_serving_ttft_p99(ctx: RuleContext) -> Optional[Dict[str, Any]]:
+    threshold = ctx.param("serving_ttft_p99", "threshold_s", 0.0)
+    if threshold <= 0:
+        return None
+    p99 = ctx.histogram_quantile("serving.ttft_s", 0.99)
+    if p99 is None or p99 <= threshold:
+        return None
+    return {
+        "value": float(p99),
+        "message": f"serving TTFT p99 {p99:.3f}s above SLO {threshold:.3f}s",
+        "threshold_s": threshold,
+    }
+
+
+def _check_steady_state_compiles(ctx: RuleContext) -> Optional[Dict[str, Any]]:
+    compiles = ctx.counter("serving.steady_state_compiles")
+    if compiles <= 0:
+        return None
+    return {
+        "value": float(compiles),
+        "message": (
+            f"{compiles:.0f} recompilations after warmup — the "
+            f"zero-recompile invariant is broken"
+        ),
+    }
+
+
+def _check_compile_cache_miss(ctx: RuleContext) -> Optional[Dict[str, Any]]:
+    gp = ctx.goodput
+    hits = gp["compile_cache_hits"]
+    misses = gp["compile_cache_misses"]
+    events = hits + misses
+    min_events = ctx.param("compile_cache_miss", "min_events", 8.0)
+    if events < min_events:
+        return None
+    ratio = misses / events
+    threshold = ctx.param("compile_cache_miss", "ratio", 0.5)
+    if ratio <= threshold:
+        return None
+    return {
+        "value": float(ratio),
+        "message": (
+            f"compile cache miss ratio {ratio:.2f} "
+            f"({misses}/{events} events) above {threshold:.2f}"
+        ),
+        "hits": hits,
+        "misses": misses,
+    }
+
+
+def default_rules() -> List[AlertRule]:
+    """The built-in catalog; ``for_s`` defaults are starting points — every
+    value here is overridable per run (declarations) and per deployment
+    (env knobs)."""
+    return [
+        AlertRule(
+            "run_stalled",
+            AlertSeverity.CRITICAL,
+            0.0,  # stall_after_s already IS a hold-down
+            _check_run_stalled,
+            "gang alive (fresh heartbeats) but no forward progress",
+        ),
+        AlertRule(
+            "gang_straggler",
+            AlertSeverity.WARNING,
+            0.0,
+            _check_gang_straggler,
+            "one host's step lags the gang median",
+        ),
+        AlertRule(
+            "heartbeat_stale",
+            AlertSeverity.CRITICAL,
+            0.0,
+            _check_heartbeat_stale,
+            "no heartbeat from any gang process past the threshold",
+        ),
+        AlertRule(
+            "goodput_low",
+            AlertSeverity.WARNING,
+            30.0,
+            _check_goodput_low,
+            "goodput ratio below the declared SLO floor",
+        ),
+        AlertRule(
+            "mfu_low",
+            AlertSeverity.WARNING,
+            30.0,
+            _check_mfu_low,
+            "MFU below the declared SLO floor",
+        ),
+        AlertRule(
+            "serving_ttft_p99",
+            AlertSeverity.WARNING,
+            30.0,
+            _check_serving_ttft_p99,
+            "serving TTFT p99 above the declared latency SLO",
+        ),
+        AlertRule(
+            "steady_state_compiles",
+            AlertSeverity.WARNING,
+            0.0,
+            _check_steady_state_compiles,
+            "XLA recompilation observed after serving warmup",
+        ),
+        AlertRule(
+            "compile_cache_miss",
+            AlertSeverity.INFO,
+            0.0,
+            _check_compile_cache_miss,
+            "persistent compile cache mostly missing",
+        ),
+    ]
+
+
+class AlertEngine:
+    """Ticks the rule catalog over live runs; owns the alert lifecycle.
+
+    State lives in the registry ``alerts`` table, not in memory — a
+    restarted control plane resumes hold-downs and open episodes instead
+    of re-paging for everything it already knew about.
+    """
+
+    def __init__(
+        self,
+        registry: RunRegistry,
+        *,
+        stats: Any = None,
+        auditor: Any = None,
+        rules: Optional[List[AlertRule]] = None,
+        interval_s: Optional[float] = None,
+    ) -> None:
+        self.registry = registry
+        self.stats = stats
+        self.auditor = auditor
+        self.rules = list(rules) if rules is not None else default_rules()
+        self.interval_s = (
+            interval_s
+            if interval_s is not None
+            else _env_float("POLYAXON_TPU_ALERT_INTERVAL_S", 1.0)
+        )
+        self.last_tick_at: float = 0.0
+        self.ticks: int = 0
+        self.eval_errors: int = 0
+        self._last_eval: Dict[int, float] = {}
+
+    # -- per-tick entrypoints --------------------------------------------------
+    def evaluate(
+        self, run_or_handle: Any, *, now: Optional[float] = None
+    ) -> List[Dict[str, Any]]:
+        """One evaluation pass for one live run.  Called from the scheduler
+        monitor task every tick; internally throttled to ``interval_s`` per
+        run so rule evaluation stays off the hot path.  Returns the state
+        transitions it performed (empty on throttled/steady ticks)."""
+        run_id = getattr(run_or_handle, "run_id", run_or_handle)
+        now = now if now is not None else time.time()
+        last = self._last_eval.get(run_id, 0.0)
+        if self.interval_s > 0 and now - last < self.interval_s:
+            return []
+        self._last_eval[run_id] = now
+        self.last_tick_at = now
+        self.ticks += 1
+        run = self.registry.get_run(run_id)
+        if run is None:
+            return []
+        ctx = RuleContext(self.registry, run, stats=self.stats, now=now)
+        current = {
+            row["rule"]: row for row in self.registry.get_alerts(run_id)
+        }
+        transitions: List[Dict[str, Any]] = []
+        for rule in self.rules:
+            try:
+                transitions.extend(self._step(ctx, rule, current.get(rule.name)))
+            except Exception:
+                self.eval_errors += 1
+                if self.stats is not None:
+                    self.stats.incr("alert_eval_errors")
+                logger.warning(
+                    "Alert rule %r failed for run %d",
+                    rule.name,
+                    run_id,
+                    exc_info=True,
+                )
+        return transitions
+
+    def _step(
+        self,
+        ctx: RuleContext,
+        rule: AlertRule,
+        row: Optional[Dict[str, Any]],
+    ) -> List[Dict[str, Any]]:
+        """Advance one (run, rule) pair through the lifecycle state machine."""
+        run_id = ctx.run.id
+        state = row["state"] if row else None
+        violation = (
+            rule.check(ctx) if ctx.enabled(rule.name) else None
+        )
+        for_s = ctx.param(rule.name, "for_s", rule.for_s)
+        out: List[Dict[str, Any]] = []
+
+        if violation is not None:
+            value = float(violation.pop("value", 0.0))
+            message = str(violation.pop("message", rule.name))
+            attrs = violation  # whatever the check left behind
+            if state == AlertState.FIRING:
+                self._gauge(rule, run_id, GAUGE_FIRING)
+                return out  # steady firing: no row churn, no re-notify
+            if state == AlertState.PENDING:
+                if ctx.now - (row["pending_since"] or ctx.now) >= for_s:
+                    fired = self.registry.upsert_alert(
+                        run_id,
+                        rule.name,
+                        state=AlertState.FIRING,
+                        severity=rule.severity,
+                        message=message,
+                        value=value,
+                        for_s=for_s,
+                        episodes=(row["episodes"] or 0) + 1,
+                        fired_at=ctx.now,
+                        resolved_at=None,
+                        attrs=attrs,
+                        now=ctx.now,
+                    )
+                    out.append(fired)
+                    self._gauge(rule, run_id, GAUGE_FIRING)
+                    self._notify(EventTypes.ALERT_FIRING, ctx.run, fired)
+                else:
+                    self._gauge(rule, run_id, GAUGE_PENDING)
+                return out
+            # inactive (no row, or resolved) → pending; a zero hold-down
+            # fires in the same tick it pends, one transition row each.
+            pending = self.registry.upsert_alert(
+                run_id,
+                rule.name,
+                state=AlertState.PENDING,
+                severity=rule.severity,
+                message=message,
+                value=value,
+                for_s=for_s,
+                pending_since=ctx.now,
+                fired_at=None if row is None else row.get("fired_at"),
+                resolved_at=None,
+                attrs=attrs,
+                now=ctx.now,
+            )
+            out.append(pending)
+            self._gauge(rule, run_id, GAUGE_PENDING)
+            if for_s <= 0:
+                fired = self.registry.upsert_alert(
+                    run_id,
+                    rule.name,
+                    state=AlertState.FIRING,
+                    severity=rule.severity,
+                    message=message,
+                    value=value,
+                    for_s=for_s,
+                    episodes=(row["episodes"] if row else 0) + 1,
+                    fired_at=ctx.now,
+                    resolved_at=None,
+                    attrs=attrs,
+                    now=ctx.now,
+                )
+                out.append(fired)
+                self._gauge(rule, run_id, GAUGE_FIRING)
+                self._notify(EventTypes.ALERT_FIRING, ctx.run, fired)
+            return out
+
+        # healthy
+        if state == AlertState.FIRING:
+            resolved = self.registry.upsert_alert(
+                run_id,
+                rule.name,
+                state=AlertState.RESOLVED,
+                severity=rule.severity,
+                message=f"{rule.name} recovered",
+                value=None,
+                for_s=for_s,
+                resolved_at=ctx.now,
+                attrs=row.get("attrs") or None,
+                now=ctx.now,
+            )
+            out.append(resolved)
+            self._gauge(rule, run_id, GAUGE_OK)
+            self._notify(EventTypes.ALERT_RESOLVED, ctx.run, resolved)
+        elif state == AlertState.PENDING:
+            # Flap suppressed: recovered inside the hold-down — drop the
+            # row entirely, nobody was ever paged.
+            self.registry.delete_alert(run_id, rule.name)
+            self._gauge(rule, run_id, GAUGE_OK)
+        return out
+
+    def finalize(
+        self, run_id: int, *, now: Optional[float] = None
+    ) -> List[Dict[str, Any]]:
+        """Terminal-run cleanup: resolve open episodes, drop pendings, zero
+        gauges — a finished run must not keep paging (the
+        terminal-mid-episode discipline of ``run_stall_age_s``)."""
+        now = now if now is not None else time.time()
+        out: List[Dict[str, Any]] = []
+        run = self.registry.get_run(run_id)
+        for row in self.registry.get_alerts(run_id):
+            if row["state"] == AlertState.FIRING:
+                resolved = self.registry.upsert_alert(
+                    run_id,
+                    row["rule"],
+                    state=AlertState.RESOLVED,
+                    severity=row["severity"],
+                    message=f"{row['rule']}: run finished",
+                    value=None,
+                    for_s=row["for_s"],
+                    resolved_at=now,
+                    attrs=row.get("attrs") or None,
+                    now=now,
+                )
+                out.append(resolved)
+                self._notify(EventTypes.ALERT_RESOLVED, run, resolved)
+            elif row["state"] == AlertState.PENDING:
+                self.registry.delete_alert(run_id, row["rule"])
+            self._gauge_raw(row["rule"], run_id, row["severity"], GAUGE_OK)
+        self._last_eval.pop(run_id, None)
+        return out
+
+    # -- fan-out ---------------------------------------------------------------
+    def _gauge(self, rule: AlertRule, run_id: int, value: float) -> None:
+        self._gauge_raw(rule.name, run_id, rule.severity, value)
+
+    def _gauge_raw(
+        self, rule: str, run_id: int, severity: str, value: float
+    ) -> None:
+        if self.stats is not None:
+            self.stats.gauge(alert_gauge_key(rule, run_id, severity), value)
+
+    def _notify(
+        self, event_type: str, run: Optional[Run], row: Dict[str, Any]
+    ) -> None:
+        if self.auditor is None:
+            return
+        payload = {
+            "run_id": row["run_id"],
+            "run_name": getattr(run, "name", None),
+            "project": getattr(run, "project", None),
+            "rule": row["rule"],
+            "state": row["state"],
+            "severity": row["severity"],
+            "message": row["message"],
+            "value": row["value"],
+            "for_s": row["for_s"],
+            "episodes": row["episodes"],
+            "pending_since": row["pending_since"],
+            "fired_at": row["fired_at"],
+            "resolved_at": row["resolved_at"],
+            "attrs": row.get("attrs") or {},
+        }
+        try:
+            self.auditor.record(event_type, **payload)
+        except Exception:
+            logger.warning(
+                "Alert notification failed for %s/%s",
+                row["run_id"],
+                row["rule"],
+                exc_info=True,
+            )
+
+    # -- introspection (health probe / status page) ----------------------------
+    def status(self) -> Dict[str, Any]:
+        return {
+            "rules": [r.name for r in self.rules],
+            "interval_s": self.interval_s,
+            "ticks": self.ticks,
+            "eval_errors": self.eval_errors,
+            "last_tick_at": self.last_tick_at,
+        }

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import json
 import logging
+import time
+import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, Optional
 
@@ -184,6 +186,15 @@ class EmailAction(Action):
 
 
 class WebhookAction(Action):
+    """Generic JSON webhook, hardened for alert duty.
+
+    Connection-level failures (refused, DNS, timeout, 5xx) retry with
+    exponential backoff up to ``max_attempts``; a 4xx is the receiver
+    rejecting the payload and retrying would just repeat it.  After the
+    final failure a dead-letter line carries the payload summary — a lost
+    page must be visible in the control-plane log, never silent.
+    """
+
     name = "webhook"
     async_dispatch = True
 
@@ -193,16 +204,49 @@ class WebhookAction(Action):
         shaper: Optional[Callable[[Payload], Payload]] = None,
         timeout: float = 5.0,
         headers: Optional[Dict[str, str]] = None,
+        max_attempts: int = 3,
+        backoff_s: float = 0.5,
     ) -> None:
         self.url = url
         self.shaper = shaper
         self.timeout = timeout
         self.headers = {"Content-Type": "application/json", **(headers or {})}
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff_s = backoff_s
+
+    def _post_once(self, data: bytes) -> None:
+        req = urllib.request.Request(self.url, data=data, headers=self.headers)
+        with urllib.request.urlopen(req, timeout=self.timeout):
+            pass
 
     def _execute(self, payload: Payload) -> None:
         body = self.shaper(payload) if self.shaper else payload
-        req = urllib.request.Request(
-            self.url, data=json.dumps(body, default=str).encode(), headers=self.headers
+        data = json.dumps(body, default=str).encode()
+        delay = self.backoff_s
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                self._post_once(data)
+                return
+            except urllib.error.HTTPError as exc:
+                if exc.code < 500 or attempt >= self.max_attempts:
+                    self._dead_letter(payload, attempt, exc)
+                    raise
+            except (urllib.error.URLError, ConnectionError, OSError) as exc:
+                if attempt >= self.max_attempts:
+                    self._dead_letter(payload, attempt, exc)
+                    raise
+            time.sleep(delay)
+            delay *= 2
+
+    def _dead_letter(
+        self, payload: Payload, attempts: int, exc: Exception
+    ) -> None:
+        logger.error(
+            "webhook dead-letter: %s undeliverable to %s after %d attempt(s)"
+            " (%s): %s",
+            payload.get("event_type", "event"),
+            self.url,
+            attempts,
+            exc,
+            json.dumps(payload, default=str)[:2000],
         )
-        with urllib.request.urlopen(req, timeout=self.timeout):
-            pass

@@ -3,15 +3,24 @@
 Parity: reference ``notifier/service.py`` — consumes the EVENTS_NOTIFY fan
 -out and dispatches to configured actions, filtered per event type.  Here
 it subscribes to the auditor directly (the celery hop collapses away).
+
+:class:`AlertRouter` is the alert-engine flavor: same dispatch machinery,
+but the action set is *named sinks* selected per event by a
+severity → sinks routing map (``critical:webhook,email;info:log``) — a
+page-worthy alert and an informational one should not share a channel.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
-from typing import Iterable, List, Optional, Sequence
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence
 
 from polyaxon_tpu.events import Event
 from polyaxon_tpu.notifier.actions import Action
+from polyaxon_tpu.stats.metrics import labeled_key
+
+logger = logging.getLogger(__name__)
 
 
 class Notifier:
@@ -19,28 +28,37 @@ class Notifier:
 
     Actions flagged ``async_dispatch`` (network sinks) run on daemon
     threads so a slow/unreachable endpoint can't stall the bus thread
-    recording the event.
+    recording the event.  With a ``stats`` backend attached, every
+    dispatch lands on a ``notifier_dispatch{action,outcome}`` counter —
+    exported as ``polyaxon_tpu_notifier_dispatch_total`` on ``/metrics``,
+    so delivery failures are graphable, not just greppable.
     """
 
     def __init__(
         self,
         actions: Sequence[Action],
         event_types: Optional[Iterable[str]] = None,
+        *,
+        stats: Any = None,
     ) -> None:
         self.actions: List[Action] = list(actions)
         #: None = all events; else a whitelist
         self.event_types = set(event_types) if event_types is not None else None
+        self.stats = stats
         self._inflight: List[threading.Thread] = []
 
     def __call__(self, event: Event) -> None:
         if self.event_types is not None and event.event_type not in self.event_types:
             return
         payload = {"event_type": event.event_type, **event.context}
-        for action in self.actions:
+        self._dispatch(self.actions, payload)
+
+    def _dispatch(self, actions: Sequence[Action], payload: Dict[str, Any]) -> None:
+        for action in actions:
             if action.async_dispatch:
                 t = threading.Thread(
-                    target=action.execute,
-                    args=(payload,),
+                    target=self._run_action,
+                    args=(action, payload),
                     name=f"notify-{action.name}",
                     daemon=True,
                 )
@@ -48,7 +66,19 @@ class Notifier:
                 self._inflight = [x for x in self._inflight if x.is_alive()]
                 self._inflight.append(t)
             else:
-                action.execute(payload)
+                self._run_action(action, payload)
+
+    def _run_action(self, action: Action, payload: Dict[str, Any]) -> bool:
+        ok = action.execute(payload)
+        if self.stats is not None:
+            self.stats.incr(
+                labeled_key(
+                    "notifier_dispatch",
+                    action=action.name,
+                    outcome="ok" if ok else "error",
+                )
+            )
+        return ok
 
     def flush(self, timeout: float = 5.0) -> None:
         """Wait for in-flight async notifications (call before exit, or the
@@ -59,3 +89,80 @@ class Notifier:
         for t in self._inflight:
             t.join(timeout=max(0.0, deadline - time.time()))
         self._inflight = [x for x in self._inflight if x.is_alive()]
+
+
+#: Routing fallback: severities not named in the map go to every sink.
+ROUTE_ALL = "*"
+
+
+def parse_alert_routes(spec: Optional[str]) -> Dict[str, List[str]]:
+    """``"critical:webhook,email;warning:webhook;info:log"`` → map.
+
+    Empty/None means route everything everywhere (the safe default for a
+    deployment with one webhook configured).  Unknown sink names are kept
+    here and warned about at dispatch time — conf validation must not
+    depend on which sinks happen to be constructed.
+    """
+    routes: Dict[str, List[str]] = {}
+    if not spec:
+        return routes
+    for clause in str(spec).split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        sev, _, sinks = clause.partition(":")
+        routes[sev.strip().lower()] = [
+            s.strip() for s in sinks.split(",") if s.strip()
+        ]
+    return routes
+
+
+class AlertRouter(Notifier):
+    """Severity-routed alert fan-out over named sinks.
+
+    Subscribes to the auditor for ``alert.firing`` / ``alert.resolved``
+    events; the payload's ``severity`` picks the sink subset.  Resolved
+    notifications follow the same route as their firing — the channel
+    that got paged is the channel that learns it's over.
+    """
+
+    def __init__(
+        self,
+        sinks: Mapping[str, Action],
+        *,
+        routes: Optional[Dict[str, List[str]]] = None,
+        event_types: Optional[Iterable[str]] = None,
+        stats: Any = None,
+    ) -> None:
+        if event_types is None:
+            from polyaxon_tpu.events import EventTypes
+
+            event_types = (EventTypes.ALERT_FIRING, EventTypes.ALERT_RESOLVED)
+        super().__init__(list(sinks.values()), event_types, stats=stats)
+        self.sinks: Dict[str, Action] = dict(sinks)
+        self.routes: Dict[str, List[str]] = dict(routes or {})
+
+    def sinks_for(self, severity: str) -> List[Action]:
+        names = self.routes.get(
+            str(severity).lower(), self.routes.get(ROUTE_ALL)
+        )
+        if names is None:
+            return list(self.sinks.values())
+        out: List[Action] = []
+        for name in names:
+            sink = self.sinks.get(name)
+            if sink is None:
+                logger.warning(
+                    "Alert route names unknown sink %r (have: %s)",
+                    name,
+                    sorted(self.sinks),
+                )
+            else:
+                out.append(sink)
+        return out
+
+    def __call__(self, event: Event) -> None:
+        if self.event_types is not None and event.event_type not in self.event_types:
+            return
+        payload = {"event_type": event.event_type, **event.context}
+        self._dispatch(self.sinks_for(payload.get("severity", "")), payload)

@@ -169,8 +169,24 @@ class Orchestrator:
                         EventTypes.GROUP_DONE,
                         EventTypes.PIPELINE_DONE,
                     ],
+                    stats=self.stats,
                 )
             )
+        # Alert-engine fan-out: named sinks + severity routing.  The log
+        # sink is always present, so a deployment with no webhook still
+        # sees its pages in the control-plane log.
+        from polyaxon_tpu.notifier import LogAction
+        from polyaxon_tpu.notifier.service import AlertRouter, parse_alert_routes
+
+        alert_sinks = {"log": LogAction()}
+        for action in actions:
+            alert_sinks[action.name] = action
+        self.alert_router = AlertRouter(
+            alert_sinks,
+            routes=parse_alert_routes(conf.get("notifier.alert_routes")),
+            stats=self.stats,
+        )
+        self.auditor.subscribe(self.alert_router)
         from polyaxon_tpu.spawner import spawner_from_conf
 
         self.spawner = spawner_from_conf(
@@ -179,6 +195,13 @@ class Orchestrator:
         # The stats backend lets the watcher's stall/straggler detector
         # export its alarm gauges on /metrics.
         self.watcher = GangWatcher(self.registry, stats=self.stats)
+        # The alert engine ticks in the same monitor task as the watcher,
+        # turning the signal tables into a pending→firing→resolved feed.
+        from polyaxon_tpu.monitor import AlertEngine
+
+        self.alerts = AlertEngine(
+            self.registry, stats=self.stats, auditor=self.auditor
+        )
         artifacts_url = conf.get("stores.artifacts_url")
         self.artifact_store = None
         if artifacts_url:
@@ -192,6 +215,7 @@ class Orchestrator:
             layout=self.layout,
             spawner=self.spawner,
             watcher=self.watcher,
+            alerts=self.alerts,
             monitor_interval=monitor_interval,
             heartbeat_ttl=heartbeat_ttl,
             terminal_grace=conf.get("scheduler.terminal_grace"),

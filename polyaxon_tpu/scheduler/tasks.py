@@ -23,7 +23,7 @@ from polyaxon_tpu.db.registry import RegistryError, RunRegistry
 from polyaxon_tpu.events import EventTypes
 from polyaxon_tpu.exceptions import PolyaxonTPUError
 from polyaxon_tpu.lifecycles import StatusOptions as S
-from polyaxon_tpu.monitor import GangWatcher
+from polyaxon_tpu.monitor import AlertEngine, GangWatcher
 from polyaxon_tpu.spawner import GangHandle, GangSpawner
 from polyaxon_tpu.stores import StoreLayout, create_snapshot
 from polyaxon_tpu.workers import CronTasks, SchedulerTasks, TaskBus
@@ -43,6 +43,9 @@ class SchedulerContext:
     layout: StoreLayout
     spawner: GangSpawner
     watcher: GangWatcher
+    #: Alert rule engine, ticked by the monitor task alongside the watcher
+    #: (None = alerting off, e.g. minimal test stands).
+    alerts: Optional[AlertEngine] = None
     #: Live gang handles keyed by run id (the reference keeps equivalent
     #: state in k8s; a single-service control plane keeps it in-process).
     gangs: Dict[int, GangHandle] = field(default_factory=dict)
@@ -72,6 +75,15 @@ def _record_done(
     expired = ctx.registry.expire_commands(run_id)
     if expired:
         logger.info("Expired %d open command(s) on finished run %s", expired, run_id)
+    if ctx.alerts is not None:
+        # Close the alert lifecycle with the run: firing → resolved ("run
+        # finished"), pendings dropped, alert_state gauges back to 0.
+        try:
+            ctx.alerts.finalize(run_id)
+        except Exception:
+            logger.warning(
+                "Alert finalize failed for run %s", run_id, exc_info=True
+            )
     run = ctx.registry.get_run(run_id)
     if run.service_url:
         # A terminal service must stop advertising its (now dead) URL.
@@ -287,6 +299,16 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             return
         if rollup == S.RUNNING:
             reg.set_status(run_id, S.RUNNING)
+            if ctx.alerts is not None:
+                # Same cadence as the watcher; the engine throttles itself
+                # (interval_s) and counts rule errors instead of raising —
+                # but a registry-level failure here must not kill the poll.
+                try:
+                    ctx.alerts.evaluate(handle)
+                except Exception:
+                    logger.warning(
+                        "Alert evaluation failed for run %s", run_id, exc_info=True
+                    )
         if rollup in (S.SUCCEEDED, S.FAILED, S.SKIPPED) and not handle.all_exited:
             # Gang is logically done but members are still alive — typically
             # a survivor blocked in a collective on a dead peer. Give the

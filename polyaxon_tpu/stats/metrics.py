@@ -25,6 +25,8 @@ from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence
 __all__ = [
     "Histogram",
     "default_buckets",
+    "labeled_key",
+    "split_labeled_key",
     "render_prometheus",
     "render_standard_gauges",
     "PROMETHEUS_CONTENT_TYPE",
@@ -152,6 +154,32 @@ def _metric_name(key: str, prefix: str) -> str:
     return name
 
 
+# Stats backends key counters/gauges by flat strings; per-series labels
+# (``alert_state{rule=...,run=...}``) ride *inside* the key in exposition
+# syntax, produced by :func:`labeled_key` and split back out by the
+# renderer so base labels merge in.  Label order is sorted → one series
+# per (name, labels) set no matter the caller's kwarg order.
+_LABELED_KEY = re.compile(r"^(?P<name>[^{]+)\{(?P<body>.*)\}$")
+_LABEL_PAIR = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def labeled_key(name: str, **labels: Any) -> str:
+    if not labels:
+        return name
+    body = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(labels.items())
+    )
+    return f"{name}{{{body}}}"
+
+
+def split_labeled_key(key: str) -> "tuple[str, Dict[str, str]]":
+    m = _LABELED_KEY.match(key)
+    if not m:
+        return key, {}
+    pairs = {k: v for k, v in _LABEL_PAIR.findall(m.group("body"))}
+    return m.group("name"), pairs
+
+
 def _escape_label_value(value: Any) -> str:
     return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
@@ -189,17 +217,29 @@ def render_prometheus(
     base_labels = dict(labels or {})
     lines: List[str] = []
 
+    # Labeled keys of the same metric sort adjacently, so one TYPE line
+    # per name is just "don't repeat the last one".
+    last_typed = ""
     for key in sorted(snapshot.get("counters", {})):
         value = snapshot["counters"][key]
-        name = _metric_name(key, prefix) + "_total"
-        lines.append(f"# TYPE {name} counter")
-        lines.append(f"{name}{_labels(base_labels)} {_fmt(value)}")
+        base, own = split_labeled_key(key)
+        name = _metric_name(base, prefix) + "_total"
+        if name != last_typed:
+            lines.append(f"# TYPE {name} counter")
+            last_typed = name
+        series = dict(base_labels, **own) if own else base_labels
+        lines.append(f"{name}{_labels(series)} {_fmt(value)}")
 
+    last_typed = ""
     for key in sorted(snapshot.get("gauges", {})):
         value = snapshot["gauges"][key]
-        name = _metric_name(key, prefix)
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name}{_labels(base_labels)} {_fmt(value)}")
+        base, own = split_labeled_key(key)
+        name = _metric_name(base, prefix)
+        if name != last_typed:
+            lines.append(f"# TYPE {name} gauge")
+            last_typed = name
+        series = dict(base_labels, **own) if own else base_labels
+        lines.append(f"{name}{_labels(series)} {_fmt(value)}")
 
     for key in sorted(snapshot.get("histograms", {})):
         state = snapshot["histograms"][key]

@@ -384,6 +384,13 @@ class FlightRecorder:
                     kind,
                     message=message,
                     dump=str(path) if path else None,
+                    # Run-relative artifact key (``reports/<file>``) so the
+                    # anomaly row — and any alert built on it — links to
+                    # the postmortem via the run artifacts API, not a path
+                    # that only means something on the worker host.
+                    dump_artifact=(
+                        f"{self.out_dir.name}/{path.name}" if path else None
+                    ),
                     **attrs,
                 )
             except Exception:

@@ -1,0 +1,310 @@
+"""The alerts surface: cluster feed (``GET /api/v1/alerts``), per-run feed,
+the ``alerts`` roll-up on run detail, the ``/ws/v1/alerts`` live tail, and
+the end-to-end acceptance path — a gang that genuinely stalls fires
+``run_stalled`` through the webhook sink, then resolves after recovery
+with the gauge back at zero.
+"""
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from polyaxon_tpu.api.app import create_app
+from polyaxon_tpu.db.registry import AlertSeverity, AlertState
+from polyaxon_tpu.monitor.alerts import GAUGE_FIRING, GAUGE_OK, alert_gauge_key
+from polyaxon_tpu.orchestrator import Orchestrator
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(tmp_path / "plat", monitor_interval=0.05)
+    yield o
+    o.stop()
+
+
+def drive(orch, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        app = create_app(orch)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+class TestAlertFeeds:
+    def test_cluster_feed_filters_and_engine_status(self, orch):
+        async def body(client):
+            a = await (await client.post("/api/v1/runs", json={"spec": SPEC})).json()
+            b = await (await client.post("/api/v1/runs", json={"spec": SPEC})).json()
+            reg = orch.registry
+            reg.upsert_alert(
+                a["id"],
+                "run_stalled",
+                state=AlertState.FIRING,
+                severity=AlertSeverity.CRITICAL,
+                message="no progress",
+            )
+            reg.upsert_alert(
+                a["id"],
+                "compile_cache_miss",
+                state=AlertState.RESOLVED,
+                severity=AlertSeverity.INFO,
+            )
+            reg.upsert_alert(
+                b["id"],
+                "gang_straggler",
+                state=AlertState.FIRING,
+                severity=AlertSeverity.WARNING,
+            )
+            doc = await (await client.get("/api/v1/alerts")).json()
+            assert len(doc["results"]) == 3
+            # The engine's introspection rides along on the cluster feed.
+            assert "run_stalled" in doc["engine"]["rules"]
+
+            firing = await (
+                await client.get("/api/v1/alerts?state=firing")
+            ).json()
+            assert {r["rule"] for r in firing["results"]} == {
+                "run_stalled",
+                "gang_straggler",
+            }
+            crit = await (
+                await client.get("/api/v1/alerts?severity=critical")
+            ).json()
+            assert [r["rule"] for r in crit["results"]] == ["run_stalled"]
+            scoped = await (
+                await client.get(f"/api/v1/alerts?run_id={b['id']}")
+            ).json()
+            assert [r["run_id"] for r in scoped["results"]] == [b["id"]]
+            # since_id pages by transition id, same contract as logs.
+            first = doc["results"][0]["id"]
+            page = await (
+                await client.get(f"/api/v1/alerts?since_id={first}")
+            ).json()
+            assert len(page["results"]) == 2
+            return True
+
+        assert drive(orch, body)
+
+    def test_run_feed_and_404(self, orch):
+        async def body(client):
+            assert (await client.get("/api/v1/runs/999/alerts")).status == 404
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            orch.registry.upsert_alert(
+                run["id"],
+                "mfu_low",
+                state=AlertState.PENDING,
+                severity=AlertSeverity.WARNING,
+            )
+            doc = await (
+                await client.get(f"/api/v1/runs/{run['id']}/alerts")
+            ).json()
+            assert [r["rule"] for r in doc["results"]] == ["mfu_low"]
+            assert doc["results"][0]["state"] == "pending"
+            return True
+
+        assert drive(orch, body)
+
+    def test_run_detail_carries_alert_rollup(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            detail = await (await client.get(f"/api/v1/runs/{run['id']}")).json()
+            assert detail["alerts"] == {
+                "firing": 0,
+                "pending": 0,
+                "resolved": 0,
+                "results": [],
+            }
+            orch.registry.upsert_alert(
+                run["id"],
+                "run_stalled",
+                state=AlertState.FIRING,
+                severity=AlertSeverity.CRITICAL,
+            )
+            detail = await (await client.get(f"/api/v1/runs/{run['id']}")).json()
+            assert detail["alerts"]["firing"] == 1
+            assert detail["alerts"]["results"][0]["rule"] == "run_stalled"
+            # List views stay a single-table read: no alerts block.
+            listing = await (await client.get("/api/v1/runs")).json()
+            assert "alerts" not in listing["results"][0]
+            return True
+
+        assert drive(orch, body)
+
+    def test_ws_alerts_streams_lifecycle_edges(self, orch):
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            reg = orch.registry
+            reg.upsert_alert(
+                run["id"],
+                "run_stalled",
+                state=AlertState.PENDING,
+                severity=AlertSeverity.CRITICAL,
+            )
+            ws = await client.ws_connect("/ws/v1/alerts")
+            first = (await ws.receive_json(timeout=5))
+            assert first["state"] == "pending"
+            # A transition REPLACEs the row with a fresh id — the open
+            # tail sees the firing edge without re-seeing the pending row.
+            reg.upsert_alert(
+                run["id"],
+                "run_stalled",
+                state=AlertState.FIRING,
+                severity=AlertSeverity.CRITICAL,
+                episodes=1,
+            )
+            second = await ws.receive_json(timeout=5)
+            assert second["state"] == "firing"
+            assert second["id"] > first["id"]
+            await ws.close()
+            return True
+
+        assert drive(orch, body)
+
+
+class _WebhookSink:
+    """Local HTTP endpoint recording every JSON POST it receives."""
+
+    def __init__(self):
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        sink = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                sink.received.append(json.loads(self.rfile.read(length)))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.received = []
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_port}/hook"
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.mark.e2e
+class TestAlertEndToEnd:
+    def test_stall_fires_webhook_then_resolves(self, tmp_path, monkeypatch):
+        """The acceptance path: injected stall → firing ``run_stalled`` row
+        → webhook delivery through the severity router → nonzero gauge →
+        resolved after the gang recovers, gauge back to zero."""
+        sink = _WebhookSink()
+        monkeypatch.setenv("POLYAXON_TPU_WEBHOOK_URL", sink.url)
+        monkeypatch.setenv("POLYAXON_TPU_ALERT_INTERVAL_S", "0.05")
+        monkeypatch.setenv("POLYAXON_TPU_STALL_AFTER_S", "0.6")
+        monkeypatch.setenv("POLYAXON_TPU_PROGRESS_INTERVAL_S", "0.05")
+        monkeypatch.setenv("POLYAXON_TPU_WATCHDOG_INTERVAL_S", "0.05")
+        monkeypatch.setenv("POLYAXON_TPU_WATCHDOG_FLOOR_S", "0.6")
+        monkeypatch.setenv("POLYAXON_TPU_WATCHDOG_CEILING_S", "2.0")
+        orch = Orchestrator(
+            tmp_path / "plat", monitor_interval=0.05, heartbeat_interval=0.2
+        )
+        spec = {
+            "kind": "experiment",
+            "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:stalling"},
+            "declarations": {
+                "warm_steps": 10,
+                "beat_interval": 0.02,
+                "stall_s": 2.0,
+                # The victim resumes beating after the stall — the alert
+                # must resolve on recovery, not only at run teardown.
+                "recover_steps": 40,
+                "recover_interval": 0.05,
+            },
+            "environment": {
+                "topology": {
+                    "accelerator": "cpu-1",
+                    "num_devices": 1,
+                    "num_hosts": 1,
+                }
+            },
+        }
+        try:
+            run = orch.submit(spec, name="alert-e2e")
+            gkey = alert_gauge_key(
+                "run_stalled", run.id, AlertSeverity.CRITICAL
+            )
+            peak_gauge = 0.0
+            import time as _time
+
+            deadline = _time.time() + 90
+            while _time.time() < deadline:
+                orch.pump(0.05)
+                peak_gauge = max(peak_gauge, orch.stats.gauges.get(gkey, 0.0))
+                if orch.get_run(run.id).is_done:
+                    break
+            assert orch.get_run(run.id).is_done
+            orch.alert_router.flush()
+
+            rows = orch.registry.get_alerts(run.id, rule="run_stalled")
+            assert rows, orch.registry.get_alerts(run.id)
+            row = rows[0]
+            # Fired during the stall, resolved after: the episode's whole
+            # timeline survives on the single row.
+            assert row["state"] == AlertState.RESOLVED
+            assert row["episodes"] >= 1
+            assert row["fired_at"] is not None
+            assert row["resolved_at"] > row["fired_at"]
+            # The gauge peaked at FIRING while stalled and recovered to 0.
+            assert peak_gauge == GAUGE_FIRING
+            assert orch.stats.gauges[gkey] == GAUGE_OK
+            from polyaxon_tpu.stats.metrics import render_prometheus
+
+            text = render_prometheus(orch.stats.snapshot())
+            assert 'polyaxon_tpu_alert_state{' in text
+            assert f'rule="run_stalled",run="{run.id}"' in text
+
+            # The webhook sink heard both edges, firing before resolved.
+            events = [
+                (p.get("event_type"), p.get("rule"))
+                for p in sink.received
+                if p.get("rule") == "run_stalled"
+            ]
+            assert ("alert.firing", "run_stalled") in events
+            assert ("alert.resolved", "run_stalled") in events
+            assert events.index(("alert.firing", "run_stalled")) < events.index(
+                ("alert.resolved", "run_stalled")
+            )
+            fired = next(
+                p for p in sink.received if p.get("event_type") == "alert.firing"
+                and p.get("rule") == "run_stalled"
+            )
+            assert fired["severity"] == "critical"
+            assert fired["run_id"] == run.id
+            assert "no progress" in fired["message"]
+        finally:
+            orch.stop()
+            sink.stop()

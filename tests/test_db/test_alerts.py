@@ -1,0 +1,233 @@
+"""Alerts table at the registry layer: latest-state-per-(run, rule) upsert
+with a fresh id per transition, carry-forward of episode timestamps,
+since_id paging + filters, cascade delete, and updated_at-keyed retention.
+"""
+
+import pytest
+
+from polyaxon_tpu.db.registry import AlertSeverity, AlertState, RunRegistry
+from polyaxon_tpu.lifecycles import StatusOptions as S
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "noop:main"},
+    "environment": {"topology": {"accelerator": "cpu", "num_devices": 1}},
+}
+
+
+@pytest.fixture()
+def reg(tmp_path):
+    r = RunRegistry(tmp_path / "reg.db")
+    yield r
+    r.close()
+
+
+class TestUpsert:
+    def test_one_row_per_rule_with_fresh_id_per_transition(self, reg):
+        run = reg.create_run(dict(SPEC))
+        pending = reg.upsert_alert(
+            run.id,
+            "run_stalled",
+            state=AlertState.PENDING,
+            severity=AlertSeverity.CRITICAL,
+            message="no progress",
+            value=3.0,
+            pending_since=100.0,
+            now=100.0,
+        )
+        firing = reg.upsert_alert(
+            run.id,
+            "run_stalled",
+            state=AlertState.FIRING,
+            severity=AlertSeverity.CRITICAL,
+            message="no progress",
+            value=5.0,
+            episodes=1,
+            fired_at=105.0,
+            now=105.0,
+        )
+        # REPLACE bumps the autoincrement id — every transition is a new
+        # row id, but the table holds exactly one row for the pair.
+        assert firing["id"] > pending["id"]
+        rows = reg.get_alerts(run.id)
+        assert len(rows) == 1
+        assert rows[0]["state"] == AlertState.FIRING
+        assert rows[0]["episodes"] == 1
+
+    def test_carry_forward_of_episode_fields(self, reg):
+        run = reg.create_run(dict(SPEC))
+        reg.upsert_alert(
+            run.id,
+            "goodput_low",
+            state=AlertState.PENDING,
+            severity=AlertSeverity.WARNING,
+            pending_since=10.0,
+            now=10.0,
+        )
+        reg.upsert_alert(
+            run.id,
+            "goodput_low",
+            state=AlertState.FIRING,
+            severity=AlertSeverity.WARNING,
+            episodes=1,
+            fired_at=40.0,
+            now=40.0,
+        )
+        resolved = reg.upsert_alert(
+            run.id,
+            "goodput_low",
+            state=AlertState.RESOLVED,
+            severity=AlertSeverity.WARNING,
+            resolved_at=55.0,
+            now=55.0,
+        )
+        # The resolve supplies nothing but resolved_at; the episode's
+        # timeline must survive the REPLACE (fired_at → resolved_at gap is
+        # what the latency bench and notifications read).
+        assert resolved["pending_since"] == 10.0
+        assert resolved["fired_at"] == 40.0
+        assert resolved["episodes"] == 1
+        assert resolved["created_at"] == 10.0
+        row = reg.get_alerts(run.id)[0]
+        assert row["fired_at"] == 40.0
+        assert row["resolved_at"] == 55.0
+        assert row["created_at"] == 10.0
+
+    def test_attrs_round_trip(self, reg):
+        run = reg.create_run(dict(SPEC))
+        reg.upsert_alert(
+            run.id,
+            "run_stalled",
+            state=AlertState.FIRING,
+            severity=AlertSeverity.CRITICAL,
+            attrs={"dump_artifact": "reports/flight_stall_1.json", "steps": [9]},
+        )
+        row = reg.get_alerts(run.id)[0]
+        assert row["attrs"]["dump_artifact"] == "reports/flight_stall_1.json"
+        assert row["attrs"]["steps"] == [9]
+
+
+class TestFeed:
+    def test_since_id_pages_by_transition(self, reg):
+        run = reg.create_run(dict(SPEC))
+        pending = reg.upsert_alert(
+            run.id,
+            "run_stalled",
+            state=AlertState.PENDING,
+            severity=AlertSeverity.CRITICAL,
+        )
+        # A pager that saw the pending row sees the firing edge next even
+        # though the table still holds a single row.
+        reg.upsert_alert(
+            run.id,
+            "run_stalled",
+            state=AlertState.FIRING,
+            severity=AlertSeverity.CRITICAL,
+            episodes=1,
+        )
+        page = reg.get_alerts(since_id=pending["id"])
+        assert [r["state"] for r in page] == [AlertState.FIRING]
+        assert reg.get_alerts(since_id=page[0]["id"]) == []
+
+    def test_filters(self, reg):
+        a = reg.create_run(dict(SPEC))
+        b = reg.create_run(dict(SPEC))
+        reg.upsert_alert(
+            a.id,
+            "run_stalled",
+            state=AlertState.FIRING,
+            severity=AlertSeverity.CRITICAL,
+        )
+        reg.upsert_alert(
+            a.id,
+            "compile_cache_miss",
+            state=AlertState.RESOLVED,
+            severity=AlertSeverity.INFO,
+        )
+        reg.upsert_alert(
+            b.id,
+            "gang_straggler",
+            state=AlertState.FIRING,
+            severity=AlertSeverity.WARNING,
+        )
+        assert len(reg.get_alerts()) == 3
+        assert {r["run_id"] for r in reg.get_alerts(a.id)} == {a.id}
+        firing = reg.get_alerts(state=AlertState.FIRING)
+        assert {r["rule"] for r in firing} == {"run_stalled", "gang_straggler"}
+        crit = reg.get_alerts(severity=AlertSeverity.CRITICAL)
+        assert [r["rule"] for r in crit] == ["run_stalled"]
+        assert len(reg.get_alerts(rule="gang_straggler")) == 1
+        assert len(reg.get_alerts(limit=2)) == 2
+
+    def test_delete_alert(self, reg):
+        run = reg.create_run(dict(SPEC))
+        reg.upsert_alert(
+            run.id,
+            "mfu_low",
+            state=AlertState.PENDING,
+            severity=AlertSeverity.WARNING,
+        )
+        assert reg.delete_alert(run.id, "mfu_low") is True
+        assert reg.get_alerts(run.id) == []
+        assert reg.delete_alert(run.id, "mfu_low") is False
+
+
+class TestLifecycleOfRows:
+    def _done_run(self, reg):
+        run = reg.create_run(dict(SPEC))
+        for s in (S.SCHEDULED, S.STARTING, S.RUNNING, S.SUCCEEDED):
+            reg.set_status(run.id, s)
+        return reg.get_run(run.id)
+
+    def test_cascade_delete_with_run(self, reg):
+        run = self._done_run(reg)
+        reg.upsert_alert(
+            run.id,
+            "run_stalled",
+            state=AlertState.RESOLVED,
+            severity=AlertSeverity.CRITICAL,
+        )
+        assert reg.delete_run(run.id)
+        assert reg.get_alerts() == []
+
+    def test_retention_keys_on_updated_at(self, reg):
+        import time
+
+        now = time.time()
+        run = self._done_run(reg)
+        old = now - 10_000
+        # Row born long ago but touched recently (a long-lived firing
+        # alert): created_at is ancient, updated_at fresh — must survive.
+        reg.upsert_alert(
+            run.id,
+            "run_stalled",
+            state=AlertState.PENDING,
+            severity=AlertSeverity.CRITICAL,
+            now=old,
+        )
+        reg.upsert_alert(
+            run.id,
+            "run_stalled",
+            state=AlertState.FIRING,
+            severity=AlertSeverity.CRITICAL,
+            episodes=1,
+            now=now,
+        )
+        # And one genuinely stale row on the same (done) run.
+        reg.upsert_alert(
+            run.id,
+            "compile_cache_miss",
+            state=AlertState.RESOLVED,
+            severity=AlertSeverity.INFO,
+            now=old,
+        )
+        # Backdate the run's finish so the DONE-run guard lets the sweep in.
+        with reg._lock, reg._conn() as conn:
+            conn.execute(
+                "UPDATE runs SET finished_at = ? WHERE id = ?", (old, run.id)
+            )
+        removed = reg.clean_old_rows(5_000, now=now)
+        assert removed["alerts"] == 1
+        kept = reg.get_alerts(run.id)
+        assert [r["rule"] for r in kept] == ["run_stalled"]
+        assert kept[0]["created_at"] == old

@@ -1,0 +1,439 @@
+"""Alert-engine unit tests: the pending → firing → resolved state machine
+(hold-down, flap suppression, steady-firing quiescence, terminal-run
+finalize), parameter resolution (declarations → env → defaults), gauge
+discipline, and the built-in rule catalog's predicates.
+
+Driven with synthetic rules and controlled ``now=`` values — no sleeping,
+no scheduler; the clock is an argument.
+"""
+
+import pytest
+
+from polyaxon_tpu.db.registry import AlertSeverity, AlertState, RunRegistry
+from polyaxon_tpu.events import EventTypes
+from polyaxon_tpu.monitor.alerts import (
+    GAUGE_FIRING,
+    GAUGE_OK,
+    GAUGE_PENDING,
+    AlertEngine,
+    AlertRule,
+    RuleContext,
+    alert_gauge_key,
+    default_rules,
+)
+from polyaxon_tpu.stats.backends import MemoryStats
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "noop:main"},
+    "environment": {"topology": {"accelerator": "cpu", "num_devices": 1}},
+}
+
+
+class FakeAuditor:
+    def __init__(self):
+        self.events = []
+
+    def record(self, event_type, **ctx):
+        self.events.append((event_type, ctx))
+
+
+class Flag:
+    """A togglable predicate for synthetic rules."""
+
+    def __init__(self):
+        self.on = False
+
+    def __call__(self, ctx):
+        if not self.on:
+            return None
+        return {"value": 1.0, "message": "synthetic violation", "extra": "x"}
+
+
+@pytest.fixture()
+def reg(tmp_path):
+    r = RunRegistry(tmp_path / "reg.db")
+    yield r
+    r.close()
+
+
+@pytest.fixture()
+def run(reg):
+    return reg.create_run(dict(SPEC))
+
+
+def make_engine(reg, rules, **kw):
+    kw.setdefault("stats", MemoryStats())
+    kw.setdefault("auditor", FakeAuditor())
+    kw.setdefault("interval_s", 0)
+    return AlertEngine(reg, rules=rules, **kw)
+
+
+class TestLifecycle:
+    def test_holddown_pending_then_firing(self, reg, run):
+        flag = Flag()
+        rule = AlertRule("probe", AlertSeverity.WARNING, 5.0, flag)
+        eng = make_engine(reg, [rule])
+        gkey = alert_gauge_key("probe", run.id, AlertSeverity.WARNING)
+
+        assert eng.evaluate(run.id, now=100.0) == []
+
+        flag.on = True
+        t1 = eng.evaluate(run.id, now=110.0)
+        assert [r["state"] for r in t1] == [AlertState.PENDING]
+        assert eng.stats.gauges[gkey] == GAUGE_PENDING
+        assert eng.auditor.events == []  # pending never pages
+
+        # Inside the hold-down: still pending, no new transition rows.
+        assert eng.evaluate(run.id, now=112.0) == []
+
+        t2 = eng.evaluate(run.id, now=116.0)
+        assert [r["state"] for r in t2] == [AlertState.FIRING]
+        fired = t2[0]
+        assert fired["episodes"] == 1
+        assert fired["fired_at"] == 116.0
+        assert fired["pending_since"] == 110.0
+        assert eng.stats.gauges[gkey] == GAUGE_FIRING
+        assert [e[0] for e in eng.auditor.events] == [EventTypes.ALERT_FIRING]
+        assert eng.auditor.events[0][1]["attrs"]["extra"] == "x"
+
+        # Steady firing: no row churn, no re-page, gauge holds.
+        before = reg.get_alerts(run.id)[0]["id"]
+        assert eng.evaluate(run.id, now=120.0) == []
+        assert reg.get_alerts(run.id)[0]["id"] == before
+        assert len(eng.auditor.events) == 1
+
+    def test_resolve_notifies_and_keeps_fired_at(self, reg, run):
+        flag = Flag()
+        rule = AlertRule("probe", AlertSeverity.WARNING, 0.0, flag)
+        eng = make_engine(reg, [rule])
+        flag.on = True
+        eng.evaluate(run.id, now=50.0)
+        flag.on = False
+        out = eng.evaluate(run.id, now=60.0)
+        assert [r["state"] for r in out] == [AlertState.RESOLVED]
+        row = reg.get_alerts(run.id)[0]
+        assert row["fired_at"] == 50.0
+        assert row["resolved_at"] == 60.0
+        assert [e[0] for e in eng.auditor.events] == [
+            EventTypes.ALERT_FIRING,
+            EventTypes.ALERT_RESOLVED,
+        ]
+        gkey = alert_gauge_key("probe", run.id, AlertSeverity.WARNING)
+        assert eng.stats.gauges[gkey] == GAUGE_OK
+
+    def test_zero_holddown_fires_same_tick(self, reg, run):
+        flag = Flag()
+        flag.on = True
+        eng = make_engine(
+            reg, [AlertRule("probe", AlertSeverity.CRITICAL, 0.0, flag)]
+        )
+        out = eng.evaluate(run.id, now=10.0)
+        # Two transition rows in one tick — the pending edge stays visible
+        # to since_id pagers even when the hold-down is zero.
+        assert [r["state"] for r in out] == [
+            AlertState.PENDING,
+            AlertState.FIRING,
+        ]
+        assert out[1]["id"] > out[0]["id"]
+        assert len(reg.get_alerts(run.id)) == 1
+
+    def test_flap_inside_holddown_vanishes_silently(self, reg, run):
+        flag = Flag()
+        rule = AlertRule("probe", AlertSeverity.WARNING, 30.0, flag)
+        eng = make_engine(reg, [rule])
+        flag.on = True
+        eng.evaluate(run.id, now=100.0)
+        assert reg.get_alerts(run.id)[0]["state"] == AlertState.PENDING
+        flag.on = False
+        out = eng.evaluate(run.id, now=105.0)
+        # Recovered inside the hold-down: the row is deleted, not resolved
+        # — nobody was paged, so there is nothing to un-page.
+        assert out == []
+        assert reg.get_alerts(run.id) == []
+        assert eng.auditor.events == []
+        gkey = alert_gauge_key("probe", run.id, AlertSeverity.WARNING)
+        assert eng.stats.gauges[gkey] == GAUGE_OK
+
+    def test_refire_counts_episodes(self, reg, run):
+        flag = Flag()
+        rule = AlertRule("probe", AlertSeverity.WARNING, 0.0, flag)
+        eng = make_engine(reg, [rule])
+        flag.on = True
+        eng.evaluate(run.id, now=10.0)
+        flag.on = False
+        eng.evaluate(run.id, now=20.0)
+        flag.on = True
+        out = eng.evaluate(run.id, now=30.0)
+        assert out[-1]["state"] == AlertState.FIRING
+        assert out[-1]["episodes"] == 2
+
+    def test_finalize_resolves_firing_drops_pending(self, reg, run):
+        hot, warm = Flag(), Flag()
+        hot.on = warm.on = True
+        eng = make_engine(
+            reg,
+            [
+                AlertRule("hot", AlertSeverity.CRITICAL, 0.0, hot),
+                AlertRule("warm", AlertSeverity.WARNING, 60.0, warm),
+            ],
+        )
+        eng.evaluate(run.id, now=5.0)
+        states = {r["rule"]: r["state"] for r in reg.get_alerts(run.id)}
+        assert states == {
+            "hot": AlertState.FIRING,
+            "warm": AlertState.PENDING,
+        }
+        out = eng.finalize(run.id, now=9.0)
+        assert [r["rule"] for r in out] == ["hot"]
+        assert out[0]["state"] == AlertState.RESOLVED
+        assert "run finished" in out[0]["message"]
+        rows = reg.get_alerts(run.id)
+        assert [r["rule"] for r in rows] == ["hot"]
+        assert eng.auditor.events[-1][0] == EventTypes.ALERT_RESOLVED
+        for rule_name, sev in (("hot", "critical"), ("warm", "warning")):
+            assert (
+                eng.stats.gauges[alert_gauge_key(rule_name, run.id, sev)]
+                == GAUGE_OK
+            )
+
+
+class TestEngineMechanics:
+    def test_interval_throttles_per_run(self, reg, run):
+        flag = Flag()
+        flag.on = True
+        eng = make_engine(
+            reg,
+            [AlertRule("probe", AlertSeverity.WARNING, 0.0, flag)],
+            interval_s=10.0,
+        )
+        assert eng.evaluate(run.id, now=100.0) != []
+        assert eng.evaluate(run.id, now=104.0) == []  # throttled
+        assert eng.ticks == 1
+        flag.on = False
+        assert eng.evaluate(run.id, now=111.0) != []  # past the interval
+        assert eng.ticks == 2
+
+    def test_rule_error_is_counted_not_raised(self, reg, run):
+        def boom(ctx):
+            raise RuntimeError("bad rule")
+
+        flag = Flag()
+        flag.on = True
+        eng = make_engine(
+            reg,
+            [
+                AlertRule("boom", AlertSeverity.INFO, 0.0, boom),
+                AlertRule("probe", AlertSeverity.WARNING, 0.0, flag),
+            ],
+        )
+        out = eng.evaluate(run.id, now=1.0)
+        # The broken rule neither raises nor starves its neighbors.
+        assert {r["rule"] for r in out} == {"probe"}
+        assert eng.eval_errors == 1
+        assert eng.stats.counters["alert_eval_errors"] == 1
+
+    def test_accepts_gang_handle_shaped_objects(self, reg, run):
+        class Handle:
+            run_id = run.id
+
+        eng = make_engine(reg, [])
+        assert eng.evaluate(Handle(), now=1.0) == []
+        assert eng.ticks == 1
+
+    def test_status_shape(self, reg):
+        eng = AlertEngine(reg, interval_s=2.5)
+        st = eng.status()
+        assert st["interval_s"] == 2.5
+        assert st["ticks"] == 0
+        assert "run_stalled" in st["rules"]
+
+
+class TestParamResolution:
+    def test_for_s_override_via_declaration(self, reg):
+        spec = dict(SPEC)
+        spec["declarations"] = {"alert.probe.for_s": 0}
+        run = reg.create_run(spec)
+        flag = Flag()
+        flag.on = True
+        eng = make_engine(
+            reg, [AlertRule("probe", AlertSeverity.WARNING, 600.0, flag)]
+        )
+        out = eng.evaluate(run.id, now=1.0)
+        assert out[-1]["state"] == AlertState.FIRING
+
+    def test_disable_via_declaration(self, reg):
+        spec = dict(SPEC)
+        spec["declarations"] = {"alert.probe.enabled": False}
+        run = reg.create_run(spec)
+        flag = Flag()
+        flag.on = True
+        eng = make_engine(
+            reg, [AlertRule("probe", AlertSeverity.WARNING, 0.0, flag)]
+        )
+        assert eng.evaluate(run.id, now=1.0) == []
+        assert reg.get_alerts(run.id) == []
+
+    def test_disable_via_env(self, reg, run, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_ALERT_PROBE_ENABLED", "false")
+        flag = Flag()
+        flag.on = True
+        eng = make_engine(
+            reg, [AlertRule("probe", AlertSeverity.WARNING, 0.0, flag)]
+        )
+        assert eng.evaluate(run.id, now=1.0) == []
+
+    def test_env_param_beaten_by_declaration(self, reg, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_ALERT_PROBE_FOR_S", "600")
+        spec = dict(SPEC)
+        spec["declarations"] = {"alert.probe.for_s": 0}
+        run = reg.create_run(spec)
+        ctx = RuleContext(reg, reg.get_run(run.id))
+        assert ctx.param("probe", "for_s", 30.0) == 0.0
+        monkeypatch.delenv("POLYAXON_TPU_ALERT_PROBE_FOR_S")
+        plain = reg.create_run(dict(SPEC))
+        ctx2 = RuleContext(reg, reg.get_run(plain.id))
+        assert ctx2.param("probe", "for_s", 30.0) == 30.0
+
+
+class TestBuiltinCatalog:
+    def _ctx(self, reg, run, stats=None, now=1000.0):
+        return RuleContext(reg, reg.get_run(run.id), stats=stats, now=now)
+
+    def _rules(self):
+        return {r.name: r for r in default_rules()}
+
+    def test_catalog_names_and_severities(self):
+        rules = self._rules()
+        assert set(rules) == {
+            "run_stalled",
+            "gang_straggler",
+            "heartbeat_stale",
+            "goodput_low",
+            "mfu_low",
+            "serving_ttft_p99",
+            "steady_state_compiles",
+            "compile_cache_miss",
+        }
+        assert rules["run_stalled"].severity == AlertSeverity.CRITICAL
+        assert rules["heartbeat_stale"].severity == AlertSeverity.CRITICAL
+        assert rules["compile_cache_miss"].severity == AlertSeverity.INFO
+
+    def test_run_stalled_carries_dump_artifact(self, reg, run):
+        reg.add_anomaly(
+            run.id,
+            "stall",
+            message="wedged",
+            attrs={"dump_artifact": "reports/flight_stall_1.json"},
+        )
+        ctx = self._ctx(reg, run)
+        ctx._anomaly = {
+            "stalled": True,
+            "stall_age_s": 7.5,
+            "stragglers": [],
+            "progress": [{"step": 9}],
+        }
+        out = self._rules()["run_stalled"].check(ctx)
+        assert out["value"] == 7.5
+        assert out["dump_artifact"] == "reports/flight_stall_1.json"
+        ctx._anomaly["stalled"] = False
+        assert self._rules()["run_stalled"].check(ctx) is None
+
+    def test_gang_straggler_picks_worst(self, reg, run):
+        ctx = self._ctx(reg, run)
+        ctx._anomaly = {
+            "stalled": False,
+            "stall_age_s": 0.0,
+            "stragglers": [
+                {"process_id": 1, "lag_steps": 25},
+                {"process_id": 3, "lag_steps": 90},
+            ],
+            "progress": [],
+        }
+        out = self._rules()["gang_straggler"].check(ctx)
+        assert out["value"] == 90
+        assert "proc 3" in out["message"]
+
+    def test_heartbeat_stale(self, reg, run):
+        rule = self._rules()["heartbeat_stale"]
+        ctx = self._ctx(reg, run, now=1000.0)
+        # Never heartbeated: not this rule's problem (reconcile owns it).
+        assert rule.check(ctx) is None
+        reg.ping_heartbeat(run.id, at=500.0)
+        out = rule.check(self._ctx(reg, run, now=1000.0))
+        assert out["value"] == 500.0
+        reg.ping_heartbeat(run.id, at=990.0)
+        assert rule.check(self._ctx(reg, run, now=1000.0)) is None
+
+    def test_goodput_and_mfu_floors_off_by_default(self, reg, run):
+        ctx = self._ctx(reg, run)
+        ctx._goodput = {
+            "rows": 4,
+            "wall_s": 600.0,
+            "goodput_ratio": 0.05,
+            "mfu": 0.01,
+            "compile_cache_hits": 0,
+            "compile_cache_misses": 0,
+        }
+        assert self._rules()["goodput_low"].check(ctx) is None
+        assert self._rules()["mfu_low"].check(ctx) is None
+
+    def test_goodput_low_with_declared_floor(self, reg, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_ALERT_GOODPUT_LOW_FLOOR", "0.8")
+        run = reg.create_run(dict(SPEC))
+        ctx = self._ctx(reg, run)
+        ctx._goodput = {
+            "rows": 4,
+            "wall_s": 600.0,
+            "goodput_ratio": 0.4,
+            "mfu": 0.0,
+            "compile_cache_hits": 0,
+            "compile_cache_misses": 0,
+        }
+        out = self._rules()["goodput_low"].check(ctx)
+        assert out["value"] == 0.4
+        assert out["floor"] == 0.8
+        # Warm-up grace: too little wall clock → no verdict yet.
+        ctx._goodput["wall_s"] = 10.0
+        assert self._rules()["goodput_low"].check(ctx) is None
+
+    def test_serving_ttft_p99(self, reg, run, monkeypatch):
+        rule = self._rules()["serving_ttft_p99"]
+        stats = MemoryStats()
+        for _ in range(100):
+            stats.observe("serving.ttft_s", 2.0)
+        ctx = self._ctx(reg, run, stats=stats)
+        # Off until a latency SLO is declared.
+        assert rule.check(ctx) is None
+        monkeypatch.setenv(
+            "POLYAXON_TPU_ALERT_SERVING_TTFT_P99_THRESHOLD_S", "0.5"
+        )
+        out = rule.check(self._ctx(reg, run, stats=stats))
+        assert out["value"] > 0.5
+        assert "p99" in out["message"]
+
+    def test_steady_state_compiles(self, reg, run):
+        rule = self._rules()["steady_state_compiles"]
+        stats = MemoryStats()
+        assert rule.check(self._ctx(reg, run, stats=stats)) is None
+        stats.incr("serving.steady_state_compiles", 3)
+        out = rule.check(self._ctx(reg, run, stats=stats))
+        assert out["value"] == 3.0
+
+    def test_compile_cache_miss_ratio(self, reg, run):
+        rule = self._rules()["compile_cache_miss"]
+        ctx = self._ctx(reg, run)
+        ctx._goodput = {
+            "rows": 2,
+            "wall_s": 100.0,
+            "goodput_ratio": 1.0,
+            "mfu": 0.0,
+            "compile_cache_hits": 1,
+            "compile_cache_misses": 9,
+        }
+        out = rule.check(ctx)
+        assert out["value"] == 0.9
+        # Below the min-events floor: not enough signal to call it.
+        ctx._goodput["compile_cache_misses"] = 2
+        ctx._goodput["compile_cache_hits"] = 0
+        assert rule.check(ctx) is None

@@ -59,3 +59,206 @@ class TestEmailAction:
             host="x", sender="s@x", recipients=["r@x"], transport=bad_transport
         )
         assert action.execute({"event_type": "e"}) is False
+
+
+class TestWebhookRetry:
+    """Hardened webhook: bounded retry with exponential backoff on
+    connection-level failures, no retry on 4xx, dead-letter log line after
+    the final failure."""
+
+    def _action(self, monkeypatch, outcomes, **kw):
+        """A WebhookAction whose POSTs pop from ``outcomes`` (an exception
+        to raise, or None for success); sleeps are recorded, not slept."""
+        import urllib.request
+
+        from polyaxon_tpu.notifier import actions as mod
+
+        calls = {"posts": 0, "sleeps": []}
+
+        def fake_urlopen(req, timeout=None):
+            calls["posts"] += 1
+            out = outcomes.pop(0)
+            if out is not None:
+                raise out
+
+            class _Resp:
+                def __enter__(self):
+                    return self
+
+                def __exit__(self, *a):
+                    return False
+
+            return _Resp()
+
+        monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+        monkeypatch.setattr(
+            mod.time, "sleep", lambda s: calls["sleeps"].append(s)
+        )
+        return mod.WebhookAction("http://sink.example/hook", **kw), calls
+
+    def test_retries_connection_errors_with_backoff(self, monkeypatch):
+        import urllib.error
+
+        action, calls = self._action(
+            monkeypatch,
+            [
+                urllib.error.URLError("refused"),
+                ConnectionResetError("reset"),
+                None,
+            ],
+        )
+        assert action.execute({"event_type": "alert.firing"}) is True
+        assert calls["posts"] == 3
+        assert calls["sleeps"] == [0.5, 1.0]  # exponential
+
+    def test_retries_5xx_but_not_4xx(self, monkeypatch):
+        import urllib.error
+
+        def http_error(code):
+            return urllib.error.HTTPError(
+                "http://sink.example/hook", code, "err", {}, None
+            )
+
+        action, calls = self._action(monkeypatch, [http_error(503), None])
+        assert action.execute({"event_type": "alert.firing"}) is True
+        assert calls["posts"] == 2
+
+        action, calls = self._action(monkeypatch, [http_error(404)])
+        assert action.execute({"event_type": "alert.firing"}) is False
+        assert calls["posts"] == 1  # the receiver said no; don't repeat it
+        assert calls["sleeps"] == []
+
+    def test_dead_letter_after_exhausted_retries(self, monkeypatch, caplog):
+        import logging
+
+        action, calls = self._action(
+            monkeypatch,
+            [ConnectionError("down")] * 3,
+            max_attempts=3,
+        )
+        with caplog.at_level(logging.ERROR, logger="polyaxon_tpu.notifier.actions"):
+            assert action.execute(
+                {"event_type": "alert.firing", "rule": "run_stalled"}
+            ) is False
+        assert calls["posts"] == 3
+        dead = [r for r in caplog.records if "webhook dead-letter" in r.getMessage()]
+        assert dead, caplog.text
+        # The payload rides in the dead-letter line — a lost page is
+        # greppable, never silent.
+        assert "run_stalled" in dead[0].getMessage()
+        assert "after 3 attempt(s)" in dead[0].getMessage()
+
+
+class TestDispatchCounters:
+    def test_notifier_counts_outcomes_per_action(self):
+        from polyaxon_tpu.events import Event
+        from polyaxon_tpu.notifier.actions import Action, CallbackAction
+        from polyaxon_tpu.notifier.service import Notifier
+        from polyaxon_tpu.stats.backends import MemoryStats
+        from polyaxon_tpu.stats.metrics import labeled_key, render_prometheus
+
+        class FailingAction(Action):
+            name = "flaky"
+
+            def _execute(self, payload):
+                raise ConnectionError("down")
+
+        stats = MemoryStats()
+        notifier = Notifier(
+            [CallbackAction(lambda p: None), FailingAction()], stats=stats
+        )
+        notifier(Event("experiment.done", {"run_id": 1}))
+        notifier(Event("experiment.done", {"run_id": 2}))
+        notifier.flush()
+        snap = stats.snapshot()["counters"]
+        ok_key = labeled_key("notifier_dispatch", action="callback", outcome="ok")
+        err_key = labeled_key("notifier_dispatch", action="flaky", outcome="error")
+        assert snap[ok_key] == 2
+        assert snap[err_key] == 2
+        text = render_prometheus(stats.snapshot())
+        assert (
+            'polyaxon_tpu_notifier_dispatch_total{action="callback",outcome="ok"} 2'
+            in text
+        )
+
+
+class TestAlertRouter:
+    def _sinks(self):
+        from polyaxon_tpu.notifier.actions import CallbackAction
+
+        hits = {"pager": [], "chat": [], "log": []}
+
+        def sink(name):
+            a = CallbackAction(lambda p, n=name: hits[n].append(p))
+            a.name = name
+            return a
+
+        return hits, {n: sink(n) for n in hits}
+
+    def test_route_parsing(self):
+        from polyaxon_tpu.notifier.service import parse_alert_routes
+
+        assert parse_alert_routes(None) == {}
+        assert parse_alert_routes(" critical : pager , chat ; info:log ") == {
+            "critical": ["pager", "chat"],
+            "info": ["log"],
+        }
+
+    def test_severity_picks_sink_subset(self):
+        from polyaxon_tpu.events import Event
+        from polyaxon_tpu.notifier.service import AlertRouter
+
+        hits, sinks = self._sinks()
+        router = AlertRouter(
+            sinks, routes={"critical": ["pager"], "info": ["log"]}
+        )
+        router(Event("alert.firing", {"severity": "critical", "rule": "r"}))
+        router.flush()
+        assert len(hits["pager"]) == 1 and not hits["chat"] and not hits["log"]
+        # Severity missing from the map: every sink hears about it.
+        router(Event("alert.firing", {"severity": "warning", "rule": "r"}))
+        router.flush()
+        assert len(hits["pager"]) == 2 and len(hits["chat"]) == 1
+        # Non-alert events are not the router's business.
+        router(Event("experiment.done", {"severity": "critical"}))
+        router.flush()
+        assert len(hits["pager"]) == 2
+
+    def test_resolved_follows_firing_route(self):
+        from polyaxon_tpu.events import Event
+        from polyaxon_tpu.notifier.service import AlertRouter
+
+        hits, sinks = self._sinks()
+        router = AlertRouter(sinks, routes={"critical": ["pager"]})
+        router(Event("alert.resolved", {"severity": "critical", "rule": "r"}))
+        router.flush()
+        assert len(hits["pager"]) == 1
+        assert hits["pager"][0]["event_type"] == "alert.resolved"
+
+    def test_unknown_sink_name_warns_but_delivers_rest(self, caplog):
+        import logging
+
+        from polyaxon_tpu.events import Event
+        from polyaxon_tpu.notifier.service import AlertRouter
+
+        hits, sinks = self._sinks()
+        router = AlertRouter(
+            sinks, routes={"critical": ["pagerduty_typo", "pager"]}
+        )
+        with caplog.at_level(logging.WARNING, logger="polyaxon_tpu.notifier.service"):
+            router(Event("alert.firing", {"severity": "critical"}))
+            router.flush()
+        assert len(hits["pager"]) == 1
+        assert any("unknown sink" in r.getMessage() for r in caplog.records)
+
+    def test_route_all_fallback(self):
+        from polyaxon_tpu.events import Event
+        from polyaxon_tpu.notifier.service import ROUTE_ALL, AlertRouter
+
+        hits, sinks = self._sinks()
+        router = AlertRouter(
+            sinks, routes={"critical": ["pager"], ROUTE_ALL: ["log"]}
+        )
+        router(Event("alert.firing", {"severity": "info"}))
+        router.flush()
+        assert len(hits["log"]) == 1 and not hits["pager"]
