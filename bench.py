@@ -819,12 +819,41 @@ def main() -> None:
             conc_dt = time.perf_counter() - t0
         finally:
             eng.stop()
+        # Fully-quantized serving: int8 weights (the PR 6 streaming path)
+        # AND an int8 KV pool (rows + per-row scales) — same prompts,
+        # same concurrent burst.  Decode is HBM-bandwidth-bound, so on
+        # real hardware the int8 stream is the throughput story; on the
+        # CPU smoke this is a correctness/steady-state check and the pool
+        # byte ratio is the claim that transfers.
+        qweights = decode_mod.quantize_weights(sparams)
+        eng8 = ServingEngine(
+            sparams, scfg, slots=slots, max_len=scfg.max_seq,
+            qweights=qweights, kv_quantize="int8",
+        ).start()
+        eng8.wait_ready(timeout=600)
+        try:
+            for t in lengths:
+                eng8.submit([1] * t, 2).wait(timeout=600)
+            t0 = time.perf_counter()
+            reqs = [eng8.submit(p, max_new) for p in prompts]
+            for r in reqs:
+                r.wait(timeout=600)
+            conc8_dt = time.perf_counter() - t0
+            int8_steady = eng8.stats()["steady_state_compiles"]
+        finally:
+            eng8.stop()
         total = n_req * max_new
         serving = {
             "tokens_per_s": round(total / conc_dt),
             "sequential_tokens_per_s": round(total / seq_dt),
             "speedup": round(seq_dt / conc_dt, 2),
             "offline_generate_tokens_per_s": round(total / offline_dt),
+            "tokens_per_s_int8": round(total / conc8_dt),
+            "int8_vs_f32": round(conc_dt / conc8_dt, 2),
+            "kv_pool_bytes": eng.kv_pool_bytes,
+            "kv_pool_bytes_int8": eng8.kv_pool_bytes,
+            "kv_pool_ratio": round(eng8.kv_pool_bytes / eng.kv_pool_bytes, 3),
+            "int8_steady_state_compiles": int8_steady,
             "n_requests": n_req,
             "slots": slots,
             "ready_s": round(serving_ready_s, 3),
@@ -977,6 +1006,74 @@ def main() -> None:
 
         traceback.print_exc(file=sys.stderr)
 
+    # Fixed-HBM A/B: the int8 KV pool's CAPACITY claim under load.  Same
+    # mix, same Poisson schedule, but the pool is now the binding
+    # resource: the f32 side gets ~1.5 long-request spans of blocks, so
+    # two longs in flight contend (park, or shed on true deadlock); the
+    # int8 side gets the SAME byte budget, which at (d+4) vs 4d bytes
+    # per head-row holds >2x the blocks (``decode.kv_block_bytes`` is
+    # the sizing primitive, test-pinned to the real leaf nbytes).
+    # Completions / tokens-per-s / parks at equal HBM are the honest
+    # comparison — this is "double the live batch at a fixed memory
+    # budget" measured rather than asserted.
+    serving_int8_kv = None
+    try:
+        if serving_loaded is None:
+            raise RuntimeError(
+                "loaded serving section did not run; skipping fixed-HBM A/B"
+            )
+        from polyaxon_tpu.models import decode as decode_mod
+
+        ab_bs = 16
+        span = -(-(long_len + lmax_new) // ab_bs)  # blocks one long spans
+        kv_blocks_f32 = 1 + span + span // 2
+        budget = kv_blocks_f32 * decode_mod.kv_block_bytes(lcfg, ab_bs)
+        kv_blocks_int8 = int(
+            budget // decode_mod.kv_block_bytes(lcfg, ab_bs, "int8")
+        )
+
+        def fixed_hbm_run(num_blocks, kv_quantize):
+            eng = ServingEngine(
+                lparams, lcfg, slots=lslots, max_len=lcfg.max_seq,
+                block_size=ab_bs, num_blocks=num_blocks,
+                prefill_chunk=lchunk, prefix_cache=False,
+                kv_quantize=kv_quantize,
+            ).start()
+            try:
+                for t in (long_len, short_len):
+                    eng.submit([1] * t, 2).wait(timeout=600)
+                res = poisson_load(
+                    eng, loaded_prompts, lmax_new, rate_rps=lrate, seed=23
+                )
+                res["block_parks"] = eng.stats()["block_parks"]
+                res["kv_pool_bytes"] = eng.kv_pool_bytes
+            finally:
+                eng.stop()
+            return res
+
+        ab_f32 = fixed_hbm_run(kv_blocks_f32, None)
+        ab_int8 = fixed_hbm_run(kv_blocks_int8, "int8")
+        serving_int8_kv = {  # [f32 pool, int8 pool] at equal pool bytes
+            "kv_blocks": [kv_blocks_f32, kv_blocks_int8],
+            "pool_bytes": [
+                ab_f32["kv_pool_bytes"], ab_int8["kv_pool_bytes"]
+            ],
+            "tokens_per_s": [
+                ab_f32["tokens_per_s"], ab_int8["tokens_per_s"]
+            ],
+            "completed": [ab_f32["completed"], ab_int8["completed"]],
+            "errors": [ab_f32["errors"], ab_int8["errors"]],
+            "block_parks": [ab_f32["block_parks"], ab_int8["block_parks"]],
+            "ttft_p99_s": [ab_f32["ttft_p99_s"], ab_int8["ttft_p99_s"]],
+            "offered_rps": round(lrate, 2),
+            "n_requests": n_loaded,
+        }
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+
     # Training input pipeline: the overlapped hot loop (host prefetch +
     # device prefetch + async metrics, runtime/pipeline.py) vs the same
     # loop fully synchronous, on a dataset-backed image-classifier config.
@@ -1103,6 +1200,7 @@ def main() -> None:
     longctx_vs_baseline = None
     hpsearch_vs_baseline = None
     serving_vs_baseline = None
+    serving_int8_vs_baseline = None
     serving_loaded_vs_baseline = None
     train_images_vs_baseline = None
     if on_tpu:
@@ -1138,6 +1236,19 @@ def main() -> None:
                 )
             else:
                 base["serving_tokens_per_s"] = serving["tokens_per_s"]
+        # The quantized serving path gates on its own baseline — an int8
+        # dequant-fusion regression must not hide behind the f32 number.
+        if serving is not None and serving.get("tokens_per_s_int8"):
+            if base.get("serving_tokens_per_s_int8"):
+                serving_int8_vs_baseline = round(
+                    serving["tokens_per_s_int8"]
+                    / base["serving_tokens_per_s_int8"],
+                    3,
+                )
+            else:
+                base["serving_tokens_per_s_int8"] = serving[
+                    "tokens_per_s_int8"
+                ]
         # Loaded serving throughput gates separately — paging/prefill
         # regressions show up here before the instant-burst number moves.
         if serving_loaded is not None:
@@ -1184,6 +1295,11 @@ def main() -> None:
                 "longctx_vs_baseline": longctx_vs_baseline,
                 "serving_tokens_per_s": serving,
                 "serving_vs_baseline": serving_vs_baseline,
+                "serving_tokens_per_s_int8": (
+                    serving.get("tokens_per_s_int8") if serving else None
+                ),
+                "serving_int8_vs_baseline": serving_int8_vs_baseline,
+                "serving_int8_kv": serving_int8_kv,
                 "serving_ttft_p99_s": (
                     serving_loaded["ttft_p99_s"] if serving_loaded else None
                 ),

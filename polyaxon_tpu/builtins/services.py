@@ -322,7 +322,10 @@ def lm_server(ctx: Context) -> None:
     prompt+generation length per request), ``slots`` (concurrent
     sequences the batch holds), ``block_size`` (tokens per KV block),
     ``kv_blocks`` (pool size override — size below slots×seq to
-    overcommit on prefix sharing), ``prefill_chunk`` (prompt tokens
+    overcommit on prefix sharing), ``kv_quantize`` (``int8`` stores the
+    KV pool quantized with per-row scales — <0.3× the pool HBM, so a
+    fixed byte budget holds >2× the blocks; composes with ``quantize``),
+    ``prefill_chunk`` (prompt tokens
     inserted per scheduler iteration; 0/unset = whole-prompt),
     ``prefix_cache`` (share identical prompt prefixes, default on),
     ``request_timeout_s`` (server-side wait budget per /generate),
@@ -419,6 +422,9 @@ def lm_server(ctx: Context) -> None:
     eos_id = ctx.get_param("eos_id")
     kv_blocks = ctx.get_param("kv_blocks")
     prefill_chunk = int(ctx.get_param("prefill_chunk", 0) or 0)
+    kv_quantize = str(ctx.get_param("kv_quantize", "") or "") or None
+    if kv_quantize:
+        ctx.log_text(f"lm_server: kv_quantize={kv_quantize} KV pool enabled")
     engine = ServingEngine(
         params,
         cfg,
@@ -430,6 +436,7 @@ def lm_server(ctx: Context) -> None:
         prefix_cache=str(ctx.get_param("prefix_cache", "1")).lower()
         not in ("0", "false", "no"),
         qweights=qweights,
+        kv_quantize=kv_quantize,
         mesh=mesh if template is not None else None,
         eos_id=int(eos_id) if eos_id is not None else None,
         seed=ctx.seed or 0,

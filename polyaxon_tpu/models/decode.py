@@ -363,15 +363,117 @@ def slot_decode_step(
 
 
 def init_block_pool(
-    cfg: TransformerConfig, num_blocks: int, block_size: int
+    cfg: TransformerConfig,
+    num_blocks: int,
+    block_size: int,
+    kv_dtype: Optional[str] = None,
 ) -> Dict[str, jax.Array]:
-    """Zeroed paged KV pool: k/v [L, num_blocks, block_size, Hkv, d]."""
+    """Zeroed paged KV pool: k/v [L, num_blocks, block_size, Hkv, d].
+
+    With ``kv_dtype="int8"`` the pool instead stores symmetric-quantized
+    rows plus their scales — ``k_q``/``v_q`` int8 [L, NB, bs, Hkv, d] and
+    ``k_scale``/``v_scale`` f32 [L, NB, bs, Hkv] (one scale per appended
+    row per kv-head, so appends quantize once and never touch rows
+    already in the block).  At head_dim d that is (d + 4) bytes per head
+    row versus 4d for an f32 pool — under 0.3× the HBM at the same
+    ``num_blocks × block_size``, i.e. >2× the live blocks at a fixed
+    memory budget.
+    """
     c = cfg
     shape = (c.n_layers, num_blocks, block_size, c.kv_heads, c.head_dim)
+    if kv_dtype is None:
+        return {
+            "k": jnp.zeros(shape, c.dtype),
+            "v": jnp.zeros(shape, c.dtype),
+        }
+    if str(kv_dtype) != "int8":
+        raise ValueError(f"unsupported kv_dtype {kv_dtype!r} (int8 or None)")
     return {
-        "k": jnp.zeros(shape, c.dtype),
-        "v": jnp.zeros(shape, c.dtype),
+        "k_q": jnp.zeros(shape, jnp.int8),
+        "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+        "v_q": jnp.zeros(shape, jnp.int8),
+        "v_scale": jnp.zeros(shape[:-1], jnp.float32),
     }
+
+
+def is_quantized_pool(pool: Dict[str, jax.Array]) -> bool:
+    """True for the (k_q, k_scale, v_q, v_scale) int8 pool layout."""
+    return "k_q" in pool
+
+
+def pool_geometry(pool: Dict[str, jax.Array]) -> Tuple[int, int, int]:
+    """(block_size, kv_heads, head_dim) for either pool layout."""
+    leaf = pool["k_q"] if is_quantized_pool(pool) else pool["k"]
+    return leaf.shape[2], leaf.shape[3], leaf.shape[4]
+
+
+def kv_block_bytes(
+    cfg: TransformerConfig, block_size: int, kv_dtype: Optional[str] = None
+) -> int:
+    """Device bytes ONE pool block costs (all layers, k+v, incl. scales).
+
+    The sizing primitive for fixed-HBM capacity math: at a fixed byte
+    budget B the pool holds ``B // kv_block_bytes(...)`` blocks.
+    """
+    c = cfg
+    rows = c.n_layers * block_size * c.kv_heads  # head-rows per block
+    if kv_dtype is None:
+        return 2 * rows * c.head_dim * jnp.dtype(c.dtype).itemsize
+    if str(kv_dtype) != "int8":
+        raise ValueError(f"unsupported kv_dtype {kv_dtype!r} (int8 or None)")
+    return 2 * rows * (c.head_dim + 4)  # int8 row + one f32 scale
+
+
+def _kv_quant(rows: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 per-head-row quantization: rows [..., Hkv, d] →
+    (int8 [..., Hkv, d], f32 scale [..., Hkv]).  Zero rows (trash-lane
+    writes, padding) get scale 0 and dequantize back to exact zeros."""
+    r = rows.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(r), axis=-1) / 127.0
+    q = jnp.round(r / jnp.where(scale > 0, scale, 1.0)[..., None])
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def _kv_dequant(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Fused-into-the-read dequant (the ``_wdq`` pattern for KV): the
+    gather streams int8 + one scale per head row; XLA fuses the widen
+    and multiply into the attention einsum's operand read."""
+    return q.astype(dtype) * scale[..., None].astype(dtype)
+
+
+def _pool_append(
+    pool_l: Dict[str, jax.Array],
+    name: str,
+    rows: jax.Array,
+    write_blk: jax.Array,
+    write_off: jax.Array,
+) -> Dict[str, jax.Array]:
+    """Scatter freshly-computed KV rows for one layer into per-layer pool
+    leaves at (write_blk, write_off), quantizing on append for the int8
+    layout.  ``rows``: [N, Hkv, d] aligned with write_blk/write_off [N]."""
+    if name + "_q" in pool_l:
+        q, scale = _kv_quant(rows)
+        return {
+            **pool_l,
+            name + "_q": pool_l[name + "_q"].at[write_blk, write_off].set(q),
+            name + "_scale": pool_l[name + "_scale"]
+            .at[write_blk, write_off]
+            .set(scale),
+        }
+    leaf = pool_l[name]
+    return {**pool_l, name: leaf.at[write_blk, write_off].set(rows.astype(leaf.dtype))}
+
+
+def _pool_gather(
+    pool_l: Dict[str, jax.Array], name: str, table: jax.Array, dtype
+) -> jax.Array:
+    """Gather a layer's KV rows for a block table, dequantizing int8
+    leaves fused into the read.  table [..., W] → [..., W, bs, Hkv, d]."""
+    if name + "_q" in pool_l:
+        return _kv_dequant(
+            pool_l[name + "_q"][table], pool_l[name + "_scale"][table], dtype
+        )
+    return pool_l[name][table]
 
 
 def copy_block(
@@ -380,13 +482,15 @@ def copy_block(
     """Copy one physical block's KV rows (all layers) — the copy-on-write
     primitive: a shared block a sequence must write into is duplicated
     into a private block first.  ``src``/``dst`` are traced scalars, so
-    every COW reuses one compilation."""
-    k = lax.dynamic_slice_in_dim(pool["k"], src, 1, axis=1)
-    v = lax.dynamic_slice_in_dim(pool["v"], src, 1, axis=1)
-    return {
-        "k": lax.dynamic_update_slice(pool["k"], k, (0, dst, 0, 0, 0)),
-        "v": lax.dynamic_update_slice(pool["v"], v, (0, dst, 0, 0, 0)),
-    }
+    every COW reuses one compilation.  Generic over the pool layout: an
+    int8 pool's quantized rows and scales copy bit-exact, so a COW'd
+    block dequantizes identically to the shared original."""
+    out = {}
+    for name, leaf in pool.items():
+        sl = lax.dynamic_slice_in_dim(leaf, src, 1, axis=1)
+        idx = (0, dst) + (0,) * (leaf.ndim - 2)
+        out[name] = lax.dynamic_update_slice(leaf, sl, idx)
+    return out
 
 
 def paged_prefill_chunk(
@@ -421,8 +525,7 @@ def paged_prefill_chunk(
     c = cfg
     C = tokens.shape[0]
     W = table.shape[0]
-    bs = pool["k"].shape[2]
-    Hkv, d = pool["k"].shape[3], pool["k"].shape[4]
+    bs, Hkv, d = pool_geometry(pool)
     group = c.n_heads // c.kv_heads
 
     qpos = start + jnp.arange(C)  # [C] absolute positions
@@ -437,7 +540,7 @@ def paged_prefill_chunk(
     positions = qpos[None]  # [1, C]
 
     def layer_body(x, inputs):
-        layer, pk, pv = inputs  # pk/pv: [NB, bs, Hkv, d]
+        layer, pool_l = inputs  # pool_l leaves: [NB, bs, Hkv, ...]
         h = _rmsnorm(x, layer["attn_norm"])
         q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(h.dtype))
         k = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(h.dtype))
@@ -446,10 +549,10 @@ def paged_prefill_chunk(
         k = _rope(k, positions, c.rope_theta)
         # Write the chunk's KV rows, then attend against the whole table —
         # the rows just written ARE the chunk's causal self-attention keys.
-        pk = pk.at[write_blk, write_off].set(k[0].astype(pk.dtype))
-        pv = pv.at[write_blk, write_off].set(v[0].astype(pv.dtype))
-        ck = pk[table].reshape(1, W * bs, Hkv, d)
-        cv = pv[table].reshape(1, W * bs, Hkv, d)
+        pool_l = _pool_append(pool_l, "k", k[0], write_blk, write_off)
+        pool_l = _pool_append(pool_l, "v", v[0], write_blk, write_off)
+        ck = _pool_gather(pool_l, "k", table, h.dtype).reshape(1, W * bs, Hkv, d)
+        cv = _pool_gather(pool_l, "v", table, h.dtype).reshape(1, W * bs, Hkv, d)
         if group > 1:
             ck = jnp.repeat(ck, group, axis=2)
             cv = jnp.repeat(cv, group, axis=2)
@@ -461,15 +564,13 @@ def paged_prefill_chunk(
         gate = jnp.einsum("btd,df->btf", h, layer["wg"].astype(h.dtype))
         y = jax.nn.silu(gate) * up
         x = x + jnp.einsum("btf,fd->btd", y, layer["wd"].astype(h.dtype))
-        return x, (pk, pv)
+        return x, pool_l
 
-    x, (new_k, new_v) = lax.scan(
-        layer_body, x, (params["block"], pool["k"], pool["v"])
-    )
+    x, new_pool = lax.scan(layer_body, x, (params["block"], pool))
     x = _rmsnorm(x, params["final_norm"])
     logits = jnp.einsum("btd,dv->btv", x, params["unembed"].astype(x.dtype))
     last = jnp.take(logits[0], length - 1, axis=0)
-    return last.astype(jnp.float32), {"k": new_k, "v": new_v}
+    return last.astype(jnp.float32), new_pool
 
 
 def _attend_paged(q, ck, cv, pos, group):
@@ -515,8 +616,7 @@ def paged_decode_step(
     """
     c = cfg
     S, W = tables.shape
-    bs = pool["k"].shape[2]
-    Hkv, d = pool["k"].shape[3], pool["k"].shape[4]
+    bs, Hkv, d = pool_geometry(pool)
     pos = jnp.where(active, pos, 0)
     write_blk = jnp.where(active, tables[jnp.arange(S), pos // bs], 0)
     write_off = jnp.where(active, pos % bs, 0)
@@ -537,7 +637,7 @@ def paged_decode_step(
 
     def layer_body(carry, inputs):
         x = carry
-        layer, pk, pv = inputs  # pk/pv: [NB, bs, Hkv, d]
+        layer, pool_l = inputs  # pool_l leaves: [NB, bs, Hkv, ...]
         h = _rmsnorm(x, layer["attn_norm"])
         q = jnp.einsum("btd,dhk->bthk", h, _wdq(layer["wq"], h.dtype))
         k = jnp.einsum("btd,dhk->bthk", h, _wdq(layer["wk"], h.dtype))
@@ -545,10 +645,10 @@ def paged_decode_step(
         positions = pos[:, None]  # [S, 1]
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
-        pk = pk.at[write_blk, write_off].set(k[:, 0].astype(pk.dtype))
-        pv = pv.at[write_blk, write_off].set(v[:, 0].astype(pv.dtype))
-        ck = pk[tables].reshape(S, W * bs, Hkv, d)
-        cv = pv[tables].reshape(S, W * bs, Hkv, d)
+        pool_l = _pool_append(pool_l, "k", k[:, 0], write_blk, write_off)
+        pool_l = _pool_append(pool_l, "v", v[:, 0], write_blk, write_off)
+        ck = _pool_gather(pool_l, "k", tables, h.dtype).reshape(S, W * bs, Hkv, d)
+        cv = _pool_gather(pool_l, "v", tables, h.dtype).reshape(S, W * bs, Hkv, d)
         attn = _attend_paged(q, ck, cv, pos, c.n_heads // c.kv_heads)
         x = x + jnp.einsum("bthk,hkd->btd", attn, _wdq(layer["wo"], h.dtype))
 
@@ -557,14 +657,12 @@ def paged_decode_step(
         gate = jnp.einsum("btd,df->btf", h, _wdq(layer["wg"], h.dtype))
         y = jax.nn.silu(gate) * up
         x = x + jnp.einsum("btf,fd->btd", y, _wdq(layer["wd"], h.dtype))
-        return x, (pk, pv)
+        return x, pool_l
 
-    x, (new_k, new_v) = lax.scan(
-        layer_body, x, (layers, pool["k"], pool["v"])
-    )
+    x, new_pool = lax.scan(layer_body, x, (layers, pool))
     x = _rmsnorm(x, params["final_norm"])
     logits = jnp.einsum("btd,dv->btv", x, _wdq(unembed, x.dtype))
-    return logits[:, 0].astype(jnp.float32), {"k": new_k, "v": new_v}
+    return logits[:, 0].astype(jnp.float32), new_pool
 
 
 def _fit_spec(spec, leaf, mesh_shape):

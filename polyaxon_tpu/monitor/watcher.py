@@ -136,6 +136,7 @@ def goodput_status(
         "compile_cache_hits": 0,
         "compile_cache_misses": 0,
         "hbm_peak_bytes": 0.0,
+        "kv_pool_bytes": 0.0,
         "devices": 0,
         "device_kind": "",
         "final": False,
@@ -165,6 +166,16 @@ def goodput_status(
         for r in per_proc
     )
     out["hbm_peak_bytes"] = sum(r["hbm_peak_bytes"] or 0.0 for r in per_proc)
+    # Serving engines report their KV block-pool bytes under the ledger's
+    # free-form extras — summed here so /goodput HBM accounting sees a
+    # quantized (int8) pool shrink gang-wide.
+    out["kv_pool_bytes"] = sum(
+        float(
+            (((r.get("attrs") or {}).get("extra") or {}).get("kv_pool_bytes"))
+            or 0.0
+        )
+        for r in per_proc
+    )
     out["devices"] = sum(r["devices"] or 0 for r in per_proc)
     out["device_kind"] = next(
         (r["device_kind"] for r in per_proc if r["device_kind"]), ""

@@ -177,6 +177,16 @@ class ServingEngine:
         token-block prefixes (copy-on-write at the divergence point).
     qweights : int8 tree from ``decode.quantize_weights`` — the paged
         step streams int8 exactly like the slot step did.
+    kv_quantize : ``"int8"`` stores the KV pool itself quantized —
+        int8 rows plus one f32 scale per (block row, kv head), under
+        0.3× the f32 pool's HBM at the same ``num_blocks`` — so a fixed
+        memory budget holds >2× the live blocks.  Appends quantize
+        once; attention reads dequantize fused into the gather (the
+        ``_wdq`` pattern applied to KV).  Composes with ``qweights``
+        (weight int8) and with prefix sharing/COW, which copy the
+        quantized leaves bit-exact.  ``None``/falsey keeps the full
+        compute-dtype pool.  Greedy outputs are near- but not bit-
+        identical to the full-precision pool (see docs/serving.md).
     mesh / param_shardings / qweights_shardings : multi-chip serving;
         when given, params (and qweights) are placed on the mesh and
         GSPMD propagates the sharding through prefill and the step.
@@ -225,6 +235,7 @@ class ServingEngine:
         prefill_chunk: Optional[int] = None,
         prefix_cache: bool = True,
         qweights: Optional[Any] = None,
+        kv_quantize: Optional[str] = None,
         mesh: Any = None,
         param_shardings: Optional[Any] = None,
         qweights_shardings: Optional[Any] = None,
@@ -276,7 +287,26 @@ class ServingEngine:
             if prefix_cache
             else None
         )
-        self._pool = decode.init_block_pool(cfg, num_blocks, self.block_size)
+        kvq = "" if kv_quantize in (None, False) else str(kv_quantize).lower()
+        if kvq in ("", "0", "false", "no", "off", "none"):
+            self.kv_quantize: Optional[str] = None
+        elif kvq in ("1", "true", "yes", "on", "int8"):
+            self.kv_quantize = "int8"
+        else:
+            raise ValueError(
+                f"unsupported kv_quantize {kv_quantize!r} (int8 or off)"
+            )
+        self._pool = decode.init_block_pool(
+            cfg, num_blocks, self.block_size, kv_dtype=self.kv_quantize
+        )
+        #: What the pool leaves actually store ("int8" or the compute
+        #: dtype name) and their total device bytes — surfaced on
+        #: ``/v1/stats``, the ``serving.kv_pool_bytes`` gauge, and the
+        #: final ledger row so goodput HBM accounting sees pool shrink.
+        self.kv_dtype = self.kv_quantize or str(jax.numpy.dtype(cfg.dtype).name)
+        self.kv_pool_bytes = int(
+            sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(self._pool))
+        )
         # Per-slot block tables (host truth): -1 = unset, mapped to the
         # trash block when shipped to the device.
         self._tables = np.full(
@@ -611,6 +641,8 @@ class ServingEngine:
                 block_occupancy=paging["block_occupancy"],
                 prefix_cache_hit_rate=paging["prefix_cache_hit_rate"],
                 prefill_backlog_chunks=paging["prefill_backlog_chunks"],
+                kv_pool_bytes=paging["kv_pool_bytes"],
+                kv_dtype=paging["kv_dtype"],
             )
             self._ledger.flush(final=True)
             self._ledger = None
@@ -743,6 +775,8 @@ class ServingEngine:
             cancelled = self._n_cancelled
         return {
             "block_size": self.block_size,
+            "kv_dtype": self.kv_dtype,
+            "kv_pool_bytes": self.kv_pool_bytes,
             "blocks_total": total,
             "blocks_free": alloc.n_free,
             "block_occupancy": (
@@ -1170,6 +1204,7 @@ class ServingEngine:
             round(alloc.n_used / total, 6) if total else 0.0,
         )
         gauge("serving.blocks_free", float(alloc.n_free))
+        gauge("serving.kv_pool_bytes", float(self.kv_pool_bytes))
         pc = self.prefix_cache
         gauge(
             "serving.prefix_cache_hit_rate",

@@ -276,7 +276,14 @@ class TestProjectACLs:
 
 
 class TestSecretEncryption:
+    # The runtime degrades gracefully without the cryptography wheel
+    # (orchestrator._build_encryptor stores plaintext); the tests that
+    # assert encrypted-at-rest behaviour only mean anything where the
+    # dependency exists, so they importorskip it.  The plaintext
+    # read-through tests below run everywhere.
+
     def test_secret_option_encrypted_at_rest(self, orch):
+        pytest.importorskip("cryptography")
         orch.conf.set("notifier.email_password", "hunter2")
         stored = orch.registry.get_option("notifier.email_password")
         assert stored.startswith("enc:v1:")
@@ -299,6 +306,7 @@ class TestSecretEncryption:
     def test_keyfile_created_0600_and_stable(self, tmp_path):
         import stat
 
+        pytest.importorskip("cryptography")
         from polyaxon_tpu.conf.encryptor import Encryptor
 
         enc = Encryptor.from_base_dir(tmp_path)
@@ -311,6 +319,7 @@ class TestSecretEncryption:
         assert enc2.decrypt(token) == "s3cret"
 
     def test_wrong_key_is_loud(self, tmp_path):
+        pytest.importorskip("cryptography")
         from polyaxon_tpu.conf.encryptor import EncryptionError, Encryptor
 
         (tmp_path / "a").mkdir()

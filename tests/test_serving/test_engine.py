@@ -286,3 +286,25 @@ class TestEngineUtilization:
         assert row["buckets"]["step_compute_s"] > 0
         assert 0.0 < row["goodput"] <= 1.0
         assert 0.0 < row["extra"]["decode_busy_frac"] <= 1.0
+        # Pool accounting rides the same row: /goodput HBM math needs
+        # the true pool bytes (and sees them shrink under kv_quantize).
+        assert row["extra"]["kv_pool_bytes"] > 0
+        assert row["extra"]["kv_dtype"] == "float32"
+
+    def test_final_ledger_row_reports_quantized_pool(self, params):
+        from polyaxon_tpu.serving import ServingEngine
+        from polyaxon_tpu.tracking.ledger import get_ledger
+
+        rows = []
+        get_ledger().configure(sink=rows.append)
+        try:
+            eng = ServingEngine(
+                params, CFG, slots=2, max_len=48, kv_quantize="int8"
+            ).start()
+            eng.submit([1, 2, 3], 4).wait(timeout=60)
+            eng.stop()
+        finally:
+            get_ledger().configure(sink=None)
+        row = [r for r in rows if r["final"]][-1]
+        assert row["extra"]["kv_dtype"] == "int8"
+        assert row["extra"]["kv_pool_bytes"] > 0

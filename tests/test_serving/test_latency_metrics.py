@@ -101,12 +101,33 @@ class TestEngineLatencyHistograms:
         for key in (
             "serving.block_occupancy",
             "serving.blocks_free",
+            "serving.kv_pool_bytes",
             "serving.prefix_cache_hit_rate",
             "serving.prefill_backlog_chunks",
         ):
             assert key in gauges, gauges.keys()
         assert 0.0 <= gauges["serving.block_occupancy"] <= 1.0
         assert 0.0 <= gauges["serving.prefix_cache_hit_rate"] <= 1.0
+        assert gauges["serving.kv_pool_bytes"] > 0
+
+    def test_kv_pool_bytes_gauge_shrinks_with_int8(self, params):
+        """The gauge reports the pool's TRUE device bytes: a quantized
+        engine at the same geometry exports a smaller value."""
+        readings = {}
+        for kvq in (None, "int8"):
+            registry = MemoryStats()
+            engine = ServingEngine(
+                params, CFG, slots=2, max_len=48,
+                kv_quantize=kvq, stats=registry,
+            ).start()
+            try:
+                _run_requests(engine, n=1)
+            finally:
+                engine.stop()
+            readings[kvq] = registry.snapshot()["gauges"][
+                "serving.kv_pool_bytes"
+            ]
+        assert readings["int8"] <= 0.55 * readings[None]
 
 
 class TestLmMetricsRoute:

@@ -529,3 +529,136 @@ class TestLoadHarnessSlow:
             assert res["completed"] == len(prompts)
             assert res["errors"] == 0
             assert res["ttft_p99_s"] > 0
+
+
+class TestQuantizedPool:
+    """``kv_quantize="int8"``: the HBM claim (pool leaves under 0.55× the
+    f32 pool at equal blocks), greedy parity within tolerance, and every
+    paging behaviour — prefix hit, COW, park/resume — on quantized
+    leaves.  Quantized decode is NOT bit-identical to the f32 pool (each
+    appended KV row rounds to int8 once), so parity asserts a token
+    agreement fraction instead of equality."""
+
+    def test_pool_bytes_at_most_055x_f32(self):
+        f32 = decode.init_block_pool(CFG, 13, 4)
+        q = decode.init_block_pool(CFG, 13, 4, kv_dtype="int8")
+        fb = sum(x.nbytes for x in jax.tree_util.tree_leaves(f32))
+        qb = sum(x.nbytes for x in jax.tree_util.tree_leaves(q))
+        assert qb <= 0.55 * fb
+        assert q["k_q"].dtype == jnp.int8
+        assert q["k_scale"].dtype == jnp.float32
+        # The sizing helper agrees with the real leaves — it's what the
+        # bench's fixed-HBM A/B uses to pick the block counts.
+        assert decode.kv_block_bytes(CFG, 4) * 13 == fb
+        assert decode.kv_block_bytes(CFG, 4, "int8") * 13 == qb
+
+    def test_bad_kv_dtype_rejected(self, params):
+        with pytest.raises(ValueError, match="kv_dtype"):
+            decode.init_block_pool(CFG, 4, 4, kv_dtype="fp8")
+        with pytest.raises(ValueError, match="kv_quantize"):
+            ServingEngine(params, CFG, slots=1, kv_quantize="int4")
+
+    def test_greedy_parity_within_tolerance(self, params):
+        rng = np.random.default_rng(40)
+        cases = [(list(rng.integers(0, 64, t)), mn)
+                 for t, mn in [(5, 10), (9, 8), (13, 6), (24, 12)]]
+        eng = ServingEngine(
+            params, CFG, slots=2, max_len=48,
+            block_size=4, kv_quantize="int8",
+        ).start()
+        try:
+            agree = total = 0
+            for prompt, mn in cases:
+                out = eng.submit(prompt, mn).wait(timeout=120)
+                ref = _ref(params, prompt, mn)
+                assert len(out) == mn
+                assert all(0 <= t < CFG.vocab_size for t in out)
+                agree += sum(a == b for a, b in zip(out, ref))
+                total += mn
+            assert agree / total >= 0.75, (
+                f"int8 KV drifted too far from f32: {agree}/{total} tokens"
+            )
+        finally:
+            eng.stop()
+
+    def test_prefix_hit_and_cow_on_quantized_pool(self, params):
+        """A full-block prefix hit COWs quantized leaves bit-exact: the
+        copier and the original produce the SAME tokens, and the shared
+        blocks survive the copier's writes."""
+        rng = np.random.default_rng(41)
+        prompt = list(rng.integers(0, 64, 16))  # two full 8-blocks
+        eng = ServingEngine(
+            params, CFG, slots=2, max_len=48,
+            block_size=8, prefix_cache=True, kv_quantize="int8",
+        ).start()
+        try:
+            first = eng.submit(prompt, 6).wait(timeout=120)
+            second = eng.submit(prompt, 6).wait(timeout=120)  # COW path
+            assert second == first
+            assert eng.stats()["cow_copies"] >= 1
+            hits_before = eng.prefix_cache.hits
+            assert eng.submit(prompt, 6).wait(timeout=120) == first
+            assert eng.prefix_cache.hits > hits_before
+        finally:
+            eng.stop()
+
+    def test_park_resume_and_shed_on_quantized_pool(self, params):
+        """The TestPoolExhaustion scenarios on int8 leaves: pool pressure
+        parks and resumes (same tokens as an uncontended int8 engine),
+        and a true deadlock sheds exactly one request."""
+        rng = np.random.default_rng(42)
+        pa = list(rng.integers(0, 64, 24))
+        pb = list(rng.integers(0, 64, 4))
+        roomy = ServingEngine(
+            params, CFG, slots=2, max_len=48,
+            block_size=4, prefix_cache=False, kv_quantize="int8",
+        ).start()
+        try:
+            ref_a = roomy.submit(pa, 8).wait(timeout=120)
+            ref_b = roomy.submit(pb, 4).wait(timeout=120)
+        finally:
+            roomy.stop()
+        eng = ServingEngine(
+            params, CFG, slots=2, max_len=48, block_size=4,
+            num_blocks=9, prefix_cache=False, kv_quantize="int8",
+        ).start()
+        try:
+            ra = eng.submit(pa, 8)
+            rb = eng.submit(pb, 4)
+            assert ra.wait(timeout=120) == ref_a
+            assert rb.wait(timeout=120) == ref_b
+            s = eng.stats()
+            assert s["block_parks"] >= 1, "pool pressure never parked"
+            assert s["blocks_free"] == s["blocks_total"]
+            # Deadlock: two spans that can never fit together.
+            r1 = eng.submit(list(rng.integers(0, 64, 4)), 24)
+            r2 = eng.submit(list(rng.integers(0, 64, 4)), 24)
+            done = 0
+            for req in (r1, r2):
+                try:
+                    out = req.wait(timeout=120)
+                    assert len(out) == 24
+                    done += 1
+                except RuntimeError as e:
+                    assert "pool exhausted" in str(e)
+            assert done == 1, "exactly one request is shed"
+        finally:
+            eng.stop()
+
+    def test_stats_report_kv_dtype_and_pool_bytes(self, params):
+        f32 = ServingEngine(params, CFG, slots=2, max_len=48, block_size=4)
+        q = ServingEngine(
+            params, CFG, slots=2, max_len=48, block_size=4,
+            kv_quantize="int8",
+        )
+        try:
+            sf, sq = f32.stats(), q.stats()
+            assert sf["kv_dtype"] == "float32"
+            assert sq["kv_dtype"] == "int8"
+            assert sq["kv_pool_bytes"] <= 0.55 * sf["kv_pool_bytes"]
+            assert sq["kv_pool_bytes"] == sum(
+                x.nbytes for x in jax.tree_util.tree_leaves(q._pool)
+            )
+        finally:
+            f32.stop()
+            q.stop()

@@ -105,6 +105,32 @@ def test_mixed_lengths_after_ready_compile_nothing(params, warm_engine):
     assert warm_engine.stats()["steady_state_compiles"] == 0
 
 
+def test_quantized_pool_warmup_compiles_nothing_after_ready(params):
+    """With ``kv_quantize="int8"`` the warmup executes the QUANTIZED
+    bucket family (the pool pytree structure is part of every compiled
+    signature), so mixed-length traffic after ready still adds zero
+    compiles and ``steady_state_compiles`` stays 0."""
+    eng = ServingEngine(
+        params, CFG, slots=2, max_len=48, kv_quantize="int8", warmup=True
+    ).start()
+    try:
+        assert eng.wait_ready(timeout=300), "warmup never finished"
+        baseline = eng._compiled_count()
+        assert baseline > 0
+        rng = np.random.default_rng(9)
+        reqs = [
+            eng.submit(list(rng.integers(0, CFG.vocab_size, t)), mn)
+            for t, mn in [(3, 4), (9, 6), (17, 2), (30, 3)]
+        ]
+        for r in reqs:
+            out = r.wait(timeout=120)
+            assert out and all(0 <= t < CFG.vocab_size for t in out)
+        assert eng._compiled_count() == baseline
+        assert eng.stats()["steady_state_compiles"] == 0
+    finally:
+        eng.stop()
+
+
 def test_no_warmup_counts_lazy_compiles(params):
     """warmup=False keeps the old lazy behavior but MONITORS it: the
     gate opens immediately and the first request's compiles land on the

@@ -296,6 +296,25 @@ class TestLedgerPipeline:
         assert len(g["timeline"]) == 3
         assert g["timeline"][0]["mfu"] == 0.01
 
+    def test_goodput_status_sums_kv_pool_bytes_from_extras(self, rig):
+        """Serving engines ship their KV pool bytes under the row's
+        free-form extras; /goodput surfaces the gang-wide sum so HBM
+        accounting sees an int8 pool shrink."""
+        registry, watcher, handle = rig
+        _append(handle.paths, 0, [
+            _ledger_event(0, 1, 5.0, 4.0, extra={"kv_pool_bytes": 1024}),
+            _ledger_event(0, 2, 10.0, 8.0, final=True,
+                          extra={"kv_pool_bytes": 384, "kv_dtype": "int8"}),
+        ])
+        _append(handle.paths, 1, [
+            _ledger_event(1, 1, 12.0, 6.0, final=True,
+                          extra={"kv_pool_bytes": 384, "kv_dtype": "int8"}),
+        ])
+        watcher.ingest(handle)
+        g = goodput_status(registry, handle.run_id)
+        # Latest row per process wins — 384 + 384, not the stale 1024.
+        assert g["kv_pool_bytes"] == 768.0
+
     def test_goodput_status_empty_until_rows_land(self, rig):
         registry, _, handle = rig
         g = goodput_status(registry, handle.run_id)
