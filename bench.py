@@ -733,6 +733,143 @@ def main() -> None:
 
         traceback.print_exc(file=sys.stderr)
 
+    # Goodput under injected failures: the remediation loop's reason to
+    # exist, A/B'd through the REAL platform path.  Run A declares
+    # save_every + restart_policy and gets SIGKILLed mid-loop; the
+    # scheduler must auto-resume it from the latest complete async
+    # checkpoint.  Run B is the no-remediation baseline (engine off, no
+    # checkpoints): the blind restart re-pays every step.  The ratio is
+    # step-accounted (useful steps / executed steps) so it is
+    # deterministic under CI timing noise; recovery_s (failure decision →
+    # back to RUNNING) carries the wall-clock side separately.
+    run_goodput_ratio = None
+    run_goodput_ok = None
+    run_goodput_ratio_norestart = None
+    recovery_s = None
+    try:
+        import os
+        import sys
+        import tempfile
+
+        from polyaxon_tpu.db.registry import RemediationStatus
+        from polyaxon_tpu.lifecycles import StatusOptions
+        from polyaxon_tpu.orchestrator import Orchestrator
+
+        fail_steps, fail_preempt = 24, 12
+
+        def failure_spec(save_every):
+            decls = {
+                "steps": fail_steps,
+                "preempt_step": fail_preempt,
+                "batch": 4,
+                "seq": 16,
+                "vocab_size": 64,
+                "d_model": 32,
+                "n_layers": 1,
+                "n_heads": 2,
+                "head_dim": 16,
+                "d_ff": 64,
+            }
+            if save_every:
+                decls["save_every"] = save_every
+            return {
+                "kind": "experiment",
+                "run": {
+                    "entrypoint": "polyaxon_tpu.builtins.trainers:lm_train"
+                },
+                "declarations": decls,
+                "environment": {
+                    "topology": {
+                        "accelerator": "cpu-1",
+                        "num_devices": 1,
+                        "num_hosts": 1,
+                    },
+                    "restart_policy": {
+                        "max_restarts": 1,
+                        "backoff_seconds": 0.1,
+                    },
+                },
+            }
+
+        saved_rem_env = os.environ.get("POLYAXON_TPU_REMEDIATION_ENABLED")
+        orch = Orchestrator(
+            tempfile.mkdtemp(), monitor_interval=0.05, heartbeat_interval=0.2
+        )
+        try:
+            os.environ["POLYAXON_TPU_REMEDIATION_ENABLED"] = "1"
+            run_a = orch.submit(failure_spec(save_every=1))
+            done_a = orch.wait(run_a.id, timeout=300)
+            if done_a.status == StatusOptions.SUCCEEDED:
+                rows = [
+                    r
+                    for r in orch.registry.get_remediations(
+                        run_a.id, action="resume"
+                    )
+                    if r["status"] == RemediationStatus.SUCCEEDED
+                ]
+                from_step = (
+                    rows[0]["attrs"].get("from_step") if rows else None
+                )
+                # Attempt 1 executed steps [0, preempt); attempt 2
+                # resumed at from_step+1 and executed the rest.
+                executed = fail_preempt + fail_steps
+                if from_step is not None:
+                    executed -= int(from_step) + 1
+                run_goodput_ratio = fail_steps / max(1, executed)
+                history = orch.registry.get_statuses(run_a.id)
+                warn_ts = next(
+                    (
+                        s["created_at"]
+                        for s in history
+                        if s["status"] == StatusOptions.WARNING
+                    ),
+                    None,
+                )
+                if warn_ts is not None:
+                    back = [
+                        s["created_at"]
+                        for s in history
+                        if s["status"] == StatusOptions.RUNNING
+                        and s["created_at"] > warn_ts
+                    ]
+                    if back:
+                        recovery_s = back[0] - warn_ts
+            else:
+                print(
+                    "bench: remediated run under injected failure did not "
+                    f"complete (status={done_a.status})",
+                    file=sys.stderr,
+                )
+            # Baseline: engine off, nothing to resume from — the restart
+            # re-executes the whole schedule.
+            os.environ["POLYAXON_TPU_REMEDIATION_ENABLED"] = "0"
+            run_b = orch.submit(failure_spec(save_every=0))
+            done_b = orch.wait(run_b.id, timeout=300)
+            if done_b.status == StatusOptions.SUCCEEDED:
+                run_goodput_ratio_norestart = fail_steps / (
+                    fail_preempt + fail_steps
+                )
+        finally:
+            orch.stop()
+            if saved_rem_env is None:
+                os.environ.pop("POLYAXON_TPU_REMEDIATION_ENABLED", None)
+            else:
+                os.environ["POLYAXON_TPU_REMEDIATION_ENABLED"] = saved_rem_env
+        if run_goodput_ratio is not None:
+            run_goodput_ok = run_goodput_ratio >= 0.5
+            if not run_goodput_ok:
+                print(
+                    f"bench: run_goodput_ratio={run_goodput_ratio:.2f} under "
+                    "the 0.5 floor — auto-resume is re-paying too much work "
+                    "after an injected failure",
+                    file=sys.stderr,
+                )
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+
     # Serving: the continuous-batching engine under CONCURRENT load vs the
     # same requests one-at-a-time through generate().  Decode is
     # memory-bound, so a batched slot step costs about what a B=1 step
@@ -1366,6 +1503,20 @@ def main() -> None:
                 ),
                 "first_step_warm_ok": first_step_warm_ok,
                 "compile_cache_hits_warm": warm_cache_hits,
+                "run_goodput_ratio": (
+                    round(run_goodput_ratio, 3)
+                    if run_goodput_ratio is not None
+                    else None
+                ),
+                "run_goodput_ok": run_goodput_ok,
+                "run_goodput_ratio_norestart": (
+                    round(run_goodput_ratio_norestart, 3)
+                    if run_goodput_ratio_norestart is not None
+                    else None
+                ),
+                "recovery_s": (
+                    round(recovery_s, 2) if recovery_s is not None else None
+                ),
                 "serving_ready_s": (
                     round(serving_ready_s, 3)
                     if serving_ready_s is not None

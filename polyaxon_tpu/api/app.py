@@ -16,7 +16,7 @@ import json
 import logging
 from typing import Any, Dict, Optional
 
-from polyaxon_tpu.db.registry import Run, RunRegistry
+from polyaxon_tpu.db.registry import RemediationStatus, Run, RunRegistry
 from polyaxon_tpu.events import EventTypes
 from polyaxon_tpu.exceptions import PolyaxonTPUError
 from polyaxon_tpu.monitor.watcher import anomaly_status, goodput_status
@@ -289,6 +289,17 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             "resolved": sum(1 for r in alert_rows if r["state"] == "resolved"),
             "results": alert_rows,
         }
+        # Remediation roll-up: what the control plane DID about trouble
+        # (checkpoint-now, resume-from-step, eviction) — the action half
+        # of the alerts block above.
+        rem_rows = reg.get_remediations(run.id)
+        payload["remediations"] = {
+            "total": len(rem_rows),
+            "open": sum(
+                1 for r in rem_rows if r["status"] in RemediationStatus.OPEN
+            ),
+            "results": rem_rows,
+        }
         return web.json_response(payload)
 
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/stop")
@@ -521,6 +532,25 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             limit=_int_param(request, "limit"),
         )
         return web.json_response({"results": rows})
+
+    # -- remediations (the detection→action audit trail) ----------------------
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/remediations")
+    async def get_run_remediations(request):
+        run = _run_or_404(request)
+        rows = reg.get_remediations(
+            run.id,
+            action=request.query.get("action"),
+            status=request.query.get("status"),
+            since_id=_int_param(request, "since_id", 0),
+            limit=_int_param(request, "limit"),
+        )
+        engine = getattr(orch, "remediation", None)
+        return web.json_response(
+            {
+                "results": rows,
+                "engine": engine.status() if engine is not None else None,
+            }
+        )
 
     # -- on-demand device profiling (run command bus) -------------------------
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/profile")

@@ -110,6 +110,52 @@ def resume_counter(ctx: Context) -> None:
     ctx.log_text(f"resume_counter attempt {n + 1}")
 
 
+def _fault_injection(ctx: Context):
+    """Per-step fault injector for the declared chaos params, or None.
+
+    ``preempt_step``/``preempt_process``/``preempt_signal`` kill a worker
+    mid-loop with REAL process death (SIGKILL, or SIGTERM then SIGKILL
+    after ``preempt_grace_s`` — the preemption-notice shape), once per run:
+    an outputs marker survives the restart so the resumed attempt trains
+    through.  ``stall_at_step``/``stall_s``/``stall_process`` silence a
+    worker's progress beats mid-loop (heartbeats keep flowing) to trip the
+    stall/straggler detectors against a live train loop.
+    """
+    preempt_step = int(ctx.get_param("preempt_step", -1))
+    stall_at = int(ctx.get_param("stall_at_step", -1))
+    stall_s = float(ctx.get_param("stall_s", 0.0))
+    if preempt_step < 0 and (stall_at < 0 or stall_s <= 0):
+        return None
+    preempt_process = int(ctx.get_param("preempt_process", 0))
+    preempt_signal = str(ctx.get_param("preempt_signal", "kill"))
+    preempt_grace_s = float(ctx.get_param("preempt_grace_s", 0.5))
+    stall_process = int(ctx.get_param("stall_process", -1))
+
+    def on_step(step: int) -> None:
+        import os
+        import signal as _signal
+
+        if step == stall_at and stall_s > 0 and stall_process in (-1, ctx.process_id):
+            ctx.log_text(f"injecting {stall_s:.1f}s stall at step {step}")
+            time.sleep(stall_s)
+        if step == preempt_step and preempt_process in (-1, ctx.process_id):
+            marker = None
+            if ctx.outputs_path is not None:
+                marker = ctx.outputs_path / f"preempted_p{ctx.process_id}"
+                if marker.exists():
+                    return
+                marker.write_text(str(step))
+            ctx.log_text(
+                f"injecting preemption at step {step} (signal={preempt_signal})"
+            )
+            if preempt_signal == "term":
+                os.kill(os.getpid(), _signal.SIGTERM)
+                time.sleep(max(preempt_grace_s, 0.0))
+            os.kill(os.getpid(), _signal.SIGKILL)
+
+    return on_step
+
+
 def _should_measure_flops(ctx: Context, backend: str) -> bool:
     """Whether to probe per-step FLOPs via XLA cost analysis.
 
@@ -271,6 +317,12 @@ def _train_image_classifier(
     from polyaxon_tpu.tracking.capture import get_capture_agent
 
     capture = get_capture_agent()
+    ckpt_now = None
+    if ckpt is not None:
+        from polyaxon_tpu.runtime.checkpoint import CheckpointNowService
+
+        ckpt_now = CheckpointNowService(ckpt, capture)
+    inject = _fault_injection(ctx)
     drain = MetricsDrain(lambda step, vals: ctx.log_metrics(step=step, **vals))
     clock = StepClock()
     tracer = get_tracer()
@@ -326,6 +378,8 @@ def _train_image_classifier(
             for i in range(start_step, steps):
                 profiler.on_step(i)
                 capture.on_step(i)
+                if inject is not None:
+                    inject(i)
                 with tracer.span("train:step", sample=tracer.hot_sample, step=i):
                     if warm_batch is not None:
                         batch, warm_batch = warm_batch, None
@@ -340,6 +394,8 @@ def _train_image_classifier(
                     drain.push(i, {"loss": metrics["loss"]})
                 if ckpt is not None:
                     ckpt.save(i, params, opt_state)
+                if ckpt_now is not None:
+                    ckpt_now.maybe_save(i, params, opt_state)
                 step_dt = clock.tick()
                 if step_dt is not None:
                     run_stats.timing("train.step_wall_s", step_dt)
@@ -724,6 +780,14 @@ def lm_train(ctx: Context) -> None:
     from polyaxon_tpu.tracking.capture import get_capture_agent
 
     capture = get_capture_agent()
+    # Remediation's checkpoint-now lands on the bus thread but must save
+    # from the loop thread (donated buffers) — the service bridges them.
+    ckpt_now = None
+    if ckpt is not None:
+        from polyaxon_tpu.runtime.checkpoint import CheckpointNowService
+
+        ckpt_now = CheckpointNowService(ckpt, capture)
+    inject = _fault_injection(ctx)
     # Metrics leave the loop as device arrays; a drain thread does the
     # host reads — even logging steps no longer serialize dispatch.
     drain = MetricsDrain(lambda step, vals: ctx.log_metrics(step=step, **vals))
@@ -771,6 +835,8 @@ def lm_train(ctx: Context) -> None:
             for i in range(start_step, steps):
                 profiler.on_step(i)
                 capture.on_step(i)
+                if inject is not None:
+                    inject(i)
                 with tracer.span("train:step", sample=tracer.hot_sample, step=i):
                     params, opt_state, metrics = step_fn(
                         params, opt_state, batch, key
@@ -782,6 +848,8 @@ def lm_train(ctx: Context) -> None:
                     )
                 if ckpt is not None:
                     ckpt.save(i, params, opt_state)  # async; fenced at close
+                if ckpt_now is not None:
+                    ckpt_now.maybe_save(i, params, opt_state)
                 step_dt = clock.tick()
                 if step_dt is not None:
                     run_stats.timing("train.step_wall_s", step_dt)

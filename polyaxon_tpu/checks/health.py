@@ -116,6 +116,30 @@ def check_alerts(orch) -> Tuple[bool, str]:
     )
 
 
+def check_remediation(orch) -> Tuple[bool, str]:
+    """Remediation-engine posture: wired, enabled, and whether its
+    reactions are erroring (counted, never raised — same contract as the
+    alert engine).  Reaction errors with zero successful actions mean the
+    reflex arc is broken, not merely noisy."""
+    engine = getattr(orch, "remediation", None)
+    if engine is None:
+        return True, "remediation engine not wired"
+    try:
+        st = engine.status()
+    except Exception as e:
+        return False, f"status() failed: {type(e).__name__}: {e}"
+    if not st["enabled"]:
+        return True, "disabled (POLYAXON_TPU_REMEDIATION_ENABLED=0)"
+    if st["errors"] and not st["actions"]:
+        return False, f"{st['errors']} reaction error(s), no action succeeded"
+    evict = "on" if st["evict_enabled"] else "off"
+    errors = f", {st['errors']} reaction error(s)" if st["errors"] else ""
+    return True, (
+        f"enabled, {st['actions']} action(s), budget {st['budget']}/run, "
+        f"evict {evict}{errors}"
+    )
+
+
 def check_devices(orch) -> Tuple[bool, str]:
     """Accelerator visibility — only meaningful in-process on a worker/bench
     host; the control plane itself may legitimately be CPU-only."""
@@ -136,6 +160,7 @@ CHECKS: Dict[str, Callable] = {
     "heartbeats": check_heartbeats,
     "compile_cache": check_compile_cache,
     "alerts": check_alerts,
+    "remediation": check_remediation,
 }
 
 

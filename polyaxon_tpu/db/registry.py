@@ -71,6 +71,7 @@ CREATE TABLE IF NOT EXISTS runs (
     outputs_path TEXT,
     code_ref TEXT,
     service_url TEXT,
+    meta TEXT NOT NULL DEFAULT '{}',
     created_at REAL NOT NULL,
     updated_at REAL NOT NULL,
     started_at REAL,
@@ -344,6 +345,19 @@ CREATE TABLE IF NOT EXISTS alerts (
     UNIQUE (run_id, rule)
 );
 CREATE INDEX IF NOT EXISTS ix_alerts_run ON alerts (run_id);
+
+CREATE TABLE IF NOT EXISTS remediations (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_id INTEGER NOT NULL,
+    action TEXT NOT NULL,
+    trigger TEXT,
+    status TEXT NOT NULL,
+    message TEXT,
+    attrs TEXT,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_remediations_run ON remediations (run_id);
 """
 
 
@@ -364,6 +378,21 @@ class CommandStatus:
     EXPIRED = "expired"
 
     TERMINAL = (COMPLETE, FAILED, EXPIRED)
+
+
+def command_ack_state(ack: Any) -> Optional[str]:
+    """The per-process state of an ``acks`` value — plain string for
+    attr-less acks, ``{"state":..., "attrs":...}`` dicts otherwise."""
+    if isinstance(ack, dict):
+        return ack.get("state")
+    return ack
+
+
+def command_ack_attrs(ack: Any) -> Dict[str, Any]:
+    """Handler result attrs folded into an ``acks`` value ({} if none)."""
+    if isinstance(ack, dict):
+        return ack.get("attrs") or {}
+    return {}
 
 
 class AlertState:
@@ -391,6 +420,27 @@ class AlertSeverity:
     CRITICAL = "critical"
 
     ALL = (INFO, WARNING, CRITICAL)
+
+
+class RemediationStatus:
+    """Lifecycle of a remediation action (the detection→action loop).
+
+    PENDING (decided, not yet acting) → IN_PROGRESS (command issued /
+    process signalled) → SUCCEEDED / FAILED.  SKIPPED records a decision
+    *not* to act (budget exhausted, topology not shrinkable) so the run's
+    timeline explains inaction; EXPIRED is the control plane closing rows
+    left open when the run reached a terminal state.
+    """
+
+    PENDING = "pending"
+    IN_PROGRESS = "in_progress"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    SKIPPED = "skipped"
+    EXPIRED = "expired"
+
+    OPEN = (PENDING, IN_PROGRESS)
+    TERMINAL = (SUCCEEDED, FAILED, SKIPPED, EXPIRED)
 
 
 def accelerator_family(accelerator: str) -> str:
@@ -422,6 +472,9 @@ class Run:
     code_ref: Optional[str] = None
     #: Reachable URL of a serving service gang (notebook/tensorboard kinds).
     service_url: Optional[str] = None
+    #: Control-plane scratch attrs surviving restarts (e.g. the ``elastic``
+    #: topology override recorded by straggler eviction).
+    meta: Dict[str, Any] = field(default_factory=dict)
     created_at: float = 0.0
     updated_at: float = 0.0
     started_at: Optional[float] = None
@@ -463,6 +516,7 @@ def _row_to_run(row: sqlite3.Row) -> Run:
         outputs_path=row["outputs_path"],
         code_ref=row["code_ref"],
         service_url=row["service_url"],
+        meta=json.loads(row["meta"] or "{}"),
         created_at=row["created_at"],
         updated_at=row["updated_at"],
         started_at=row["started_at"],
@@ -507,6 +561,10 @@ class RunRegistry:
                 conn.execute("ALTER TABLE users ADD COLUMN sso_provider TEXT")
             if "archived_at" not in run_cols:
                 conn.execute("ALTER TABLE runs ADD COLUMN archived_at REAL")
+            if "meta" not in run_cols:
+                conn.execute(
+                    "ALTER TABLE runs ADD COLUMN meta TEXT NOT NULL DEFAULT '{}'"
+                )
 
     # -- connection management ------------------------------------------------
     def _conn(self) -> sqlite3.Connection:
@@ -671,6 +729,30 @@ class RunRegistry:
                 (*fields.values(), time.time(), run_id),
             )
 
+    def merge_run_meta(self, run_id: int, **patch: Any) -> Dict[str, Any]:
+        """Shallow-merge keys into the run's control-plane ``meta`` blob
+        under the write lock (read-merge-write, so concurrent patches to
+        different keys never clobber each other).  A key set to ``None``
+        is removed.  Returns the merged blob."""
+        with self._lock, self._conn() as conn:
+            conn.execute("BEGIN IMMEDIATE")
+            row = conn.execute(
+                "SELECT meta FROM runs WHERE id = ?", (run_id,)
+            ).fetchone()
+            if row is None:
+                raise RegistryError(f"Run {run_id} does not exist")
+            meta = json.loads(row["meta"] or "{}")
+            for key, value in patch.items():
+                if value is None:
+                    meta.pop(key, None)
+                else:
+                    meta[key] = value
+            conn.execute(
+                "UPDATE runs SET meta = ?, updated_at = ? WHERE id = ?",
+                (json.dumps(meta), time.time(), run_id),
+            )
+        return meta
+
     # -- archival + deletion ---------------------------------------------------
     # Parity: the reference's archived model managers + archives API
     # (``api/archives/``) and its archive-deletion beat pipeline
@@ -785,6 +867,7 @@ class RunRegistry:
                 ("commands", "run_id"),
                 ("captures", "run_id"),
                 ("alerts", "run_id"),
+                ("remediations", "run_id"),
                 ("heartbeats", "run_id"),
                 ("processes", "run_id"),
                 ("bookmarks", "run_id"),
@@ -1360,13 +1443,18 @@ class RunRegistry:
         state: str,
         *,
         message: Optional[str] = None,
+        attrs: Optional[Dict[str, Any]] = None,
     ) -> Optional[Dict[str, Any]]:
         """Fold one process's command state into the row and recompute the
         gang roll-up.  Per-process states are acked/complete/failed; the
         roll-up goes COMPLETE once ``expected`` processes are terminal and
         none failed, FAILED if any did.  A command the control plane
         already resolved (EXPIRED) never un-resolves — late worker lines
-        land in ``acks`` for forensics but don't flip the status."""
+        land in ``acks`` for forensics but don't flip the status.
+
+        ``attrs`` carries handler result data (e.g. checkpoint-now's saved
+        step) — the ack value then becomes ``{"state":..., "attrs":...}``;
+        attr-less acks stay plain strings for compatibility."""
         with self._lock, self._conn() as conn:
             conn.execute("BEGIN IMMEDIATE")
             row = conn.execute(
@@ -1375,12 +1463,14 @@ class RunRegistry:
             if row is None:
                 return None
             acks = json.loads(row["acks"]) if row["acks"] else {}
-            acks[str(int(process_id))] = state
+            acks[str(int(process_id))] = (
+                {"state": state, "attrs": attrs} if attrs else state
+            )
             status = row["status"]
             if status not in CommandStatus.TERMINAL:
                 terminal = [
                     s
-                    for s in acks.values()
+                    for s in (command_ack_state(v) for v in acks.values())
                     if s in (CommandStatus.COMPLETE, CommandStatus.FAILED)
                 ]
                 if len(terminal) >= max(1, row["expected"]):
@@ -1415,6 +1505,149 @@ class RunRegistry:
                     time.time(),
                     run_id,
                     *CommandStatus.TERMINAL,
+                ),
+            ).rowcount
+
+    # -- remediations (alert-driven actions) ----------------------------------
+    @staticmethod
+    def _remediation_row(row: sqlite3.Row) -> Dict[str, Any]:
+        out = dict(row)
+        out["attrs"] = json.loads(out["attrs"]) if out["attrs"] else {}
+        return out
+
+    def add_remediation(
+        self,
+        run_id: int,
+        action: str,
+        *,
+        trigger: Optional[str] = None,
+        status: str = RemediationStatus.PENDING,
+        message: Optional[str] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Record one remediation action on a run's timeline."""
+        now = time.time()
+        with self._lock, self._conn() as conn:
+            cur = conn.execute(
+                """INSERT INTO remediations
+                       (run_id, action, trigger, status, message, attrs,
+                        created_at, updated_at)
+                   VALUES (?, ?, ?, ?, ?, ?, ?, ?)""",
+                (
+                    run_id,
+                    action,
+                    trigger,
+                    status,
+                    message,
+                    json.dumps(attrs) if attrs else None,
+                    now,
+                    now,
+                ),
+            )
+            rem_id = cur.lastrowid
+        return self.get_remediation(rem_id)
+
+    def update_remediation(
+        self,
+        rem_id: int,
+        *,
+        status: Optional[str] = None,
+        message: Optional[str] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Advance a remediation row; ``attrs`` shallow-merge into the
+        stored blob so phases can accrete result data."""
+        with self._lock, self._conn() as conn:
+            conn.execute("BEGIN IMMEDIATE")
+            row = conn.execute(
+                "SELECT * FROM remediations WHERE id = ?", (rem_id,)
+            ).fetchone()
+            if row is None:
+                return None
+            merged = json.loads(row["attrs"]) if row["attrs"] else {}
+            if attrs:
+                merged.update(attrs)
+            conn.execute(
+                """UPDATE remediations
+                   SET status = COALESCE(?, status),
+                       message = COALESCE(?, message),
+                       attrs = ?, updated_at = ?
+                   WHERE id = ?""",
+                (
+                    status,
+                    message,
+                    json.dumps(merged) if merged else None,
+                    time.time(),
+                    rem_id,
+                ),
+            )
+        return self.get_remediation(rem_id)
+
+    def get_remediation(self, rem_id: int) -> Optional[Dict[str, Any]]:
+        with self._lock, self._conn() as conn:
+            row = conn.execute(
+                "SELECT * FROM remediations WHERE id = ?", (rem_id,)
+            ).fetchone()
+        return self._remediation_row(row) if row else None
+
+    def get_remediations(
+        self,
+        run_id: int,
+        *,
+        action: Optional[str] = None,
+        status: Optional[str] = None,
+        since_id: int = 0,
+        limit: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        query = "SELECT * FROM remediations WHERE run_id = ? AND id > ?"
+        params: List[Any] = [run_id, since_id]
+        if action is not None:
+            query += " AND action = ?"
+            params.append(action)
+        if status is not None:
+            query += " AND status = ?"
+            params.append(status)
+        query += " ORDER BY id"
+        if limit is not None:
+            query += " LIMIT ?"
+            params.append(int(limit))
+        with self._lock, self._conn() as conn:
+            rows = conn.execute(query, params).fetchall()
+        return [self._remediation_row(r) for r in rows]
+
+    def count_remediations(
+        self, run_id: int, *, statuses: Optional[Sequence[str]] = None
+    ) -> int:
+        """How many remediation actions a run has consumed — the budget
+        check.  ``statuses`` narrows the count (e.g. exclude SKIPPED so
+        recording a refusal doesn't itself consume budget)."""
+        query = "SELECT COUNT(*) FROM remediations WHERE run_id = ?"
+        params: List[Any] = [run_id]
+        if statuses:
+            query += f" AND status IN ({','.join('?' * len(statuses))})"
+            params.extend(statuses)
+        with self._lock, self._conn() as conn:
+            return int(conn.execute(query, params).fetchone()[0])
+
+    def expire_remediations(
+        self,
+        run_id: int,
+        *,
+        message: str = "run finished before the action resolved",
+    ) -> int:
+        """Close every still-open remediation row when a run goes
+        terminal — mirrors ``expire_commands`` so nothing hangs open."""
+        placeholders = ",".join("?" * len(RemediationStatus.OPEN))
+        with self._lock, self._conn() as conn:
+            return conn.execute(
+                f"""UPDATE remediations SET status = ?, message = ?, updated_at = ?
+                    WHERE run_id = ? AND status IN ({placeholders})""",
+                (
+                    RemediationStatus.EXPIRED,
+                    message,
+                    time.time(),
+                    run_id,
+                    *RemediationStatus.OPEN,
                 ),
             ).rowcount
 
@@ -2101,6 +2334,13 @@ class RunRegistry:
                    (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
                 (cutoff, cutoff),
             ).rowcount
+            # updated_at like alerts: a row's last lifecycle edge, not its
+            # creation, decides when the action falls off the timeline.
+            remediations = conn.execute(
+                """DELETE FROM remediations WHERE updated_at < ? AND run_id IN
+                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
+                (cutoff, cutoff),
+            ).rowcount
         return {
             "activity": act,
             "logs": logs,
@@ -2110,6 +2350,7 @@ class RunRegistry:
             "commands": commands,
             "captures": captures,
             "alerts": alerts,
+            "remediations": remediations,
         }
 
     # -- projects (entity metadata over runs.project) --------------------------

@@ -37,6 +37,9 @@ class EventTypes:
     EXPERIMENT_DONE = "experiment.done"
     EXPERIMENT_ZOMBIE = "experiment.zombie"
     EXPERIMENT_COMMAND_SENT = "experiment.command_sent"
+    # remediation (the monitor/remediation.py detection→action loop)
+    EXPERIMENT_REMEDIATION = "experiment.remediation"
+    EXPERIMENT_EVICTED = "experiment.evicted"
     EXPERIMENT_PROFILE_REQUESTED = "experiment.profile_requested"
     EXPERIMENT_ARTIFACTS_SYNCED = "experiment.artifacts_synced"
     EXPERIMENT_ARCHIVED = "experiment.archived"

@@ -1,4 +1,5 @@
 from polyaxon_tpu.monitor.alerts import AlertEngine
+from polyaxon_tpu.monitor.remediation import RemediationEngine
 from polyaxon_tpu.monitor.watcher import GangWatcher
 
-__all__ = ["AlertEngine", "GangWatcher"]
+__all__ = ["AlertEngine", "GangWatcher", "RemediationEngine"]

@@ -380,8 +380,20 @@ class GangWatcher:
                     "Command report without uuid/state from proc %d", process_id
                 )
                 return
+            # Handler result data (e.g. checkpoint-now's saved step) rides
+            # the same line as extra keys → into the command's ack attrs.
+            extra = {
+                k: v
+                for k, v in event.items()
+                if k not in ("type", "ts", "uuid", "state", "message")
+                and v is not None
+            }
             self.registry.mark_command(
-                str(uuid), process_id, str(state), message=event.get("message")
+                str(uuid),
+                process_id,
+                str(state),
+                message=event.get("message"),
+                attrs=extra or None,
             )
         elif etype == "capture":
             # On-demand profiling record: one latest-wins row per

@@ -202,6 +202,18 @@ class Orchestrator:
         self.alerts = AlertEngine(
             self.registry, stats=self.stats, auditor=self.auditor
         )
+        # The remediation engine closes the detection→action loop: alert
+        # firing edges trigger checkpoint-now/eviction through the command
+        # bus, and FAILED gangs relaunch from their latest complete
+        # checkpoint instead of step 0.
+        from polyaxon_tpu.monitor import RemediationEngine
+
+        self.remediation = RemediationEngine(
+            self.registry,
+            stats=self.stats,
+            auditor=self.auditor,
+            sender=self.send_command,
+        )
         artifacts_url = conf.get("stores.artifacts_url")
         self.artifact_store = None
         if artifacts_url:
@@ -216,6 +228,7 @@ class Orchestrator:
             spawner=self.spawner,
             watcher=self.watcher,
             alerts=self.alerts,
+            remediation=self.remediation,
             monitor_interval=monitor_interval,
             heartbeat_ttl=heartbeat_ttl,
             terminal_grace=conf.get("scheduler.terminal_grace"),

@@ -12,9 +12,65 @@ automatically.
 
 from __future__ import annotations
 
+import threading
 import time
 from pathlib import Path
-from typing import Any, Dict, Optional, Union
+from typing import Any, Dict, List, Optional, Set, Union
+
+#: Dot-named so orbax's digit-dir step scan never mistakes it for a step.
+_COMPLETE_DIR = ".complete"
+
+
+#: Shared jitted identity for :func:`_fresh_leaf` — one function object
+#: so each (shape, dtype, sharding) compiles once per process.
+_detach_jit = None
+
+
+def _fresh_leaf(restored_leaf: Any, sharding: Any) -> Any:
+    """Re-place a restored leaf onto ``sharding`` as XLA-owned buffers.
+
+    Two hazards in one pass.  Placement: orbax restores scalar leaves
+    onto the default device, poisoning the jitted step with mixed device
+    sets.  Provenance: buffers staged by the restore (or zero-copied from
+    host memory by ``device_put``) must never be *donated* into an
+    executable deserialized from the persistent XLA compile cache — the
+    CPU client's inflight-computation semaphore underflows
+    (``xla/pjrt/semaphore.cc`` check failure, heap corruption).  A jitted
+    identity detaches the leaf: without donation XLA cannot alias input
+    to output, so the result is a freshly-allocated buffer the runtime
+    owns, safe to donate.
+    """
+    global _detach_jit
+    import jax
+
+    placed = restored_leaf
+    if not isinstance(placed, jax.Array) or placed.sharding != sharding:
+        placed = jax.device_put(placed, sharding)
+    if _detach_jit is None:
+        _detach_jit = jax.jit(lambda x: x)
+    return _detach_jit(placed)
+
+
+def latest_complete_step(directory: Union[str, Path]) -> Optional[int]:
+    """Latest step with a finalize marker — pure filesystem, no orbax/jax
+    import, so the control plane can answer "where can this run resume
+    from" without touching the accelerator runtime.
+
+    Checkpoint dirs written before finalize markers existed (no
+    ``.complete/``) fall back to trusting the digit-named step dirs, the
+    pre-marker behavior.
+    """
+    directory = Path(directory)
+    if not directory.is_dir():
+        return None
+    steps = {int(p.name) for p in directory.iterdir() if p.name.isdigit()}
+    if not steps:
+        return None
+    marks_dir = directory / _COMPLETE_DIR
+    if marks_dir.is_dir():
+        marked = {int(p.name) for p in marks_dir.iterdir() if p.name.isdigit()}
+        steps &= marked
+    return max(steps) if steps else None
 
 
 class CheckpointManager:
@@ -47,8 +103,16 @@ class CheckpointManager:
 
         self.directory = Path(directory).resolve()
         self.directory.mkdir(parents=True, exist_ok=True)
+        # Eager, so a crash before the first marker leaves an EMPTY marker
+        # dir (torn step dirs rejected) rather than no dir (legacy-trust).
+        (self.directory / _COMPLETE_DIR).mkdir(exist_ok=True)
         self.save_block_s = 0.0
         self.saves = 0
+        #: Steps this process staged whose finalize marker isn't written
+        #: yet.  Markers are only ever written for steps saved BY THIS
+        #: process — a fresh process must never bless a torn step dir a
+        #: crashed predecessor left behind.
+        self._pending_marks: Set[int] = set()
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -85,14 +149,65 @@ class CheckpointManager:
         )
         self.save_block_s += time.perf_counter() - t0
         if saved:
+            # Earlier async saves have committed by now (orbax sequences a
+            # new save behind the in-flight one), so their markers can be
+            # written without blocking; ``step`` itself stays pending.
+            self._mark_committed(exclude=step)
+            self._pending_marks.add(step)
             self.saves += 1
         return saved
 
+    def _write_marker(self, step: int) -> None:
+        """Atomic finalize marker: a committed save is only trusted by
+        restore once this rename lands (tmp+rename, so a crash leaves
+        either a valid marker or none — never a torn one)."""
+        marks = self.directory / _COMPLETE_DIR
+        marks.mkdir(exist_ok=True)
+        tmp = marks / f".tmp.{step}"
+        tmp.write_text("")
+        tmp.rename(marks / str(step))
+
+    def _mark_committed(self, exclude: Optional[int] = None) -> None:
+        if not self._pending_marks:
+            return
+        # orbax's all_steps() only lists FINALIZED step dirs (in-flight
+        # saves live under tmp names), so membership proves commit.
+        committed = set(self._mgr.all_steps())
+        for s in sorted(self._pending_marks):
+            if s == exclude or s not in committed:
+                continue
+            self._write_marker(s)
+            self._pending_marks.discard(s)
+
+    def _fence(self) -> None:
+        """Drain in-flight saves, then finalize their markers and GC
+        markers whose step dirs ``max_to_keep`` pruned away."""
+        self._mgr.wait_until_finished()
+        self._mark_committed()
+        marks = self.directory / _COMPLETE_DIR
+        if marks.is_dir():
+            committed = set(self._mgr.all_steps())
+            for p in marks.iterdir():
+                if p.name.isdigit() and int(p.name) not in committed:
+                    p.unlink(missing_ok=True)
+
+    def _complete_steps(self) -> List[int]:
+        committed = set(self._mgr.all_steps())
+        marks = self.directory / _COMPLETE_DIR
+        if not marks.is_dir():
+            # Legacy (pre-marker) checkpoint dir: trust orbax's view.
+            return sorted(committed)
+        marked = {int(p.name) for p in marks.iterdir() if p.name.isdigit()}
+        return sorted(committed & marked)
+
     def latest_step(self) -> Optional[int]:
         # Fence: an in-flight async save's step must be visible to whoever
-        # asks "where are we" (restore-after-save ordering).
-        self._mgr.wait_until_finished()
-        return self._mgr.latest_step()
+        # asks "where are we" (restore-after-save ordering) — and only
+        # steps with a finalize marker count: a torn dir left by a crashed
+        # process must never answer.
+        self._fence()
+        steps = self._complete_steps()
+        return steps[-1] if steps else None
 
     def restore_params(
         self, params_template: Any, step: Optional[int] = None
@@ -103,8 +218,8 @@ class CheckpointManager:
         :meth:`restore` with an optimizer template for those)."""
         import orbax.checkpoint as ocp
 
-        self._mgr.wait_until_finished()  # fence against in-flight saves
-        step = step if step is not None else self._mgr.latest_step()
+        self._fence()  # fence against in-flight saves
+        step = step if step is not None else self._latest_complete()
         if step is None:
             return None
         restored = self._mgr.restore(
@@ -116,7 +231,7 @@ class CheckpointManager:
         import jax
 
         params = jax.tree.map(
-            lambda t, r: jax.device_put(r, t.sharding)
+            lambda t, r: _fresh_leaf(r, t.sharding)
             if hasattr(t, "sharding")
             else r,
             params_template,
@@ -139,8 +254,8 @@ class CheckpointManager:
         """
         import orbax.checkpoint as ocp
 
-        self._mgr.wait_until_finished()  # fence against in-flight saves
-        step = step if step is not None else self._mgr.latest_step()
+        self._fence()  # fence against in-flight saves
+        step = step if step is not None else self._latest_complete()
         if step is None:
             return None
         target = {"params": params_template, "opt_state": opt_state_template}
@@ -161,25 +276,85 @@ class CheckpointManager:
             restored = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(target)
             )
-        # Re-place every leaf onto its template's sharding: orbax restores
-        # scalar leaves (e.g. optax's step count) onto the default device,
-        # which poisons the jitted step with mixed device sets on a mesh.
+        # Re-place every leaf onto its template's sharding as FRESH
+        # buffers: orbax restores scalar leaves (e.g. optax's step count)
+        # onto the default device, which poisons the jitted step with
+        # mixed device sets on a mesh — and its tensorstore-staged
+        # buffers must never be donated directly (see _fresh_leaf).
         import jax
 
         def _place(template_leaf, restored_leaf):
             if hasattr(template_leaf, "sharding"):
-                return jax.device_put(restored_leaf, template_leaf.sharding)
+                return _fresh_leaf(restored_leaf, template_leaf.sharding)
             return restored_leaf
 
         restored = jax.tree.map(_place, target, restored)
         restored["step"] = step
         return restored
 
+    def _latest_complete(self) -> Optional[int]:
+        steps = self._complete_steps()
+        return steps[-1] if steps else None
+
     def wait_until_finished(self) -> None:
-        """Block until every async save has committed to disk."""
-        self._mgr.wait_until_finished()
+        """Block until every async save has committed to disk — and its
+        finalize marker is durable (a caller who fenced may rely on the
+        fenced step surviving a crash)."""
+        self._fence()
 
     def close(self) -> None:
         # Shutdown fence: close() must never truncate an in-flight save.
-        self._mgr.wait_until_finished()
+        self._fence()
         self._mgr.close()
+
+
+class CheckpointNowService:
+    """Worker-side ``checkpoint-now`` command handler: the bridge between
+    the command bus (reporter heartbeat thread) and the train loop.
+
+    The bus handler only QUEUES — checkpointing touches donated device
+    buffers, so the save must run on the loop thread between steps.  The
+    train loop calls :meth:`maybe_save` once per step; when commands are
+    pending it forces a save, fences it (marker durable — the point of
+    checkpoint-now is surviving what comes next), and acks each command
+    ``complete`` with the saved step in its attrs.
+    """
+
+    def __init__(self, ckpt: CheckpointManager, agent: Any) -> None:
+        self._ckpt = ckpt
+        self._agent = agent
+        self._lock = threading.Lock()
+        self._pending: List[str] = []
+        agent.register_handler("checkpoint-now", self._on_command)
+
+    def _on_command(self, cmd: Dict[str, Any]) -> None:
+        # Heartbeat thread: just enqueue (the "acked" event is already out).
+        with self._lock:
+            self._pending.append(str(cmd.get("uuid") or ""))
+
+    def maybe_save(self, step: int, params: Any, opt_state: Any) -> bool:
+        """Train-loop hook; near-free when nothing is pending."""
+        if not self._pending:
+            return False
+        with self._lock:
+            uuids, self._pending = self._pending, []
+        try:
+            try:
+                self._ckpt.save(step, params, opt_state, force=True)
+            except Exception:
+                # Step already saved by the interval policy — fencing the
+                # existing save below is all the command asked for.
+                pass
+            self._ckpt.wait_until_finished()
+            saved = self._ckpt.latest_step()
+        except Exception as exc:  # keep training alive; fail the command
+            for uuid in uuids:
+                if uuid:
+                    self._agent.command_event(
+                        uuid, "failed", message=f"checkpoint-now: {exc}"
+                    )
+            return False
+        for uuid in uuids:
+            if uuid:
+                self._agent.command_event(uuid, "complete", step=saved)
+        return True

@@ -23,7 +23,7 @@ from polyaxon_tpu.db.registry import RegistryError, RunRegistry
 from polyaxon_tpu.events import EventTypes
 from polyaxon_tpu.exceptions import PolyaxonTPUError
 from polyaxon_tpu.lifecycles import StatusOptions as S
-from polyaxon_tpu.monitor import AlertEngine, GangWatcher
+from polyaxon_tpu.monitor import AlertEngine, GangWatcher, RemediationEngine
 from polyaxon_tpu.spawner import GangHandle, GangSpawner
 from polyaxon_tpu.stores import StoreLayout, create_snapshot
 from polyaxon_tpu.workers import CronTasks, SchedulerTasks, TaskBus
@@ -46,6 +46,10 @@ class SchedulerContext:
     #: Alert rule engine, ticked by the monitor task alongside the watcher
     #: (None = alerting off, e.g. minimal test stands).
     alerts: Optional[AlertEngine] = None
+    #: Remediation policy engine — acts on alert firing edges and decides
+    #: the relaunch (resume-from-checkpoint, backoff, budget).  None =
+    #: legacy blind restart (minimal test stands).
+    remediation: Optional[RemediationEngine] = None
     #: Live gang handles keyed by run id (the reference keeps equivalent
     #: state in k8s; a single-service control plane keeps it in-process).
     gangs: Dict[int, GangHandle] = field(default_factory=dict)
@@ -83,6 +87,15 @@ def _record_done(
         except Exception:
             logger.warning(
                 "Alert finalize failed for run %s", run_id, exc_info=True
+            )
+    if ctx.remediation is not None:
+        # Mirror the command expiry above: an action row never hangs open
+        # past the run's terminal state.
+        try:
+            ctx.remediation.finalize(run_id)
+        except Exception:
+            logger.warning(
+                "Remediation finalize failed for run %s", run_id, exc_info=True
             )
     run = ctx.registry.get_run(run_id)
     if run.service_url:
@@ -177,6 +190,17 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             reg.set_status(run_id, S.FAILED, message=f"compile failed: {e}")
             _record_done(ctx, run_id, S.FAILED)
             return
+        if ctx.remediation is not None:
+            # A straggler eviction recorded an elastic topology override in
+            # the run's meta — every (re)launch re-applies it so the gang
+            # stays on the smaller mesh across further restarts.
+            try:
+                plan = ctx.remediation.apply_elastic_plan(run, plan)
+            except Exception:
+                logger.warning(
+                    "Elastic plan override failed for run %s", run_id,
+                    exc_info=True,
+                )
         # Gang admission (reference: scheduler/experiment_scheduler.py's
         # k8s-delegated placement; here an explicit slice inventory). No
         # inventory for the family → admission is off; otherwise the run
@@ -303,12 +327,27 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
                 # Same cadence as the watcher; the engine throttles itself
                 # (interval_s) and counts rule errors instead of raising —
                 # but a registry-level failure here must not kill the poll.
+                transitions = []
                 try:
-                    ctx.alerts.evaluate(handle)
+                    transitions = ctx.alerts.evaluate(handle) or []
                 except Exception:
                     logger.warning(
                         "Alert evaluation failed for run %s", run_id, exc_info=True
                     )
+                if ctx.remediation is not None:
+                    # Detection→action: firing edges trigger typed actions
+                    # (checkpoint-now, eviction); the tick advances
+                    # multi-phase ones.  Never poll-fatal.
+                    try:
+                        if transitions:
+                            ctx.remediation.on_transitions(handle, transitions)
+                        ctx.remediation.tick(handle)
+                    except Exception:
+                        logger.warning(
+                            "Remediation tick failed for run %s",
+                            run_id,
+                            exc_info=True,
+                        )
         if rollup in (S.SUCCEEDED, S.FAILED, S.SKIPPED) and not handle.all_exited:
             # Gang is logically done but members are still alive — typically
             # a survivor blocked in a collective on a dead peer. Give the
@@ -352,28 +391,80 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             # One final ingest now that every process flushed and exited.
             ctx.watcher.ingest(handle)
             ctx.gangs.pop(run_id, None)
+            if ctx.remediation is not None:
+                # Last advancement over the final ingest: an ack that
+                # landed in the gang's dying flush still resolves its
+                # action row (instead of expiring as the run closes).
+                try:
+                    ctx.remediation.tick(handle)
+                except Exception:
+                    logger.warning(
+                        "Remediation final tick failed for run %s",
+                        run_id,
+                        exc_info=True,
+                    )
             if rollup == S.FAILED and run.restarts < handle.plan.max_restarts:
-                restarts = run.restarts + 1
-                reg.update_run(run_id, restarts=restarts)
-                reg.clear_processes(run_id)
-                # Rotate report files so the next attempt's watcher (fresh
-                # offsets) doesn't re-ingest this attempt's lines.
-                for process_id in range(handle.plan.num_hosts):
-                    report = handle.paths.report_file(process_id)
-                    if report.exists():
-                        report.rename(report.with_suffix(f".jsonl.attempt{run.restarts}"))
-                reg.set_status(
-                    run_id,
-                    S.WARNING,
-                    message=f"gang failed; restart {restarts}/{handle.plan.max_restarts}",
-                )
-                ctx.auditor.record(EventTypes.EXPERIMENT_RESTARTED, run_id=run_id)
-                bus.send(
-                    SchedulerTasks.EXPERIMENTS_START,
-                    {"run_id": run_id},
-                    countdown=handle.plan.backoff_seconds,
-                )
-                return
+                # Checkpoint-aware relaunch: the remediation engine decides
+                # from-where (latest COMPLETE async checkpoint — finalize
+                # markers reject torn saves) and how-long (exponential
+                # backoff, per-run budget).  Without an engine, or if its
+                # decision errors, fall back to the plan's fixed backoff —
+                # the trainer still restores whatever checkpoints/ holds.
+                decision = None
+                if ctx.remediation is not None:
+                    try:
+                        decision = ctx.remediation.on_gang_failed(run, handle)
+                    except Exception:
+                        logger.warning(
+                            "Remediation relaunch decision failed for run %s",
+                            run_id,
+                            exc_info=True,
+                        )
+                        decision = {
+                            "backoff_s": handle.plan.backoff_seconds,
+                            "from_step": None,
+                            "message": None,
+                        }
+                else:
+                    decision = {
+                        "backoff_s": handle.plan.backoff_seconds,
+                        "from_step": None,
+                        "message": None,
+                    }
+                if decision is not None:
+                    restarts = run.restarts + 1
+                    reg.update_run(run_id, restarts=restarts)
+                    reg.clear_processes(run_id)
+                    # Rotate report files so the next attempt's watcher
+                    # (fresh offsets) doesn't re-ingest this attempt's
+                    # lines.
+                    for process_id in range(handle.plan.num_hosts):
+                        report = handle.paths.report_file(process_id)
+                        if report.exists():
+                            report.rename(
+                                report.with_suffix(f".jsonl.attempt{run.restarts}")
+                            )
+                    reg.set_status(
+                        run_id,
+                        S.WARNING,
+                        message=decision.get("message")
+                        or (
+                            f"gang failed; restart "
+                            f"{restarts}/{handle.plan.max_restarts}"
+                        ),
+                    )
+                    ctx.auditor.record(
+                        EventTypes.EXPERIMENT_RESTARTED,
+                        run_id=run_id,
+                        from_step=decision.get("from_step"),
+                    )
+                    bus.send(
+                        SchedulerTasks.EXPERIMENTS_START,
+                        {"run_id": run_id},
+                        countdown=decision.get("backoff_s") or 0.0,
+                    )
+                    return
+                # Budget exhausted: fall through to terminal FAILED.
             reg.set_status(run_id, rollup)
             _record_done(ctx, run_id, rollup)
             return

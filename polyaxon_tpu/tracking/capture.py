@@ -359,11 +359,22 @@ class CaptureAgent:
         except Exception:
             logger.warning("Failed to report capture record", exc_info=True)
 
-    def _command_event(self, uuid: str, state: str, message: Optional[str] = None) -> None:
+    def command_event(
+        self, uuid: str, state: str, message: Optional[str] = None, **attrs: Any
+    ) -> None:
+        """Report a per-process command state — the public surface for
+        registered handlers that resolve a command later, off the dispatch
+        thread (checkpoint-now completes from the train loop this way).
+        Extra kwargs ride the report line into the command's ack attrs."""
+        self._command_event(uuid, state, message=message, **attrs)
+
+    def _command_event(
+        self, uuid: str, state: str, message: Optional[str] = None, **attrs: Any
+    ) -> None:
         if self.reporter is None or not uuid:
             return
         try:
-            self.reporter.command_event(uuid, state, message=message)
+            self.reporter.command_event(uuid, state, message=message, **attrs)
         except Exception:
             logger.warning("Failed to report command state", exc_info=True)
 

@@ -184,6 +184,79 @@ class TestAlertFeeds:
         assert drive(orch, body)
 
 
+class TestRemediationFeeds:
+    def test_run_feed_filters_and_engine_status(self, orch):
+        from polyaxon_tpu.db.registry import RemediationStatus
+
+        async def body(client):
+            assert (await client.get("/api/v1/runs/999/remediations")).status == 404
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            reg = orch.registry
+            first = reg.add_remediation(
+                run["id"],
+                "checkpoint_now",
+                trigger="run_stalled",
+                status=RemediationStatus.SUCCEEDED,
+                attrs={"saved_step": 7},
+            )
+            reg.add_remediation(
+                run["id"],
+                "resume",
+                trigger="gang_failed",
+                status=RemediationStatus.SKIPPED,
+            )
+            doc = await (
+                await client.get(f"/api/v1/runs/{run['id']}/remediations")
+            ).json()
+            assert [r["action"] for r in doc["results"]] == [
+                "checkpoint_now",
+                "resume",
+            ]
+            assert doc["results"][0]["attrs"]["saved_step"] == 7
+            # The engine's introspection rides along, like the alert feed.
+            assert doc["engine"]["enabled"] is True
+            assert "run_stalled" in doc["engine"]["checkpoint_rules"]
+
+            skipped = await (
+                await client.get(
+                    f"/api/v1/runs/{run['id']}/remediations?status=skipped"
+                )
+            ).json()
+            assert [r["action"] for r in skipped["results"]] == ["resume"]
+            page = await (
+                await client.get(
+                    f"/api/v1/runs/{run['id']}/remediations?since_id={first['id']}"
+                )
+            ).json()
+            assert [r["action"] for r in page["results"]] == ["resume"]
+            return True
+
+        assert drive(orch, body)
+
+    def test_run_detail_carries_remediation_rollup(self, orch):
+        from polyaxon_tpu.db.registry import RemediationStatus
+
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            orch.registry.add_remediation(
+                run["id"], "evict", status=RemediationStatus.IN_PROGRESS
+            )
+            orch.registry.add_remediation(
+                run["id"], "resume", status=RemediationStatus.SUCCEEDED
+            )
+            doc = await (await client.get(f"/api/v1/runs/{run['id']}")).json()
+            assert doc["remediations"]["total"] == 2
+            assert doc["remediations"]["open"] == 1
+            assert len(doc["remediations"]["results"]) == 2
+            return True
+
+        assert drive(orch, body)
+
+
 class _WebhookSink:
     """Local HTTP endpoint recording every JSON POST it receives."""
 

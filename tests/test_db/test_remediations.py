@@ -1,0 +1,178 @@
+"""Remediations table at the registry layer: lifecycle rows with
+attr accretion, budget counting that exempts refusals, open-row expiry
+on terminal runs, cascade delete, updated_at-keyed retention, run meta
+merge, and the dict-shaped command acks that carry handler results.
+"""
+
+import pytest
+
+from polyaxon_tpu.db.registry import (
+    CommandStatus,
+    RemediationStatus,
+    RunRegistry,
+    command_ack_attrs,
+    command_ack_state,
+)
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "noop:main"},
+    "environment": {"topology": {"accelerator": "cpu", "num_devices": 1}},
+}
+
+
+@pytest.fixture()
+def reg(tmp_path):
+    r = RunRegistry(tmp_path / "reg.db")
+    yield r
+    r.close()
+
+
+class TestLifecycle:
+    def test_add_update_accretes_attrs(self, reg):
+        run = reg.create_run(dict(SPEC))
+        row = reg.add_remediation(
+            run.id,
+            "checkpoint_now",
+            trigger="run_stalled",
+            status=RemediationStatus.IN_PROGRESS,
+            attrs={"command_uuid": "u1"},
+        )
+        assert row["status"] == RemediationStatus.IN_PROGRESS
+        assert row["trigger"] == "run_stalled"
+        assert row["attrs"] == {"command_uuid": "u1"}
+        done = reg.update_remediation(
+            row["id"],
+            status=RemediationStatus.SUCCEEDED,
+            attrs={"saved_step": 7},
+        )
+        # Shallow merge: the phase result rides along with the issue-time
+        # attrs instead of replacing them.
+        assert done["attrs"] == {"command_uuid": "u1", "saved_step": 7}
+        assert done["status"] == RemediationStatus.SUCCEEDED
+        assert done["updated_at"] >= done["created_at"]
+
+    def test_update_missing_row_returns_none(self, reg):
+        assert reg.update_remediation(999, status=RemediationStatus.FAILED) is None
+        assert reg.get_remediation(999) is None
+
+    def test_filters_paging_and_order(self, reg):
+        run = reg.create_run(dict(SPEC))
+        first = reg.add_remediation(run.id, "checkpoint_now")
+        reg.add_remediation(run.id, "evict", status=RemediationStatus.SKIPPED)
+        reg.add_remediation(run.id, "resume", status=RemediationStatus.SUCCEEDED)
+        assert [r["action"] for r in reg.get_remediations(run.id)] == [
+            "checkpoint_now",
+            "evict",
+            "resume",
+        ]
+        assert [
+            r["action"]
+            for r in reg.get_remediations(run.id, status=RemediationStatus.SKIPPED)
+        ] == ["evict"]
+        assert [
+            r["action"] for r in reg.get_remediations(run.id, action="resume")
+        ] == ["resume"]
+        tail = reg.get_remediations(run.id, since_id=first["id"])
+        assert [r["action"] for r in tail] == ["evict", "resume"]
+        assert len(reg.get_remediations(run.id, limit=1)) == 1
+
+    def test_budget_count_exempts_skipped(self, reg):
+        run = reg.create_run(dict(SPEC))
+        reg.add_remediation(run.id, "checkpoint_now", status=RemediationStatus.SUCCEEDED)
+        reg.add_remediation(run.id, "evict", status=RemediationStatus.SKIPPED)
+        reg.add_remediation(run.id, "resume", status=RemediationStatus.FAILED)
+        assert reg.count_remediations(run.id) == 3
+        spent = reg.count_remediations(
+            run.id,
+            statuses=(
+                RemediationStatus.PENDING,
+                RemediationStatus.IN_PROGRESS,
+                RemediationStatus.SUCCEEDED,
+                RemediationStatus.FAILED,
+            ),
+        )
+        assert spent == 2
+
+    def test_expire_closes_only_open_rows(self, reg):
+        run = reg.create_run(dict(SPEC))
+        reg.add_remediation(run.id, "checkpoint_now", status=RemediationStatus.IN_PROGRESS)
+        reg.add_remediation(run.id, "evict", status=RemediationStatus.PENDING)
+        keep = reg.add_remediation(
+            run.id, "resume", status=RemediationStatus.SUCCEEDED
+        )
+        assert reg.expire_remediations(run.id) == 2
+        rows = reg.get_remediations(run.id)
+        assert {r["status"] for r in rows if r["id"] != keep["id"]} == {
+            RemediationStatus.EXPIRED
+        }
+        assert reg.get_remediation(keep["id"])["status"] == RemediationStatus.SUCCEEDED
+        # Idempotent: nothing left open.
+        assert reg.expire_remediations(run.id) == 0
+
+    def test_delete_run_cascades(self, reg):
+        run = reg.create_run(dict(SPEC))
+        row = reg.add_remediation(run.id, "checkpoint_now")
+        reg.delete_run(run.id)
+        assert reg.get_remediation(row["id"]) is None
+
+    def test_retention_keys_off_updated_at(self, reg):
+        run = reg.create_run(dict(SPEC))
+        now = 1_000_000.0
+        old = now - 10_000
+        fresh = reg.add_remediation(run.id, "resume", status=RemediationStatus.SUCCEEDED)
+        stale = reg.add_remediation(run.id, "evict", status=RemediationStatus.SKIPPED)
+        with reg._lock, reg._conn() as conn:
+            conn.execute(
+                "UPDATE remediations SET updated_at = ? WHERE id = ?",
+                (now, fresh["id"]),
+            )
+            conn.execute(
+                "UPDATE remediations SET updated_at = ? WHERE id = ?",
+                (old, stale["id"]),
+            )
+            conn.execute(
+                "UPDATE runs SET finished_at = ? WHERE id = ?", (old, run.id)
+            )
+        removed = reg.clean_old_rows(5_000, now=now)
+        assert removed["remediations"] == 1
+        assert [r["action"] for r in reg.get_remediations(run.id)] == ["resume"]
+
+
+class TestRunMeta:
+    def test_merge_and_remove_keys(self, reg):
+        run = reg.create_run(dict(SPEC))
+        assert run.meta == {}
+        merged = reg.merge_run_meta(run.id, elastic={"num_hosts": 1}, note="x")
+        assert merged["elastic"] == {"num_hosts": 1}
+        assert reg.get_run(run.id).meta == merged
+        # None removes; other keys survive the patch.
+        merged = reg.merge_run_meta(run.id, note=None)
+        assert merged == {"elastic": {"num_hosts": 1}}
+
+    def test_merge_missing_run_raises(self, reg):
+        from polyaxon_tpu.db.registry import RegistryError
+
+        with pytest.raises(RegistryError):
+            reg.merge_run_meta(999, elastic={})
+
+
+class TestCommandAckAttrs:
+    def test_attrs_ack_is_dict_plain_ack_stays_string(self, reg):
+        run = reg.create_run(dict(SPEC))
+        cmd = reg.enqueue_command(run.id, "checkpoint-now", expected=2)
+        reg.mark_command(cmd["uuid"], 0, "complete", attrs={"step": 5})
+        row = reg.mark_command(cmd["uuid"], 1, "complete")
+        # Back-compat: attr-less acks keep the pinned plain-string shape.
+        assert row["acks"]["0"] == {"state": "complete", "attrs": {"step": 5}}
+        assert row["acks"]["1"] == "complete"
+        # Roll-up reads through both shapes.
+        assert row["status"] == CommandStatus.COMPLETE
+
+    def test_ack_helpers_normalize_both_shapes(self):
+        assert command_ack_state({"state": "failed", "attrs": {"e": 1}}) == "failed"
+        assert command_ack_state("acked") == "acked"
+        assert command_ack_attrs({"state": "complete", "attrs": {"step": 9}}) == {
+            "step": 9
+        }
+        assert command_ack_attrs("complete") == {}

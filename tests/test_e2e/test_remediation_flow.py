@@ -1,0 +1,229 @@
+"""The detection→action loop, end to end against real gangs.
+
+Three acceptance flows: a genuinely stalled trainer gets a gang-wide
+``checkpoint-now`` acked with the saved step; a SIGKILLed worker's run
+auto-resumes from its latest *complete* async checkpoint (not step 0)
+and completes; a 2-host gang with a wedged straggler is evicted and
+re-forms on a 1-host mesh, then trains to completion.
+"""
+
+import pytest
+
+from polyaxon_tpu.db.registry import (
+    CommandStatus,
+    RemediationStatus,
+    command_ack_attrs,
+)
+from polyaxon_tpu.events import EventTypes
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.orchestrator import Orchestrator
+
+#: Tiny LM so each attempt compiles + trains in seconds on CPU.
+TINY_LM = {
+    "batch": 4,
+    "seq": 16,
+    "vocab_size": 64,
+    "d_model": 32,
+    "n_layers": 1,
+    "n_heads": 2,
+    "head_dim": 16,
+    "d_ff": 64,
+}
+
+
+def lm_spec(declarations, *, devices=1, hosts=1, **env_extra):
+    decls = dict(TINY_LM)
+    decls.update(declarations)
+    return {
+        "kind": "experiment",
+        "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:lm_train"},
+        "declarations": decls,
+        "environment": {
+            "topology": {
+                "accelerator": "cpu" if devices > 1 else "cpu-1",
+                "num_devices": devices,
+                "num_hosts": hosts,
+            },
+            **env_extra,
+        },
+    }
+
+
+@pytest.mark.e2e
+class TestCheckpointNowFlow:
+    def test_stall_alert_issues_checkpoint_now_and_gang_acks_step(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("POLYAXON_TPU_ALERT_INTERVAL_S", "0.05")
+        monkeypatch.setenv("POLYAXON_TPU_STALL_AFTER_S", "0.5")
+        monkeypatch.setenv("POLYAXON_TPU_PROGRESS_INTERVAL_S", "0.05")
+        orch = Orchestrator(
+            tmp_path / "plat", monitor_interval=0.05, heartbeat_interval=0.2
+        )
+        spec = lm_spec(
+            {
+                "steps": 60,
+                "save_every": 1,
+                # Stall long enough for detection + the command round-trip;
+                # the post-stall steps give the control plane RUNNING ticks
+                # to resolve the action row from the ingested ack.
+                "stall_at_step": 3,
+                "stall_s": 2.5,
+            }
+        )
+        try:
+            run = orch.submit(spec, name="ckpt-now-e2e")
+            done = orch.wait(run.id, timeout=240)
+            assert done.status == S.SUCCEEDED, orch.registry.get_logs(run.id)
+
+            cmds = orch.registry.get_commands(run.id, kind="checkpoint-now")
+            assert cmds, "alert never produced a checkpoint-now command"
+            cmd = cmds[0]
+            assert cmd["status"] == CommandStatus.COMPLETE
+            assert cmd["payload"]["reason"] == "run_stalled"
+            steps = [
+                command_ack_attrs(v).get("step") for v in cmd["acks"].values()
+            ]
+            assert any(s is not None and int(s) >= 0 for s in steps), cmd["acks"]
+
+            rows = orch.registry.get_remediations(run.id, action="checkpoint_now")
+            assert rows, "no remediation row recorded"
+            row = rows[0]
+            assert row["trigger"] == "run_stalled"
+            assert row["status"] == RemediationStatus.SUCCEEDED
+            assert int(row["attrs"]["saved_step"]) >= 0
+            assert orch.registry.get_activities(EventTypes.EXPERIMENT_REMEDIATION)
+            assert any(
+                "checkpoint_now" in k and 'outcome="succeeded"' in k
+                for k in orch.stats.counters
+            ), dict(orch.stats.counters)
+        finally:
+            orch.stop()
+
+
+@pytest.mark.e2e
+class TestAutoResumeFlow:
+    def test_preempted_worker_resumes_from_complete_checkpoint(
+        self, tmp_path, monkeypatch
+    ):
+        orch = Orchestrator(
+            tmp_path / "plat", monitor_interval=0.05, heartbeat_interval=0.2
+        )
+        spec = lm_spec(
+            {
+                "steps": 12,
+                "save_every": 1,
+                "preempt_step": 6,  # SIGKILL mid-loop, once
+            },
+            restart_policy={"max_restarts": 1, "backoff_seconds": 0.1},
+        )
+        try:
+            run = orch.submit(spec, name="auto-resume-e2e")
+            done = orch.wait(run.id, timeout=240)
+            assert done.status == S.SUCCEEDED, orch.registry.get_logs(run.id)
+            assert done.restarts == 1  # still monotonic through the new path
+
+            rows = orch.registry.get_remediations(run.id, action="resume")
+            assert rows, orch.registry.get_remediations(run.id)
+            row = rows[0]
+            assert row["status"] == RemediationStatus.SUCCEEDED
+            from_step = row["attrs"]["from_step"]
+            assert from_step is not None and int(from_step) >= 0
+
+            # The second attempt restored — not a blind step-0 restart.
+            logs = "\n".join(l["line"] for l in orch.registry.get_logs(run.id))
+            assert "restored checkpoint at step" in logs
+            # Both audit trails: the restart marker and the resume event.
+            assert orch.registry.get_activities(EventTypes.EXPERIMENT_RESTARTED)
+            assert orch.registry.get_activities(EventTypes.EXPERIMENT_RESUMED)
+            history = orch.registry.get_statuses(run.id)
+            warn = [s for s in history if s["status"] == S.WARNING]
+            assert warn and "resume from step" in warn[0]["message"]
+        finally:
+            orch.stop()
+
+    def test_no_restart_budget_still_fails_terminally(self, tmp_path):
+        # The engine never invents budget: max_restarts=0 keeps a killed
+        # run FAILED, decided by the plan before remediation is consulted.
+        orch = Orchestrator(
+            tmp_path / "plat", monitor_interval=0.05, heartbeat_interval=0.2
+        )
+        spec = lm_spec({"steps": 12, "save_every": 1, "preempt_step": 4})
+        try:
+            run = orch.submit(spec, name="no-budget-e2e")
+            done = orch.wait(run.id, timeout=240)
+            assert done.status == S.FAILED
+            assert done.restarts == 0
+        finally:
+            orch.stop()
+
+
+@pytest.mark.e2e
+class TestStragglerEvictionFlow:
+    def test_two_host_gang_reforms_on_one_host_mesh(self, tmp_path, monkeypatch):
+        # The straggler probe beats per-process progress with no cross-host
+        # collectives — the only way a genuine step lag can develop on the
+        # CPU backend, where a gloo gang is lockstep (a wedged member
+        # blocks every peer inside one collective, which reads as a
+        # gang-wide stall, not a straggler).
+        monkeypatch.setenv("POLYAXON_TPU_REMEDIATION_EVICT", "1")
+        monkeypatch.setenv("POLYAXON_TPU_STRAGGLER_LAG_STEPS", "2")
+        monkeypatch.setenv("POLYAXON_TPU_ALERT_INTERVAL_S", "0.05")
+        monkeypatch.setenv("POLYAXON_TPU_PROGRESS_INTERVAL_S", "0.05")
+        # The surviving peer keeps beating after the victim dies; the
+        # terminal escalation drains it quickly once the rollup fails.
+        monkeypatch.setenv("POLYAXON_TPU_SCHEDULER_TERMINAL_GRACE", "0.5")
+        orch = Orchestrator(
+            tmp_path / "plat", monitor_interval=0.05, heartbeat_interval=0.2
+        )
+        spec = {
+            "kind": "experiment",
+            "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:stalling"},
+            "declarations": {
+                "warm_steps": 5,
+                "beat_interval": 0.02,
+                # Proc 1 goes silent mid-run while proc 0 advances — the
+                # step-lag detector sees the gang median pull ahead.
+                "stall_process": 1,
+                "stall_s": 60.0,
+                "peer_steps": 400,
+            },
+            "environment": {
+                "topology": {
+                    "accelerator": "cpu",
+                    "num_devices": 2,
+                    "num_hosts": 2,
+                },
+                "restart_policy": {"max_restarts": 1, "backoff_seconds": 0.1},
+            },
+        }
+        try:
+            run = orch.submit(spec, name="evict-e2e")
+            done = orch.wait(run.id, timeout=300)
+            assert done.status == S.SUCCEEDED, orch.registry.get_statuses(run.id)
+            assert done.restarts == 1
+
+            alerts = orch.registry.get_alerts(run.id, rule="gang_straggler")
+            assert alerts and alerts[0]["fired_at"], alerts
+
+            rows = orch.registry.get_remediations(run.id, action="evict")
+            assert rows, orch.registry.get_remediations(run.id)
+            row = rows[0]
+            assert row["status"] == RemediationStatus.SUCCEEDED
+            assert row["attrs"]["process_id"] == 1
+            assert row["attrs"]["elastic"]["num_hosts"] == 1
+
+            # The override is durable run state, applied on relaunch.
+            elastic = done.meta["elastic"]
+            assert elastic["num_hosts"] == 1
+            assert elastic["mesh_axes"] == {"data": 1}
+            assert elastic["evicted"] == [1]
+            assert orch.registry.get_activities(EventTypes.EXPERIMENT_EVICTED)
+
+            # The re-formed attempt really ran (and finished) single-host:
+            # proc 0 completes; the evicted proc never reaches SUCCEEDED.
+            procs = {p["process_id"]: p for p in orch.registry.get_processes(run.id)}
+            assert procs[0]["status"] == S.SUCCEEDED
+            assert procs.get(1) is None or procs[1]["status"] != S.SUCCEEDED
+        finally:
+            orch.stop()

@@ -1,0 +1,471 @@
+"""RemediationEngine units against a real registry and fake gang
+seams: checkpoint-now gating + command resolution, straggler eviction
+(victim pick, mesh shrink, elastic override), relaunch decisions
+(exponential backoff, resume vs restart, legacy-when-disabled, budget
+exhaustion), and elastic plan re-application.
+"""
+
+import pytest
+
+from polyaxon_tpu.compiler.service import GangPlan
+from polyaxon_tpu.db.registry import RemediationStatus, RunRegistry
+from polyaxon_tpu.monitor.remediation import (
+    RemediationEngine,
+    shrink_mesh_axes,
+)
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "noop:main"},
+    "declarations": {"save_every": 2},
+    "environment": {"topology": {"accelerator": "cpu", "num_devices": 2}},
+}
+
+
+class FakeStats:
+    def __init__(self):
+        self.counters = {}
+
+    def incr(self, key, value=1):
+        self.counters[key] = self.counters.get(key, 0) + value
+
+
+class FakeAuditor:
+    def __init__(self):
+        self.events = []
+
+    def record(self, event_type, **context):
+        self.events.append((event_type, context))
+
+
+class FakeRef:
+    def __init__(self):
+        self.signals = []
+        self.exit_code = None
+
+    def poll(self):
+        return self.exit_code
+
+    def signal(self, sig):
+        self.signals.append(sig)
+
+
+class FakePaths:
+    def __init__(self, tmp_path):
+        self.checkpoints = tmp_path / "checkpoints"
+
+
+class FakeHandle:
+    def __init__(self, run_id, plan, tmp_path, n_procs=None):
+        self.run_id = run_id
+        self.plan = plan
+        self.paths = FakePaths(tmp_path)
+        n = plan.num_hosts if n_procs is None else n_procs
+        self.processes = {i: FakeRef() for i in range(n)}
+
+
+def make_plan(**kw):
+    base = dict(
+        num_hosts=2,
+        devices_per_host=1,
+        mesh_axes={"data": 2},
+        strategy="data_parallel",
+        max_restarts=2,
+        backoff_seconds=0.5,
+    )
+    base.update(kw)
+    return GangPlan(**base)
+
+
+@pytest.fixture()
+def reg(tmp_path):
+    r = RunRegistry(tmp_path / "reg.db")
+    yield r
+    r.close()
+
+
+def make_engine(reg, monkeypatch, *, sender=None, **env):
+    for key, value in env.items():
+        monkeypatch.setenv(f"POLYAXON_TPU_REMEDIATION_{key}", value)
+    stats, auditor = FakeStats(), FakeAuditor()
+    eng = RemediationEngine(reg, stats=stats, auditor=auditor, sender=sender)
+    return eng, stats, auditor
+
+
+def registry_sender(reg, sent):
+    """A sender seam backed by the real command store (no mailboxes)."""
+
+    def send(run_id, kind, *, payload=None, processes=None, actor=None):
+        cmd = reg.enqueue_command(run_id, kind, payload=payload, expected=1)
+        sent.append((run_id, kind, payload, actor))
+        return cmd
+
+    return send
+
+
+class TestShrinkMeshAxes:
+    def test_prefers_data_like_axes(self):
+        axes, dcn = shrink_mesh_axes({"tensor": 2, "data": 4}, {}, 4, 2)
+        assert axes == {"tensor": 2, "data": 2}
+        assert dcn == {}
+
+    def test_dcn_axis_shrinks_in_lockstep(self):
+        axes, dcn = shrink_mesh_axes({"data": 4}, {"data": 2}, 4, 2)
+        assert axes == {"data": 2}
+        assert dcn == {"data": 1}
+
+    def test_falls_back_to_any_divisible_axis(self):
+        axes, _ = shrink_mesh_axes({"tensor": 4}, {}, 2, 1)
+        assert axes == {"tensor": 2}
+
+    def test_none_when_nothing_divides(self):
+        assert shrink_mesh_axes({"tensor": 3}, {}, 2, 1) is None
+        assert shrink_mesh_axes({"data": 1}, {}, 2, 1) is None
+
+    def test_none_when_not_actually_shrinking(self):
+        assert shrink_mesh_axes({"data": 2}, {}, 2, 2) is None
+        assert shrink_mesh_axes({"data": 2}, {}, 2, 0) is None
+
+
+class TestCheckpointNow:
+    def firing(self, rule="run_stalled", attrs=None):
+        return [{"rule": rule, "state": "firing", "attrs": attrs or {}}]
+
+    def test_firing_stall_issues_command_and_row(self, reg, tmp_path, monkeypatch):
+        sent = []
+        eng, stats, auditor = make_engine(
+            reg, monkeypatch, sender=registry_sender(reg, sent)
+        )
+        run = reg.create_run(dict(SPEC))
+        handle = FakeHandle(run.id, make_plan(num_hosts=1), tmp_path)
+        eng.on_transitions(handle, self.firing())
+        assert sent == [(run.id, "checkpoint-now", {"reason": "run_stalled"}, "remediation")]
+        (row,) = reg.get_remediations(run.id)
+        assert row["action"] == "checkpoint_now"
+        assert row["status"] == RemediationStatus.IN_PROGRESS
+        assert row["attrs"]["command_uuid"]
+        assert any(e[0] == "experiment.remediation" for e in auditor.events)
+        assert any("checkpoint_now" in k and "issued" in k for k in stats.counters)
+
+    def test_no_action_without_declared_checkpointing(self, reg, tmp_path, monkeypatch):
+        sent = []
+        eng, _, _ = make_engine(reg, monkeypatch, sender=registry_sender(reg, sent))
+        spec = dict(SPEC)
+        spec["declarations"] = {}
+        run = reg.create_run(spec)
+        eng.on_transitions(FakeHandle(run.id, make_plan(), tmp_path), self.firing())
+        assert sent == []
+        assert reg.get_remediations(run.id) == []
+
+    def test_open_row_suppresses_duplicates(self, reg, tmp_path, monkeypatch):
+        sent = []
+        eng, _, _ = make_engine(reg, monkeypatch, sender=registry_sender(reg, sent))
+        run = reg.create_run(dict(SPEC))
+        handle = FakeHandle(run.id, make_plan(), tmp_path)
+        eng.on_transitions(handle, self.firing())
+        eng.on_transitions(handle, self.firing())
+        assert len(sent) == 1
+        assert len(reg.get_remediations(run.id)) == 1
+
+    def test_resolved_edges_only(self, reg, tmp_path, monkeypatch):
+        sent = []
+        eng, _, _ = make_engine(reg, monkeypatch, sender=registry_sender(reg, sent))
+        run = reg.create_run(dict(SPEC))
+        handle = FakeHandle(run.id, make_plan(), tmp_path)
+        eng.on_transitions(
+            handle, [{"rule": "run_stalled", "state": "resolved", "attrs": {}}]
+        )
+        assert sent == []
+
+    def test_budget_exhaustion_blocks_issue(self, reg, tmp_path, monkeypatch):
+        sent = []
+        eng, _, _ = make_engine(
+            reg, monkeypatch, sender=registry_sender(reg, sent), BUDGET="1"
+        )
+        run = reg.create_run(dict(SPEC))
+        reg.add_remediation(run.id, "resume", status=RemediationStatus.SUCCEEDED)
+        eng.on_transitions(FakeHandle(run.id, make_plan(), tmp_path), self.firing())
+        assert sent == []
+
+    def test_tick_resolves_complete_with_saved_step(self, reg, tmp_path, monkeypatch):
+        sent = []
+        eng, stats, _ = make_engine(reg, monkeypatch, sender=registry_sender(reg, sent))
+        run = reg.create_run(dict(SPEC))
+        handle = FakeHandle(run.id, make_plan(num_hosts=1), tmp_path)
+        eng.on_transitions(handle, self.firing())
+        (row,) = reg.get_remediations(run.id)
+        reg.mark_command(row["attrs"]["command_uuid"], 0, "complete", attrs={"step": 6})
+        eng.tick(handle)
+        (row,) = reg.get_remediations(run.id)
+        assert row["status"] == RemediationStatus.SUCCEEDED
+        assert row["attrs"]["saved_step"] == 6
+        assert any("succeeded" in k for k in stats.counters)
+
+    def test_tick_times_out_unanswered_command(self, reg, tmp_path, monkeypatch):
+        sent = []
+        eng, _, _ = make_engine(reg, monkeypatch, sender=registry_sender(reg, sent))
+        run = reg.create_run(dict(SPEC))
+        handle = FakeHandle(run.id, make_plan(num_hosts=1), tmp_path)
+        eng.on_transitions(handle, self.firing())
+        (row,) = reg.get_remediations(run.id)
+        eng.tick(handle, now=row["attrs"]["deadline"] + 1)
+        (row,) = reg.get_remediations(run.id)
+        assert row["status"] == RemediationStatus.FAILED
+        assert "timeout" in row["message"]
+
+    def test_disabled_engine_does_nothing(self, reg, tmp_path, monkeypatch):
+        sent = []
+        eng, _, _ = make_engine(
+            reg, monkeypatch, sender=registry_sender(reg, sent), ENABLED="0"
+        )
+        run = reg.create_run(dict(SPEC))
+        eng.on_transitions(FakeHandle(run.id, make_plan(), tmp_path), self.firing())
+        assert sent == []
+        assert reg.get_remediations(run.id) == []
+
+
+class TestEviction:
+    def straggler(self, pid=1, lag=5):
+        return [
+            {
+                "rule": "gang_straggler",
+                "state": "firing",
+                "attrs": {"stragglers": [{"process_id": pid, "lag_steps": lag}]},
+            }
+        ]
+
+    def test_evict_kills_worst_and_records_elastic(self, reg, tmp_path, monkeypatch):
+        eng, _, auditor = make_engine(reg, monkeypatch, EVICT="1")
+        spec = dict(SPEC)
+        spec["declarations"] = {}  # no checkpoint phase — straight to kill
+        run = reg.create_run(spec)
+        handle = FakeHandle(run.id, make_plan(num_hosts=2), tmp_path)
+        eng.on_transitions(handle, self.straggler(pid=1, lag=7))
+        (row,) = reg.get_remediations(run.id)
+        assert row["action"] == "evict"
+        assert row["status"] == RemediationStatus.SUCCEEDED
+        assert row["attrs"]["phase"] == "killed"
+        assert handle.processes[1].signals  # victim got SIGKILL
+        assert not handle.processes[0].signals
+        meta = reg.get_run(run.id).meta
+        assert meta["elastic"]["num_hosts"] == 1
+        assert meta["elastic"]["mesh_axes"] == {"data": 1}
+        assert meta["elastic"]["evicted"] == [1]
+        assert any(e[0] == "experiment.evicted" for e in auditor.events)
+
+    def test_evict_default_off(self, reg, tmp_path, monkeypatch):
+        eng, _, _ = make_engine(reg, monkeypatch)
+        run = reg.create_run(dict(SPEC))
+        handle = FakeHandle(run.id, make_plan(num_hosts=2), tmp_path)
+        eng.on_transitions(handle, self.straggler())
+        assert reg.get_remediations(run.id) == []
+
+    def test_single_host_gang_never_evicts(self, reg, tmp_path, monkeypatch):
+        eng, _, _ = make_engine(reg, monkeypatch, EVICT="1")
+        run = reg.create_run(dict(SPEC))
+        handle = FakeHandle(run.id, make_plan(num_hosts=1, mesh_axes={"data": 1}), tmp_path)
+        eng.on_transitions(handle, self.straggler(pid=0))
+        assert reg.get_remediations(run.id) == []
+
+    def test_unshrinkable_mesh_is_a_skipped_row(self, reg, tmp_path, monkeypatch):
+        eng, _, _ = make_engine(reg, monkeypatch, EVICT="1")
+        run = reg.create_run(dict(SPEC))
+        handle = FakeHandle(
+            run.id, make_plan(num_hosts=2, mesh_axes={"tensor": 3}), tmp_path
+        )
+        eng.on_transitions(handle, self.straggler(pid=1))
+        (row,) = reg.get_remediations(run.id)
+        assert row["status"] == RemediationStatus.SKIPPED
+        assert not handle.processes[1].signals
+        assert "elastic" not in reg.get_run(run.id).meta
+
+    def test_checkpoint_phase_then_kill_on_tick(self, reg, tmp_path, monkeypatch):
+        sent = []
+        eng, _, _ = make_engine(
+            reg, monkeypatch, sender=registry_sender(reg, sent), EVICT="1"
+        )
+        run = reg.create_run(dict(SPEC))  # declares save_every=2
+        handle = FakeHandle(run.id, make_plan(num_hosts=2), tmp_path)
+        eng.on_transitions(handle, self.straggler(pid=1))
+        # Phase 1: checkpoint fence issued, victim still alive.
+        assert [kind for _, kind, _, _ in sent] == ["checkpoint-now"]
+        (row,) = reg.get_remediations(run.id)
+        assert row["attrs"]["phase"] == "checkpoint"
+        assert not handle.processes[1].signals
+        # Command resolves → tick finishes the kill.
+        reg.mark_command(row["attrs"]["command_uuid"], 0, "complete", attrs={"step": 4})
+        eng.tick(handle)
+        (row,) = reg.get_remediations(run.id)
+        assert row["status"] == RemediationStatus.SUCCEEDED
+        assert handle.processes[1].signals
+
+    def test_checkpoint_timeout_still_evicts(self, reg, tmp_path, monkeypatch):
+        sent = []
+        eng, _, _ = make_engine(
+            reg, monkeypatch, sender=registry_sender(reg, sent), EVICT="1"
+        )
+        run = reg.create_run(dict(SPEC))
+        handle = FakeHandle(run.id, make_plan(num_hosts=2), tmp_path)
+        eng.on_transitions(handle, self.straggler(pid=1))
+        (row,) = reg.get_remediations(run.id)
+        eng.tick(handle, now=row["attrs"]["deadline"] + 1)
+        (row,) = reg.get_remediations(run.id)
+        assert row["status"] == RemediationStatus.SUCCEEDED
+        assert handle.processes[1].signals
+
+
+class TestGangFailed:
+    def test_resume_from_marked_checkpoint_with_backoff(self, reg, tmp_path, monkeypatch):
+        eng, _, auditor = make_engine(reg, monkeypatch)
+        run = reg.create_run(dict(SPEC))
+        handle = FakeHandle(run.id, make_plan(backoff_seconds=0.5), tmp_path)
+        ckpts = handle.paths.checkpoints
+        (ckpts / "4").mkdir(parents=True)
+        (ckpts / ".complete").mkdir()
+        (ckpts / ".complete" / "4").touch()
+        run = reg.get_run(run.id)
+        decision = eng.on_gang_failed(run, handle)
+        assert decision["from_step"] == 4
+        assert decision["backoff_s"] == 0.5  # 0.5 * 2**0
+        assert "resume from step 4" in decision["message"]
+        (row,) = reg.get_remediations(run.id)
+        assert row["action"] == "resume"
+        assert row["attrs"]["from_step"] == 4
+        assert any(e[0] == "experiment.resumed" for e in auditor.events)
+
+    def test_backoff_grows_exponentially_and_caps(self, reg, tmp_path, monkeypatch):
+        eng, _, _ = make_engine(reg, monkeypatch, BACKOFF_MAX_S="3.0")
+        run = reg.create_run(dict(SPEC))
+        handle = FakeHandle(run.id, make_plan(backoff_seconds=1.0, max_restarts=9), tmp_path)
+        backoffs = []
+        for restarts in (0, 1, 2, 5):
+            run = reg.get_run(run.id)
+            run.restarts = restarts
+            backoffs.append(eng.on_gang_failed(run, handle)["backoff_s"])
+        assert backoffs == [1.0, 2.0, 3.0, 3.0]
+
+    def test_no_checkpoint_is_an_honest_restart(self, reg, tmp_path, monkeypatch):
+        eng, _, auditor = make_engine(reg, monkeypatch)
+        run = reg.create_run(dict(SPEC))
+        handle = FakeHandle(run.id, make_plan(), tmp_path)
+        decision = eng.on_gang_failed(reg.get_run(run.id), handle)
+        assert decision["from_step"] is None
+        (row,) = reg.get_remediations(run.id)
+        assert row["action"] == "restart"
+        assert not any(e[0] == "experiment.resumed" for e in auditor.events)
+
+    def test_torn_tail_checkpoint_is_skipped(self, reg, tmp_path, monkeypatch):
+        eng, _, _ = make_engine(reg, monkeypatch)
+        run = reg.create_run(dict(SPEC))
+        handle = FakeHandle(run.id, make_plan(), tmp_path)
+        ckpts = handle.paths.checkpoints
+        (ckpts / ".complete").mkdir(parents=True)
+        (ckpts / "2").mkdir()
+        (ckpts / ".complete" / "2").touch()
+        (ckpts / "6").mkdir()  # step dir exists, marker never written
+        decision = eng.on_gang_failed(reg.get_run(run.id), handle)
+        assert decision["from_step"] == 2
+
+    def test_disabled_returns_legacy_decision(self, reg, tmp_path, monkeypatch):
+        eng, _, _ = make_engine(reg, monkeypatch, ENABLED="0")
+        run = reg.create_run(dict(SPEC))
+        handle = FakeHandle(run.id, make_plan(backoff_seconds=1.5, max_restarts=2), tmp_path)
+        decision = eng.on_gang_failed(reg.get_run(run.id), handle)
+        assert decision == {
+            "backoff_s": 1.5,
+            "from_step": None,
+            "message": "gang failed; restart 1/2",
+        }
+        assert reg.get_remediations(run.id) == []
+
+    def test_budget_exhausted_returns_none_with_skipped_row(
+        self, reg, tmp_path, monkeypatch
+    ):
+        eng, _, _ = make_engine(reg, monkeypatch, BUDGET="1")
+        run = reg.create_run(dict(SPEC))
+        reg.add_remediation(run.id, "checkpoint_now", status=RemediationStatus.SUCCEEDED)
+        handle = FakeHandle(run.id, make_plan(), tmp_path)
+        assert eng.on_gang_failed(reg.get_run(run.id), handle) is None
+        rows = reg.get_remediations(run.id, action="resume")
+        assert rows and rows[-1]["status"] == RemediationStatus.SKIPPED
+
+
+class TestElasticPlan:
+    def test_override_applies_and_derived_sizes_follow(self, reg, tmp_path, monkeypatch):
+        eng, _, _ = make_engine(reg, monkeypatch)
+        run = reg.create_run(dict(SPEC))
+        reg.merge_run_meta(
+            run.id,
+            elastic={"num_hosts": 1, "mesh_axes": {"data": 1}, "dcn_axes": {}},
+        )
+        plan = make_plan(num_hosts=2, devices_per_host=4)
+        new = eng.apply_elastic_plan(reg.get_run(run.id), plan)
+        assert new.num_hosts == 1
+        assert new.mesh_axes == {"data": 1}
+        assert new.num_devices == 4  # property re-derives from num_hosts
+        assert plan.num_hosts == 2  # original untouched
+
+    def test_no_meta_is_identity(self, reg, tmp_path, monkeypatch):
+        eng, _, _ = make_engine(reg, monkeypatch)
+        run = reg.create_run(dict(SPEC))
+        plan = make_plan()
+        assert eng.apply_elastic_plan(reg.get_run(run.id), plan) is plan
+
+    def test_growing_override_is_ignored(self, reg, tmp_path, monkeypatch):
+        eng, _, _ = make_engine(reg, monkeypatch)
+        run = reg.create_run(dict(SPEC))
+        reg.merge_run_meta(run.id, elastic={"num_hosts": 4, "mesh_axes": {"data": 4}})
+        plan = make_plan(num_hosts=2)
+        assert eng.apply_elastic_plan(reg.get_run(run.id), plan) is plan
+
+
+class TestFinalizeAndStatus:
+    def test_finalize_expires_open_rows(self, reg, tmp_path, monkeypatch):
+        eng, _, _ = make_engine(reg, monkeypatch)
+        run = reg.create_run(dict(SPEC))
+        reg.add_remediation(run.id, "checkpoint_now", status=RemediationStatus.IN_PROGRESS)
+        eng.finalize(run.id)
+        (row,) = reg.get_remediations(run.id)
+        assert row["status"] == RemediationStatus.EXPIRED
+
+    def test_status_shape(self, reg, tmp_path, monkeypatch):
+        eng, _, _ = make_engine(reg, monkeypatch, BUDGET="5", EVICT="1")
+        st = eng.status()
+        assert st["enabled"] is True
+        assert st["evict_enabled"] is True
+        assert st["budget"] == 5
+        assert st["checkpoint_rules"] == ["run_stalled"]
+
+
+class TestHealthProbe:
+    """``check_remediation``: posture probe over ``engine.status()``."""
+
+    class _Orch:
+        def __init__(self, engine):
+            self.remediation = engine
+
+    def test_unwired_and_disabled_are_healthy(self, reg, monkeypatch):
+        from polyaxon_tpu.checks.health import check_remediation
+
+        ok, detail = check_remediation(self._Orch(None))
+        assert ok and "not wired" in detail
+        eng, _, _ = make_engine(reg, monkeypatch, ENABLED="0")
+        ok, detail = check_remediation(self._Orch(eng))
+        assert ok and "disabled" in detail
+
+    def test_errors_without_actions_is_unhealthy(self, reg, monkeypatch):
+        from polyaxon_tpu.checks.health import check_remediation
+
+        eng, _, _ = make_engine(reg, monkeypatch)
+        eng.errors = 3
+        ok, detail = check_remediation(self._Orch(eng))
+        assert not ok and "3 reaction error(s)" in detail
+        # Any succeeded action means the arc works — errors are then noise.
+        eng.actions = 1
+        ok, detail = check_remediation(self._Orch(eng))
+        assert ok and "1 action(s)" in detail
+
+    def test_probe_registered_in_catalog(self):
+        from polyaxon_tpu.checks.health import CHECKS
+
+        assert "remediation" in CHECKS

@@ -1,5 +1,11 @@
 """Checkpoint manager: save/restore of sharded training state."""
 
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -8,7 +14,11 @@ import pytest
 
 from polyaxon_tpu.models import TransformerConfig, init_params, loss_fn, param_axes
 from polyaxon_tpu.parallel import template_for
-from polyaxon_tpu.runtime.checkpoint import CheckpointManager
+from polyaxon_tpu.runtime.checkpoint import (
+    CheckpointManager,
+    CheckpointNowService,
+    latest_complete_step,
+)
 from polyaxon_tpu.runtime.mesh import build_mesh
 from polyaxon_tpu.runtime.train import build_train_step
 
@@ -128,3 +138,160 @@ class TestCheckpointManager:
         steps = sorted(int(p.name) for p in (tmp_path / "ckpt").iterdir() if p.name.isdigit())
         assert len(steps) <= 2
         mgr.close()
+
+
+def tiny_tree():
+    """Small host-side trees — enough for orbax, cheap enough for tier-1."""
+    params = {"w": np.arange(8, dtype=np.float32), "b": np.ones((), np.float32)}
+    opt = {"mu": np.zeros(8, dtype=np.float32)}
+    return params, opt
+
+
+class TestFinalizeMarkers:
+    """Torn-save protection: only steps with a finalize marker answer
+    restore, and only the process that staged a save may mark it."""
+
+    def test_latest_complete_step_marked_and_legacy_dirs(self, tmp_path):
+        assert latest_complete_step(tmp_path / "missing") is None
+        legacy = tmp_path / "legacy"
+        (legacy / "3").mkdir(parents=True)
+        (legacy / "7").mkdir()
+        # Pre-marker dir (no .complete/): trust the digit dirs.
+        assert latest_complete_step(legacy) == 7
+        marked = tmp_path / "marked"
+        (marked / "2").mkdir(parents=True)
+        (marked / "6").mkdir()
+        (marked / ".complete").mkdir()
+        (marked / ".complete" / "2").touch()
+        # Step 6's dir exists but was never finalized — torn, not eligible.
+        assert latest_complete_step(marked) == 2
+        empty = tmp_path / "empty"
+        (empty / ".complete").mkdir(parents=True)
+        assert latest_complete_step(empty) is None
+
+    def test_unfinalized_tail_save_is_skipped_on_restore(self, tmp_path):
+        params, opt = tiny_tree()
+        mgr = CheckpointManager(tmp_path / "ckpt")
+        mgr.save(0, params, opt, force=True)
+        mgr.wait_until_finished()  # full fence: step 0's marker is durable
+        torn = {"w": params["w"] + 1, "b": params["b"]}
+        mgr.save(1, torn, opt, force=True)
+        # Crash-equivalent abandonment: drain orbax's async commit WITHOUT
+        # the manager's fence, so step 1's dir lands but its finalize
+        # marker is never written — exactly what a kill mid-save leaves.
+        mgr._mgr.wait_until_finished()
+        assert mgr._pending_marks == {1}
+
+        again = CheckpointManager(tmp_path / "ckpt")
+        # A fresh process must not bless the torn step...
+        assert again.latest_step() == 0
+        assert latest_complete_step(tmp_path / "ckpt") == 0
+        # ...and restores the last finalized one.
+        fp, fo = tiny_tree()
+        restored = again.restore(fp, fo)
+        assert restored["step"] == 0
+        np.testing.assert_array_equal(restored["params"]["w"], params["w"])
+        again.close()
+
+    def test_owner_fence_finalizes_its_own_save(self, tmp_path):
+        params, opt = tiny_tree()
+        mgr = CheckpointManager(tmp_path / "ckpt")
+        mgr.save(5, params, opt, force=True)
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 5
+        marks = tmp_path / "ckpt" / ".complete"
+        assert (marks / "5").is_file()
+        mgr.close()
+
+    def test_pruned_step_markers_are_garbage_collected(self, tmp_path):
+        params, opt = tiny_tree()
+        mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=2)
+        for step in range(4):
+            mgr.save(step, params, opt, force=True)
+        mgr.wait_until_finished()
+        marks = tmp_path / "ckpt" / ".complete"
+        kept = sorted(int(p.name) for p in marks.iterdir() if p.name.isdigit())
+        assert kept == sorted(mgr._mgr.all_steps())
+        mgr.close()
+
+    def test_kill_mid_save_subprocess(self, tmp_path):
+        """The real regression: a worker SIGKILLed right after staging a
+        save leaves a step dir but no marker; the successor resumes from
+        the previous finalized step."""
+        script = textwrap.dedent(
+            """
+            import os, signal, sys
+            import numpy as np
+            from polyaxon_tpu.runtime.checkpoint import CheckpointManager
+
+            params = {"w": np.arange(8, dtype=np.float32)}
+            opt = {"mu": np.zeros(8, dtype=np.float32)}
+            mgr = CheckpointManager(sys.argv[1])
+            mgr.save(0, params, opt, force=True)
+            mgr.wait_until_finished()
+            mgr.save(1, params, opt, force=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+            """
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", script, str(tmp_path / "ckpt")],
+            env=env,
+            timeout=120,
+        )
+        assert proc.returncode == -signal.SIGKILL
+        assert latest_complete_step(tmp_path / "ckpt") == 0
+        mgr = CheckpointManager(tmp_path / "ckpt")
+        assert mgr.latest_step() == 0
+        mgr.close()
+
+
+class RecordingAgent:
+    """CaptureAgent seam for CheckpointNowService: handler registry +
+    command_event recording."""
+
+    def __init__(self):
+        self.handlers = {}
+        self.events = []
+
+    def register_handler(self, kind, fn):
+        self.handlers[kind] = fn
+
+    def command_event(self, uuid, state, message=None, **attrs):
+        self.events.append((uuid, state, message, attrs))
+
+
+class TestCheckpointNowService:
+    def test_pending_command_forces_save_and_acks_step(self, tmp_path):
+        params, opt = tiny_tree()
+        mgr = CheckpointManager(tmp_path / "ckpt")
+        agent = RecordingAgent()
+        svc = CheckpointNowService(mgr, agent)
+        # Fast path: nothing pending, no IO.
+        assert svc.maybe_save(0, params, opt) is False
+        # Heartbeat thread delivers the command...
+        agent.handlers["checkpoint-now"]({"uuid": "u1", "kind": "checkpoint-now"})
+        # ...and the next loop iteration fences a save and acks it.
+        assert svc.maybe_save(3, params, opt) is True
+        assert agent.events == [("u1", "complete", None, {"step": 3})]
+        assert latest_complete_step(tmp_path / "ckpt") == 3
+        # Drained: a later step without new commands is free again.
+        assert svc.maybe_save(4, params, opt) is False
+        mgr.close()
+
+    def test_save_failure_fails_the_command_not_the_loop(self, tmp_path):
+        class BrokenManager:
+            def save(self, *a, **k):
+                raise RuntimeError("disk gone")
+
+            def wait_until_finished(self):
+                raise RuntimeError("disk gone")
+
+        agent = RecordingAgent()
+        svc = CheckpointNowService(BrokenManager(), agent)
+        agent.handlers["checkpoint-now"]({"uuid": "u2"})
+        params, opt = tiny_tree()
+        assert svc.maybe_save(1, params, opt) is False  # loop survives
+        (uuid, state, message, attrs) = agent.events[0]
+        assert (uuid, state) == ("u2", "failed")
+        assert "disk gone" in message
