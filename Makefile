@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast gate bench-smoke dryrun
+.PHONY: test test-fast gate bench-smoke dryrun lint
 
 # Fast developer loop: skips the subprocess-gang / multi-minute tests.
 test-fast:
@@ -16,6 +16,12 @@ test-fast:
 # Full suite (what the gate runs).
 test:
 	$(PY) -m pytest tests/ -q
+
+# graft-lint: the package-native static-analysis pass (docs/analysis.md).
+# Exit 1 on any unsuppressed finding; --no-state keeps CI hermetic (the
+# health-probe state file is for interactive runs).
+lint:
+	$(PY) -m polyaxon_tpu.analysis --no-state
 
 # Bench sanity on CPU: the script must run end-to-end and print its JSON
 # line (no TPU required — the CPU fallback path exercises all the code).
@@ -27,5 +33,5 @@ dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-gate: test bench-smoke dryrun
-	@echo "GATE PASSED: full suite green, bench smoke ok, dryrun ok"
+gate: lint test bench-smoke dryrun
+	@echo "GATE PASSED: lint clean, full suite green, bench smoke ok, dryrun ok"

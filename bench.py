@@ -1412,6 +1412,33 @@ def main() -> None:
                 base["train_images_per_s"] = train_images["images_per_s"]
         baseline_path.write_text(json.dumps(base))
 
+    # graft-lint full-package runtime: the static pass rides every CI
+    # invocation (`make lint` is in the gate), so it gets a wall-clock
+    # budget like every other tick path — a rule that grows a quadratic
+    # project index fails here, not in everyone's pre-push loop.
+    analysis_runtime_s = None
+    analysis_runtime_ok = None
+    try:
+        import sys
+
+        from polyaxon_tpu.analysis import run_analysis
+
+        t0 = time.perf_counter()
+        run_analysis()
+        analysis_runtime_s = time.perf_counter() - t0
+        analysis_runtime_ok = analysis_runtime_s < 10.0
+        if not analysis_runtime_ok:
+            print(
+                f"bench: analysis_runtime_s={analysis_runtime_s:.2f} over "
+                "the 10s budget — graft-lint is too slow for CI",
+                file=sys.stderr,
+            )
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+
     print(
         json.dumps(
             {
@@ -1522,6 +1549,12 @@ def main() -> None:
                     if serving_ready_s is not None
                     else None
                 ),
+                "analysis_runtime_s": (
+                    round(analysis_runtime_s, 3)
+                    if analysis_runtime_s is not None
+                    else None
+                ),
+                "analysis_runtime_ok": analysis_runtime_ok,
             }
         )
     )

@@ -16,6 +16,7 @@ import json
 import logging
 from typing import Any, Dict, Optional
 
+from polyaxon_tpu.conf.knobs import knob_str
 from polyaxon_tpu.db.registry import RemediationStatus, Run, RunRegistry
 from polyaxon_tpu.events import EventTypes
 from polyaxon_tpu.exceptions import PolyaxonTPUError
@@ -1420,14 +1421,12 @@ def serve(
     auth_token: Optional[str] = None,
 ) -> None:
     """Run the service: orchestrator loop in a thread + aiohttp in the main loop."""
-    import os
-
     from aiohttp import web
 
     orch = orch or Orchestrator(base_dir)
     orch.start()
     app = create_app(
-        orch, auth_token=auth_token or os.environ.get("POLYAXON_TPU_AUTH_TOKEN")
+        orch, auth_token=auth_token or knob_str("POLYAXON_TPU_AUTH_TOKEN") or None
     )
     try:
         web.run_app(app, host=host, port=port, print=logger.info)

@@ -9,6 +9,7 @@ platform's own failure-handling tests, like the reference's fixture specs.
 
 from __future__ import annotations
 
+import functools
 import time
 
 from polyaxon_tpu.stats import get_stats
@@ -935,7 +936,10 @@ def synthetic_regression(ctx: Context) -> None:
         x = jax.device_put(x, batch_sharding)
         y = jax.device_put(y, batch_sharding)
 
-    @jax.jit
+    # params/opt_state are rebound from the result every step — donate
+    # them so XLA updates in place instead of copying both pytrees per
+    # call (x/y are reused across steps and must NOT be donated).
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, x, y):
         def loss_fn(p):
             pred = x @ p["w"]

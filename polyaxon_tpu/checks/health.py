@@ -140,6 +140,44 @@ def check_remediation(orch) -> Tuple[bool, str]:
     )
 
 
+def check_static_analysis(orch) -> Tuple[bool, str]:
+    """graft-lint posture: what the last recorded run found, and whether
+    it is stale.  Never-run and stale are diagnostic (ok=True) — a fresh
+    deployment hasn't linted yet and that shouldn't page anyone; recorded
+    *unsuppressed findings* are a real defect signal (ok=False)."""
+    from polyaxon_tpu.analysis.reporter import read_state, state_file_path
+    from polyaxon_tpu.conf.knobs import knob_float
+
+    state = read_state()
+    if state is None:
+        return True, (
+            f"never run (no state at {state_file_path()}; "
+            "run `python -m polyaxon_tpu.analysis` or `make lint`)"
+        )
+    rules = ", ".join(
+        f"{rid} v{meta['version']}"
+        for rid, meta in sorted((state.get("rules") or {}).items())
+    )
+    age = time.time() - float(state.get("ts", 0.0))
+    stale_after = knob_float("POLYAXON_TPU_LINT_STALE_S")
+    unsuppressed = int(state.get("unsuppressed", 0))
+    suppressed = int(state.get("suppressed", 0))
+    if unsuppressed:
+        by_rule = state.get("by_rule") or {}
+        worst = ", ".join(f"{k}={v}" for k, v in sorted(by_rule.items()))
+        return False, (
+            f"last run recorded {unsuppressed} unsuppressed finding(s) "
+            f"({worst}) {age:.0f}s ago [{rules}]"
+        )
+    freshness = (
+        f"stale ({age / 86400.0:.1f}d old)" if age > stale_after
+        else f"{age:.0f}s old"
+    )
+    return True, (
+        f"clean, {suppressed} suppressed finding(s), {freshness} [{rules}]"
+    )
+
+
 def check_devices(orch) -> Tuple[bool, str]:
     """Accelerator visibility — only meaningful in-process on a worker/bench
     host; the control plane itself may legitimately be CPU-only."""
@@ -161,6 +199,7 @@ CHECKS: Dict[str, Callable] = {
     "compile_cache": check_compile_cache,
     "alerts": check_alerts,
     "remediation": check_remediation,
+    "static_analysis": check_static_analysis,
 }
 
 

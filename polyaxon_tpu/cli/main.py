@@ -12,14 +12,15 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import sys
 import time
 import urllib.request
 from pathlib import Path
 from typing import Any, Dict, Optional
 
-DEFAULT_BASE = os.environ.get("POLYAXON_TPU_HOME", "~/.polyaxon_tpu")
+from polyaxon_tpu.conf.knobs import knob_str
+
+DEFAULT_BASE = knob_str("POLYAXON_TPU_HOME")
 AUTH_FILE = Path(DEFAULT_BASE).expanduser() / "auth.json"
 
 
@@ -28,6 +29,12 @@ def _stored_auth() -> dict:
         return json.loads(AUTH_FILE.read_text())
     except (OSError, ValueError):
         return {}
+
+
+#: A down/hung control plane must error the CLI, not freeze the terminal.
+#: Generous enough for slow artifact streams; connect failures surface in
+#: seconds regardless.
+_REQUEST_TIMEOUT_S = 60.0
 
 
 class RemoteClient:
@@ -41,7 +48,7 @@ class RemoteClient:
         stored = _stored_auth()
         self.token = (
             token
-            or os.environ.get("POLYAXON_TPU_AUTH_TOKEN")
+            or knob_str("POLYAXON_TPU_AUTH_TOKEN")
             or (stored.get("token") if stored.get("host") in (host, self.base) else None)
         )
 
@@ -55,7 +62,7 @@ class RemoteClient:
             data=json.dumps(body).encode() if body is not None else None,
             headers=headers,
         )
-        with urllib.request.urlopen(req) as resp:
+        with urllib.request.urlopen(req, timeout=_REQUEST_TIMEOUT_S) as resp:
             return json.loads(resp.read() or "{}")
 
     def submit(self, spec, project, name, tags):
@@ -207,7 +214,7 @@ class RemoteClient:
             f"{self.base}/api/v1/runs/{run_id}/artifacts/{quote(key)}",
             headers=headers,
         )
-        return urllib.request.urlopen(req)
+        return urllib.request.urlopen(req, timeout=_REQUEST_TIMEOUT_S)
 
 
 class LocalClient:

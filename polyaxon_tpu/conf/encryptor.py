@@ -23,6 +23,7 @@ import os
 from pathlib import Path
 from typing import Optional, Union
 
+from polyaxon_tpu.conf.knobs import knob_str
 from polyaxon_tpu.exceptions import PolyaxonTPUError
 
 _PREFIX = "enc:v1:"
@@ -46,7 +47,7 @@ class Encryptor:
     @classmethod
     def from_base_dir(cls, base_dir: Union[str, Path]) -> "Encryptor":
         """Env key wins; otherwise a per-deployment keyfile (created 0600)."""
-        env = os.environ.get(_KEY_ENV)
+        env = knob_str(_KEY_ENV)
         if env:
             return cls(env.encode())
         from cryptography.fernet import Fernet

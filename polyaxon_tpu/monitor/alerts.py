@@ -31,11 +31,11 @@ must not take the monitor loop down with it.
 from __future__ import annotations
 
 import logging
-import os
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
+from polyaxon_tpu.conf.knobs import family_float, family_value, knob_float
 from polyaxon_tpu.db.registry import (
     AlertSeverity,
     AlertState,
@@ -55,13 +55,6 @@ __all__ = [
     "default_rules",
     "alert_gauge_key",
 ]
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
 
 
 def alert_gauge_key(rule: str, run_id: int, severity: str) -> str:
@@ -181,14 +174,14 @@ class RuleContext:
                 return float(val)
             except (TypeError, ValueError):
                 pass
-        return _env_float(
-            f"POLYAXON_TPU_ALERT_{rule.upper()}_{name.upper()}", default
+        return family_float(
+            "POLYAXON_TPU_ALERT_", f"{rule.upper()}_{name.upper()}", default
         )
 
     def enabled(self, rule: str) -> bool:
         val = self.overrides.get(f"{rule}.enabled")
         if val is None:
-            val = os.environ.get(f"POLYAXON_TPU_ALERT_{rule.upper()}_ENABLED")
+            val = family_value("POLYAXON_TPU_ALERT_", f"{rule.upper()}_ENABLED")
         if val is None:
             return True
         return str(val).lower() not in ("0", "false", "no", "off")
@@ -436,7 +429,7 @@ class AlertEngine:
         self.interval_s = (
             interval_s
             if interval_s is not None
-            else _env_float("POLYAXON_TPU_ALERT_INTERVAL_S", 1.0)
+            else knob_float("POLYAXON_TPU_ALERT_INTERVAL_S")
         )
         self.last_tick_at: float = 0.0
         self.ticks: int = 0

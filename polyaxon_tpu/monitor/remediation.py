@@ -33,11 +33,11 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import os
 import signal as _signal
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from polyaxon_tpu.conf.knobs import knob_bool, knob_float, knob_int, knob_str
 from polyaxon_tpu.db.registry import (
     CommandStatus,
     RemediationStatus,
@@ -130,25 +130,26 @@ class RemediationEngine:
         auditor: Any = None,
         sender: Optional[Callable[..., Dict[str, Any]]] = None,
     ) -> None:
-        def _env(name: str, default: str) -> str:
-            return os.environ.get(f"POLYAXON_TPU_REMEDIATION_{name}", default)
-
         self.registry = registry
         self.stats = stats if stats is not None else get_stats()
         self.auditor = auditor
         self.sender = sender
-        self.enabled = _env("ENABLED", "1") not in ("0", "false", "no")
-        self.budget = int(_env("BUDGET", "16"))
-        base = _env("BACKOFF_BASE_S", "")
+        self.enabled = knob_bool("POLYAXON_TPU_REMEDIATION_ENABLED")
+        self.budget = knob_int("POLYAXON_TPU_REMEDIATION_BUDGET")
+        base = knob_str("POLYAXON_TPU_REMEDIATION_BACKOFF_BASE_S")
         self.backoff_base_s: Optional[float] = float(base) if base else None
-        self.backoff_max_s = float(_env("BACKOFF_MAX_S", "300"))
+        self.backoff_max_s = knob_float("POLYAXON_TPU_REMEDIATION_BACKOFF_MAX_S")
         self.checkpoint_rules = {
             r.strip()
-            for r in _env("CHECKPOINT_ALERTS", "run_stalled").split(",")
+            for r in knob_str(
+                "POLYAXON_TPU_REMEDIATION_CHECKPOINT_ALERTS"
+            ).split(",")
             if r.strip()
         }
-        self.evict_enabled = _env("EVICT", "0") not in ("0", "false", "no", "")
-        self.command_timeout_s = float(_env("COMMAND_TIMEOUT_S", "30"))
+        self.evict_enabled = knob_bool("POLYAXON_TPU_REMEDIATION_EVICT")
+        self.command_timeout_s = knob_float(
+            "POLYAXON_TPU_REMEDIATION_COMMAND_TIMEOUT_S"
+        )
         self.actions = 0
         self.errors = 0
         self.last_action_at: Optional[float] = None

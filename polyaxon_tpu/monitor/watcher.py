@@ -14,11 +14,11 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import statistics
 import time
 from typing import Any, Dict, List, Optional
 
+from polyaxon_tpu.conf.knobs import knob_default, knob_float, knob_int
 from polyaxon_tpu.db.registry import RunRegistry
 from polyaxon_tpu.lifecycles import StatusOptions as S
 from polyaxon_tpu.lifecycles.registry import gang_status
@@ -29,14 +29,7 @@ logger = logging.getLogger(__name__)
 
 #: Per-poll read budget per process file — bounds the watcher's memory when
 #: it falls behind a chatty gang (the tail used to be slurped whole).
-DEFAULT_POLL_BYTES = 4 * 1024 * 1024
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
+DEFAULT_POLL_BYTES = knob_default("POLYAXON_TPU_WATCHER_POLL_BYTES")
 
 
 def anomaly_status(
@@ -63,11 +56,11 @@ def anomaly_status(
     """
     now = now if now is not None else time.time()
     if stall_after_s is None:
-        stall_after_s = _env_float("POLYAXON_TPU_STALL_AFTER_S", 60.0)
+        stall_after_s = knob_float("POLYAXON_TPU_STALL_AFTER_S")
     if straggler_lag_steps is None:
-        straggler_lag_steps = _env_float("POLYAXON_TPU_STRAGGLER_LAG_STEPS", 50.0)
+        straggler_lag_steps = knob_float("POLYAXON_TPU_STRAGGLER_LAG_STEPS")
     if heartbeat_fresh_s is None:
-        heartbeat_fresh_s = _env_float("POLYAXON_TPU_STALL_HEARTBEAT_FRESH_S", 30.0)
+        heartbeat_fresh_s = knob_float("POLYAXON_TPU_STALL_HEARTBEAT_FRESH_S")
     out: Dict[str, Any] = {
         "stalled": False,
         "stall_age_s": 0.0,
@@ -238,24 +231,22 @@ class GangWatcher:
         self.max_poll_bytes = (
             max_poll_bytes
             if max_poll_bytes is not None
-            else int(
-                os.environ.get("POLYAXON_TPU_WATCHER_POLL_BYTES", DEFAULT_POLL_BYTES)
-            )
+            else knob_int("POLYAXON_TPU_WATCHER_POLL_BYTES")
         )
         self.stall_after_s = (
             stall_after_s
             if stall_after_s is not None
-            else _env_float("POLYAXON_TPU_STALL_AFTER_S", 60.0)
+            else knob_float("POLYAXON_TPU_STALL_AFTER_S")
         )
         self.straggler_lag_steps = (
             straggler_lag_steps
             if straggler_lag_steps is not None
-            else _env_float("POLYAXON_TPU_STRAGGLER_LAG_STEPS", 50.0)
+            else knob_float("POLYAXON_TPU_STRAGGLER_LAG_STEPS")
         )
         self.heartbeat_fresh_s = (
             heartbeat_fresh_s
             if heartbeat_fresh_s is not None
-            else _env_float("POLYAXON_TPU_STALL_HEARTBEAT_FRESH_S", 30.0)
+            else knob_float("POLYAXON_TPU_STALL_HEARTBEAT_FRESH_S")
         )
 
     # -- report ingestion -----------------------------------------------------

@@ -119,16 +119,16 @@ class Orchestrator:
                 cluster_id=cluster_id,
             )
         )
-        import os as _os
+        from polyaxon_tpu.conf.knobs import knob_str
 
         # Opt-in done/failed notifications (reference notifier/actions +
         # actions/registry/webhooks). Conf-driven; the legacy env vars keep
         # working through the option store's env resolution order.
-        webhook = conf.get("notifier.webhook_url") or _os.environ.get(
+        webhook = conf.get("notifier.webhook_url") or knob_str(
             "POLYAXON_TPU_WEBHOOK_URL"
         )
-        kind = conf.get("notifier.webhook_kind") or _os.environ.get(
-            "POLYAXON_TPU_WEBHOOK_KIND", ""
+        kind = conf.get("notifier.webhook_kind") or knob_str(
+            "POLYAXON_TPU_WEBHOOK_KIND"
         )
         actions = []
         if webhook:

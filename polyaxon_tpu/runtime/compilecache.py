@@ -39,6 +39,8 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Tuple
 
+from polyaxon_tpu.conf.knobs import knob_bool, knob_float, knob_str
+
 __all__ = [
     "CacheStatus",
     "enable_compile_cache",
@@ -46,6 +48,7 @@ __all__ = [
     "aot_compile",
 ]
 
+# Knob names as module constants (tests and callers reference these).
 ENV_ENABLE = "POLYAXON_TPU_COMPILE_CACHE"
 ENV_DIR = "POLYAXON_TPU_COMPILE_CACHE_DIR"
 ENV_MIN_COMPILE_S = "POLYAXON_TPU_COMPILE_CACHE_MIN_COMPILE_S"
@@ -65,10 +68,6 @@ _lock = threading.Lock()
 _status: Optional[CacheStatus] = None
 
 
-def _truthy(value: str) -> bool:
-    return value.strip().lower() not in ("0", "false", "off", "no", "")
-
-
 def enable_compile_cache(
     cache_dir: Optional[str] = None,
     *,
@@ -84,12 +83,12 @@ def enable_compile_cache(
     """
     global _status
     with _lock:
-        if not _truthy(os.environ.get(ENV_ENABLE, "1")):
+        if not knob_bool(ENV_ENABLE):
             _status = CacheStatus(
                 False, None, f"disabled by {ENV_ENABLE}"
             )
             return _status
-        resolved = os.environ.get(ENV_DIR) or cache_dir
+        resolved = knob_str(ENV_DIR) or cache_dir
         if not resolved:
             _status = CacheStatus(
                 False,
@@ -105,10 +104,7 @@ def enable_compile_cache(
         ):
             return _status
         if min_compile_s is None:
-            try:
-                min_compile_s = float(os.environ.get(ENV_MIN_COMPILE_S, "0"))
-            except ValueError:
-                min_compile_s = 0.0
+            min_compile_s = knob_float(ENV_MIN_COMPILE_S)
         try:
             os.makedirs(resolved, exist_ok=True)
             if not os.access(resolved, os.W_OK):

@@ -22,6 +22,9 @@ import subprocess
 import sys
 from pathlib import Path
 
+# stdlib-only import — safe before the deferred jax imports below
+from polyaxon_tpu.conf.knobs import knob_float, knob_str
+
 
 def _configure_jax_env(info) -> None:
     """Force the jax platform to match the plan's accelerator.
@@ -150,7 +153,7 @@ def main() -> int:
     # which would initialize the backend and race jax.distributed below.
     sampler = ResourceSampler(
         reporter,
-        interval=float(os.environ.get("POLYAXON_TPU_RESOURCE_INTERVAL", "10")),
+        interval=knob_float("POLYAXON_TPU_RESOURCE_INTERVAL"),
     )
 
     try:
@@ -170,7 +173,7 @@ def main() -> int:
         from polyaxon_tpu.schemas.specifications import specification_for_kind
 
         spec = specification_for_kind(spec_data["kind"]).model_validate(spec_data)
-        service_port = os.environ.get("POLYAXON_TPU_SERVICE_PORT")
+        service_port = knob_str("POLYAXON_TPU_SERVICE_PORT") or None
         if service_port is not None:
             # The dispatch-time port allocation reaches the workload both as
             # a template variable ({{service_port}} in cmd/kwargs) and as a

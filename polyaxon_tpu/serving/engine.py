@@ -47,7 +47,6 @@ step — the block pool lives on the gang mesh.
 from __future__ import annotations
 
 import itertools
-import os
 import queue
 import threading
 import time
@@ -56,6 +55,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from polyaxon_tpu.conf.knobs import knob_bool
 from polyaxon_tpu.serving.paging import BlockAllocator, PrefixCache
 from polyaxon_tpu.stats import MemoryStats
 from polyaxon_tpu.tracking.flightrec import get_progress
@@ -337,9 +337,7 @@ class ServingEngine:
         # steady-state recompiles" invariant, monitored in production
         # rather than only asserted in tests.
         if warmup is None:
-            warmup = os.environ.get(
-                "POLYAXON_TPU_SERVING_WARMUP", "1"
-            ).strip().lower() not in ("0", "false", "off", "no", "")
+            warmup = knob_bool("POLYAXON_TPU_SERVING_WARMUP")
         self._warmup = bool(warmup)
         self._ready = threading.Event()
         self._warmup_total = 0

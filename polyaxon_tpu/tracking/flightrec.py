@@ -40,12 +40,7 @@ from collections import deque
 from pathlib import Path
 from typing import Any, Dict, Optional
 
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
+from polyaxon_tpu.conf.knobs import knob_float
 
 
 class Progress:
@@ -238,26 +233,26 @@ class FlightRecorder:
         self.reporter = reporter
         self.out_dir = Path(out_dir) if out_dir is not None else None
         self.process_id = process_id
-        self.k = k if k is not None else _env_float("POLYAXON_TPU_WATCHDOG_K", 8.0)
+        self.k = k if k is not None else knob_float("POLYAXON_TPU_WATCHDOG_K")
         self.floor_s = (
             floor_s
             if floor_s is not None
-            else _env_float("POLYAXON_TPU_WATCHDOG_FLOOR_S", 30.0)
+            else knob_float("POLYAXON_TPU_WATCHDOG_FLOOR_S")
         )
         self.ceiling_s = (
             ceiling_s
             if ceiling_s is not None
-            else _env_float("POLYAXON_TPU_WATCHDOG_CEILING_S", 600.0)
+            else knob_float("POLYAXON_TPU_WATCHDOG_CEILING_S")
         )
         self.interval_s = (
             interval_s
             if interval_s is not None
-            else _env_float("POLYAXON_TPU_WATCHDOG_INTERVAL_S", 1.0)
+            else knob_float("POLYAXON_TPU_WATCHDOG_INTERVAL_S")
         )
         self.progress_interval_s = (
             progress_interval_s
             if progress_interval_s is not None
-            else _env_float("POLYAXON_TPU_PROGRESS_INTERVAL_S", 2.0)
+            else knob_float("POLYAXON_TPU_PROGRESS_INTERVAL_S")
         )
         self._seq = 0
         self._fired = False

@@ -23,11 +23,12 @@ entrypoint calls :func:`configure` to wire the report sink.
 
 from __future__ import annotations
 
-import os
 import sys
 import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
+
+from polyaxon_tpu.conf.knobs import knob_float
 
 __all__ = [
     "UtilizationLedger",
@@ -235,12 +236,7 @@ class UtilizationLedger:
         self.sink = sink
         self.process_id = process_id
         if interval_s is None:
-            try:
-                interval_s = float(
-                    os.environ.get("POLYAXON_TPU_LEDGER_INTERVAL_S", "30")
-                )
-            except ValueError:
-                interval_s = 30.0
+            interval_s = knob_float("POLYAXON_TPU_LEDGER_INTERVAL_S")
         self.interval_s = interval_s
         self._lock = threading.Lock()
         self._reset_locked()

@@ -29,12 +29,13 @@ buffer-only (no sink) unless something attaches one.
 from __future__ import annotations
 
 import itertools
-import os
 import random
 import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from polyaxon_tpu.conf.knobs import knob_float
 
 __all__ = ["Tracer", "get_tracer", "configure", "chrome_trace"]
 
@@ -201,8 +202,8 @@ class Tracer:
 
 
 _tracer = Tracer(
-    sample=float(os.environ.get("POLYAXON_TPU_TRACE_SAMPLE", "1.0")),
-    hot_sample=float(os.environ.get("POLYAXON_TPU_TRACE_HOT_SAMPLE", "0.05")),
+    sample=knob_float("POLYAXON_TPU_TRACE_SAMPLE"),
+    hot_sample=knob_float("POLYAXON_TPU_TRACE_HOT_SAMPLE"),
 )
 
 

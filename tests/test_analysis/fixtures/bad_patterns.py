@@ -1,0 +1,103 @@
+"""Known-bad fixture: every rule must fire on its section.
+
+Not imported anywhere — parsed by tests/test_analysis/test_rules.py.
+The jax/np names intentionally don't resolve; graft-lint is lexical.
+"""
+
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+
+
+# -- GL001: host syncs inside traced functions -------------------------------
+
+def impure_step(state, batch):
+    print("stepping")  # I/O in a jitted fn
+    t0 = time.time()  # time.* in a jitted fn
+    loss = np.asarray(state)  # host sync
+    lr = float(batch)  # concretizes a traced arg
+    _ = state.item()  # device round-trip
+    return loss, lr, t0
+
+
+step = jax.jit(impure_step)
+
+
+@jax.jit
+def decorated_impure(x):
+    print(x)
+    return x
+
+
+# -- GL002: rebinding args without donation ----------------------------------
+
+def pool_step(pool, tokens):
+    return pool
+
+
+run_step = jax.jit(pool_step)
+
+
+def advance(pool, tokens):
+    pool = run_step(pool, tokens)  # rebind without donate_argnums
+    return pool
+
+
+@jax.jit
+def dec_step(params, opt_state):
+    return params, opt_state
+
+
+def train_loop(params, opt_state):
+    params, opt_state = dec_step(params, opt_state)  # undonated rebind
+    return params
+
+
+# -- GL003: registry write outside the lock ----------------------------------
+
+class BadRegistry:
+    def __init__(self, conn):
+        self._lock = threading.Lock()
+        self._db = conn
+
+    def good_write(self, run_id):
+        with self._lock:
+            self._db.execute("UPDATE runs SET x = 1 WHERE id = ?", (run_id,))
+
+    def bad_write(self, run_id):
+        self._db.execute("DELETE FROM runs WHERE id = ?", (run_id,))
+
+    def read_ok(self, run_id):
+        return self._db.execute(
+            "SELECT * FROM runs WHERE id = ?", (run_id,)
+        ).fetchone()
+
+
+# -- GL004: blocking calls in tick paths -------------------------------------
+
+class SleepyAgent:
+    def poll(self):
+        time.sleep(1.0)  # blocks the beat thread
+
+    def fetch(self):
+        urllib.request.urlopen("http://example.com/hook")  # no timeout
+
+
+def wire(reporter):
+    agent = SleepyAgent()
+    reporter.add_beat_hook(agent.poll)
+    reporter.add_beat_hook(agent.fetch)
+
+
+# -- GL005: phantom knob ------------------------------------------------------
+
+PHANTOM = "POLYAXON_TPU_DOES_NOT_EXIST"
+
+
+# -- GL006: network I/O without a timeout ------------------------------------
+
+def notify(url, payload):
+    return urllib.request.urlopen(url, data=payload)

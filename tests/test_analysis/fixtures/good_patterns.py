@@ -1,0 +1,88 @@
+"""Known-good fixture: the real hot-path shapes; zero findings expected.
+
+Mirrors the package's idioms: ``lax.scan`` over pool pytrees, donated
+jit rebinds, registry writes under the lock, non-blocking beat hooks,
+catalogued knobs, timeouts everywhere.
+"""
+
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# -- pure traced functions (decode.py shape) ---------------------------------
+
+def decode_loop(pool, tokens):
+    def body(carry, tok):
+        pool, step = carry
+        new = jnp.take(pool, tok, axis=0)
+        return (pool, step + 1), new
+
+    return lax.scan(body, (pool, 0), tokens)
+
+
+fn = jax.jit(decode_loop)
+
+
+# -- donated rebinds (train.py / engine.py shape) ----------------------------
+
+def train_step(params, opt_state, batch):
+    return params, opt_state
+
+
+step = jax.jit(train_step, donate_argnums=(0, 1))
+
+
+def loop(params, opt_state, batch):
+    params, opt_state = step(params, opt_state, batch)
+    return params, opt_state
+
+
+# -- registry writes under the lock (db/registry.py shape) -------------------
+
+class GoodRegistry:
+    def __init__(self, conn):
+        self._lock = threading.Lock()
+        self._db = conn
+
+    def write(self, run_id):
+        with self._lock, self._db as conn:
+            conn.execute("UPDATE runs SET x = 1 WHERE id = ?", (run_id,))
+
+    def _delete_tree_locked(self, run_id):
+        # *_locked convention: caller holds self._lock
+        self._db.execute("DELETE FROM runs WHERE id = ?", (run_id,))
+
+    def read(self, run_id):
+        return self._db.execute(
+            "SELECT * FROM runs WHERE id = ?", (run_id,)
+        ).fetchone()
+
+
+# -- non-blocking tick paths (capture.py shape) ------------------------------
+
+class QuietAgent:
+    def poll(self):
+        return list(self._pending())
+
+    def _pending(self):
+        return ()
+
+
+def wire(reporter):
+    agent = QuietAgent()
+    reporter.add_beat_hook(agent.poll)
+
+
+# -- catalogued knobs + bounded network I/O ----------------------------------
+
+KNOWN = "POLYAXON_TPU_WATCHDOG_K"
+FAMILY_MEMBER = "POLYAXON_TPU_ALERT_GOODPUT_LOW_FLOOR"
+WILDCARD_MENTION = "tune via POLYAXON_TPU_REMEDIATION_* knobs"
+
+
+def notify(url, payload):
+    return urllib.request.urlopen(url, data=payload, timeout=5.0)

@@ -1,0 +1,93 @@
+"""conf/knobs.py: typed accessors, loud failure on unknown names, family
+accessors, and the docs knob table staying in sync with the catalog."""
+
+from pathlib import Path
+
+import pytest
+
+from polyaxon_tpu.conf.knobs import (
+    FAMILIES,
+    KNOBS,
+    family_float,
+    family_prefix,
+    family_value,
+    knob_bool,
+    knob_default,
+    knob_float,
+    knob_int,
+    knob_str,
+    reference_table,
+)
+
+
+def test_unknown_knob_raises():
+    with pytest.raises(KeyError, match="GL005"):
+        knob_float("POLYAXON_TPU_WATCHDOG_KK")  # typo'd
+
+
+def test_prefix_family_rejected_by_scalar_accessors():
+    with pytest.raises(KeyError, match="family"):
+        knob_str("POLYAXON_TPU_ALERT_")
+
+
+def test_unknown_family_raises():
+    with pytest.raises(KeyError):
+        family_prefix("POLYAXON_TPU_NOPE_")
+
+
+def test_defaults_come_from_catalog(monkeypatch):
+    monkeypatch.delenv("POLYAXON_TPU_WATCHDOG_K", raising=False)
+    assert knob_float("POLYAXON_TPU_WATCHDOG_K") == 8.0
+    assert knob_default("POLYAXON_TPU_WATCHDOG_K") == 8.0
+
+
+def test_env_overrides_and_types(monkeypatch):
+    monkeypatch.setenv("POLYAXON_TPU_WATCHDOG_K", "3.5")
+    assert knob_float("POLYAXON_TPU_WATCHDOG_K") == 3.5
+    monkeypatch.setenv("POLYAXON_TPU_REMEDIATION_BUDGET", "4")
+    assert knob_int("POLYAXON_TPU_REMEDIATION_BUDGET") == 4
+    monkeypatch.setenv("POLYAXON_TPU_REMEDIATION_ENABLED", "false")
+    assert knob_bool("POLYAXON_TPU_REMEDIATION_ENABLED") is False
+    monkeypatch.setenv("POLYAXON_TPU_STRATEGY", "fsdp")
+    assert knob_str("POLYAXON_TPU_STRATEGY") == "fsdp"
+
+
+def test_bool_empty_string_is_falsy(monkeypatch):
+    # Historical semantics: POLYAXON_TPU_SERVING_WARMUP="" disables.
+    monkeypatch.setenv("POLYAXON_TPU_SERVING_WARMUP", "")
+    assert knob_bool("POLYAXON_TPU_SERVING_WARMUP") is False
+
+
+def test_malformed_numeric_falls_back(monkeypatch):
+    monkeypatch.setenv("POLYAXON_TPU_WATCHDOG_K", "not-a-number")
+    assert knob_float("POLYAXON_TPU_WATCHDOG_K") == 8.0
+
+
+def test_family_accessors(monkeypatch):
+    monkeypatch.setenv("POLYAXON_TPU_ALERT_MFU_LOW_FLOOR", "0.25")
+    assert family_value("POLYAXON_TPU_ALERT_", "MFU_LOW_FLOOR") == "0.25"
+    assert family_float("POLYAXON_TPU_ALERT_", "MFU_LOW_FLOOR", 0.1) == 0.25
+    monkeypatch.delenv("POLYAXON_TPU_ALERT_MFU_LOW_FLOOR")
+    assert family_float("POLYAXON_TPU_ALERT_", "MFU_LOW_FLOOR", 0.1) == 0.1
+
+
+def test_catalog_shape():
+    assert len(KNOBS) >= 40
+    for name, knob in KNOBS.items():
+        assert name.startswith("POLYAXON_TPU_")
+        assert knob.kind in ("bool", "int", "float", "str")
+        assert knob.doc
+    assert "POLYAXON_TPU_ALERT_" in FAMILIES
+    assert "POLYAXON_TPU_" in FAMILIES
+
+
+def test_docs_table_in_sync_with_catalog():
+    doc = (
+        Path(__file__).resolve().parents[2] / "docs" / "observability.md"
+    ).read_text(encoding="utf-8")
+    table = reference_table()
+    assert table in doc, (
+        "docs/observability.md knob table is out of date — regenerate "
+        "with: python -c \"from polyaxon_tpu.conf.knobs import "
+        "reference_table; print(reference_table())\""
+    )

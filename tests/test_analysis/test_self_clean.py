@@ -1,0 +1,31 @@
+"""Tier-1 gate: graft-lint over the whole package, zero unsuppressed
+findings — the invariants the rules encode hold everywhere, forever.
+A new violation fails THIS test at review time instead of a bench
+budget in production."""
+
+from polyaxon_tpu.analysis import default_rules, run_analysis
+
+
+def test_package_is_clean():
+    findings = run_analysis()
+    unsuppressed = [f for f in findings if not f.suppressed]
+    assert unsuppressed == [], "\n".join(
+        f"{f.location()}: {f.rule}: {f.message}" for f in unsuppressed
+    )
+
+
+def test_every_suppression_is_justified():
+    findings = run_analysis()
+    unjustified = [
+        f for f in findings if f.suppressed and not f.suppress_reason
+    ]
+    assert unjustified == [], "\n".join(
+        f"{f.location()}: {f.rule} suppressed without a `-- reason`"
+        for f in unjustified
+    )
+
+
+def test_all_rules_ran():
+    assert {r.id for r in default_rules()} >= {
+        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
+    }
