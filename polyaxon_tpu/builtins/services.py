@@ -162,17 +162,30 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
     import json as json_mod
     from http.server import BaseHTTPRequestHandler
 
+    from polyaxon_tpu.serving.engine import EngineDrainingError
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # route into run logs, not stderr
             log("lm_server: " + fmt % args)
 
-        def _json(self, code, payload):
+        def _json(self, code, payload, headers=None):
             body = json_mod.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
+
+        def _error(self, code, kind, message, headers=None):
+            # Machine-readable errors: routers and loadgen dispatch on
+            # error.kind (429 "shed" is load signal, 503 "draining" is
+            # lifecycle, connection drop is a fault) — string matching
+            # on messages is not an API.
+            return self._json(
+                code, {"error": {"kind": kind, "message": message}}, headers
+            )
 
         def do_GET(self):
             if self.path == "/v1/stats":
@@ -203,7 +216,7 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
                 self.end_headers()
                 return self.wfile.write(body)
             if self.path not in ("/healthz", "/"):
-                return self._json(404, {"error": "not found"})
+                return self._error(404, "not_found", "not found")
             stats = engine.stats()
             self._json(
                 200,
@@ -236,10 +249,10 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
                     req = json_mod.loads(self.rfile.read(n) or b"{}")
                     rid = int(req["request_id"])
                 except (KeyError, ValueError, TypeError) as e:
-                    return self._json(400, {"error": str(e)})
+                    return self._error(400, "bad_request", str(e))
                 return self._json(200, {"cancelled": engine.cancel(rid)})
             if self.path != "/generate":
-                return self._json(404, {"error": "not found"})
+                return self._error(404, "not_found", "not found")
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json_mod.loads(self.rfile.read(n) or b"{}")
@@ -257,8 +270,13 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
                 reqs = [
                     engine.submit(p, max_new, temperature) for p in prompts
                 ]
+            except EngineDrainingError as e:
+                retry_after = str(int(meta.get("retry_after_s", 1)))
+                return self._error(
+                    503, "draining", str(e), {"Retry-After": retry_after}
+                )
             except (KeyError, ValueError, TypeError) as e:
-                return self._json(400, {"error": str(e)})
+                return self._error(400, "bad_request", str(e))
             try:
                 timeout_s = float(meta.get("request_timeout_s", 600))
                 tokens = [r.wait(timeout=timeout_s) for r in reqs]
@@ -270,14 +288,33 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
                 for r in reqs:
                     if not r.done.is_set():
                         engine.cancel(r.id)
-                return self._json(503, {"error": str(e)})
+                kinds = {r.error_kind for r in reqs if r.error_kind}
+                if "shed" in kinds:
+                    # Deadlock-shed: the pool cannot fit this working
+                    # set RIGHT NOW.  429 + Retry-After tells the client
+                    # to back off, not to count a fault.
+                    retry_after = str(int(meta.get("retry_after_s", 1)))
+                    return self._error(
+                        429, "shed", str(e), {"Retry-After": retry_after}
+                    )
+                if isinstance(e, TimeoutError):
+                    return self._error(503, "timeout", str(e))
+                kind = next(iter(kinds)) if kinds else "engine_error"
+                return self._error(503, kind, str(e))
             dt = time.time() - t0
             total = sum(len(t) for t in tokens)
+            ttfts = [
+                round(r.first_token_at - t0, 6)
+                if r.first_token_at is not None
+                else None
+                for r in reqs
+            ]
             self._json(
                 200,
                 {
                     "tokens": tokens,
                     "decode_tokens_per_s": round(total / max(dt, 1e-9), 1),
+                    "ttft_s": ttfts,
                 },
             )
 
@@ -446,6 +483,24 @@ def lm_server(ctx: Context) -> None:
     ).start()
 
     from http.server import ThreadingHTTPServer
+
+    # Control-plane drain: the fleet layer (or an operator) sends a
+    # `drain` bus command before replacing this replica.  The handler
+    # only flips the engine's admission flag (no I/O, no sleeps) —
+    # new /generate calls get a typed 503 "draining" while in-flight
+    # requests run to completion.
+    from polyaxon_tpu.tracking.capture import get_capture_agent
+
+    capture = get_capture_agent()
+
+    def _on_drain(cmd):
+        engine.drain()
+        ctx.log_text("lm_server: drain command — no new admissions")
+        capture.command_event(
+            str(cmd.get("uuid") or ""), "complete", message="engine draining"
+        )
+
+    capture.register_handler("drain", _on_drain)
 
     meta = {
         "checkpoint_step": step,

@@ -140,6 +140,45 @@ def check_remediation(orch) -> Tuple[bool, str]:
     )
 
 
+def check_fleet(orch) -> Tuple[bool, str]:
+    """Serving-fleet posture: replica states, ejections, and shed rate
+    per registered fleet.  No fleets is fine (most control planes serve
+    nothing); a fleet whose every replica is unroutable is not — traffic
+    is being refused while the registry thinks the runs are healthy."""
+    fleets = getattr(orch, "fleets", None) or []
+    if not fleets:
+        return True, "no serving fleets registered"
+    parts = []
+    ok = True
+    for fleet in fleets:
+        try:
+            st = fleet.status()
+        except Exception as e:
+            ok = False
+            parts.append(f"{getattr(fleet, 'name', '?')}: status() failed: {e}")
+            continue
+        router = st.get("router") or {}
+        by_state = router.get("by_state") or {}
+        n_ready = int(router.get("n_ready") or 0)
+        total = sum(by_state.values())
+        counters = router.get("counters") or {}
+        if total and not n_ready:
+            ok = False
+        states = ", ".join(f"{k}={v}" for k, v in sorted(by_state.items()))
+        parts.append(
+            f"{st.get('name', '?')}: {n_ready}/{total} ready"
+            + (f" ({states})" if states else "")
+            + f", ejections {counters.get('ejections', 0)}"
+            + f", shed rate {router.get('shed_rate', 0.0):.2%}"
+            + (
+                f", {len(st.get('open_ops') or {})} drain/replace open"
+                if st.get("open_ops")
+                else ""
+            )
+        )
+    return ok, "; ".join(parts)
+
+
 def check_static_analysis(orch) -> Tuple[bool, str]:
     """graft-lint posture: what the last recorded run found, and whether
     it is stale.  Never-run and stale are diagnostic (ok=True) — a fresh
@@ -199,6 +238,7 @@ CHECKS: Dict[str, Callable] = {
     "compile_cache": check_compile_cache,
     "alerts": check_alerts,
     "remediation": check_remediation,
+    "fleet": check_fleet,
     "static_analysis": check_static_analysis,
 }
 

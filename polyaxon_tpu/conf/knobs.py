@@ -173,10 +173,51 @@ _ALL: List[Knob] = [
     Knob("POLYAXON_TPU_REMEDIATION_COMMAND_TIMEOUT_S", "float", 30.0,
          "how long an issued command may stay unresolved before the "
          "action fails", "remediation"),
+    Knob("POLYAXON_TPU_REMEDIATION_DRAIN_ALERTS", "str",
+         "serving_ttft_p99,heartbeat_stale",
+         "comma-separated alert rules whose firing edge triggers "
+         "drain+replace on a serving-fleet replica", "remediation"),
     # -- serving ------------------------------------------------------------
     Knob("POLYAXON_TPU_SERVING_WARMUP", "bool", True,
          "pre-compile the whole serving fn family behind the readiness "
          "gate before traffic", "serving"),
+    # -- fleet router (control-plane request routing) ----------------------
+    Knob("POLYAXON_TPU_ROUTER_PROBE_INTERVAL_S", "float", 1.0,
+         "health/stats probe cadence per replica (s)", "router"),
+    Knob("POLYAXON_TPU_ROUTER_PROBE_TIMEOUT_S", "float", 2.0,
+         "per-probe HTTP timeout (s)", "router"),
+    Knob("POLYAXON_TPU_ROUTER_REQUEST_TIMEOUT_S", "float", 600.0,
+         "proxied /generate timeout per attempt (s)", "router"),
+    Knob("POLYAXON_TPU_ROUTER_SHED_OCCUPANCY", "float", 0.95,
+         "fleet-mean occupancy ceiling; at/above it new requests are "
+         "shed with a typed 429 + Retry-After", "router"),
+    Knob("POLYAXON_TPU_ROUTER_RETRY_AFTER_S", "float", 1.0,
+         "Retry-After seconds advertised on shed (429) responses",
+         "router"),
+    Knob("POLYAXON_TPU_ROUTER_RETRY_LIMIT", "int", 2,
+         "max failover retries per request on connection error/replica "
+         "death (admission is idempotent before the first token)",
+         "router"),
+    Knob("POLYAXON_TPU_ROUTER_EJECT_FAILURES", "int", 2,
+         "consecutive probe/request failures before a replica is "
+         "ejected from the rotation", "router"),
+    Knob("POLYAXON_TPU_ROUTER_EJECT_BACKOFF_S", "float", 1.0,
+         "first re-admission probe delay after ejection (s); doubles "
+         "per consecutive failed re-admission", "router"),
+    Knob("POLYAXON_TPU_ROUTER_EJECT_BACKOFF_MAX_S", "float", 30.0,
+         "re-admission backoff cap (s)", "router"),
+    Knob("POLYAXON_TPU_ROUTER_AFFINITY_TOKENS", "int", 16,
+         "prompt-prefix length hashed for replica affinity (0 = no "
+         "affinity, pure least-loaded)", "router"),
+    # -- serving fleet (replica gang lifecycle) ----------------------------
+    Knob("POLYAXON_TPU_FLEET_REPLICAS", "int", 2,
+         "default replica count for a serving fleet", "fleet"),
+    Knob("POLYAXON_TPU_FLEET_DRAIN_DEADLINE_S", "float", 30.0,
+         "max time a draining replica may hold in-flight requests "
+         "before it is replaced anyway", "fleet"),
+    Knob("POLYAXON_TPU_FLEET_READY_TIMEOUT_S", "float", 120.0,
+         "how long a replacement replica may take to reach ready "
+         "before the drain/replace action fails", "fleet"),
     # -- worker / monitoring ------------------------------------------------
     Knob("POLYAXON_TPU_RESOURCE_INTERVAL", "float", 10.0,
          "host/device resource sampler cadence (s)", "worker"),

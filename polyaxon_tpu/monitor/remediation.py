@@ -150,9 +150,36 @@ class RemediationEngine:
         self.command_timeout_s = knob_float(
             "POLYAXON_TPU_REMEDIATION_COMMAND_TIMEOUT_S"
         )
+        self.drain_rules = {
+            r.strip()
+            for r in knob_str("POLYAXON_TPU_REMEDIATION_DRAIN_ALERTS").split(",")
+            if r.strip()
+        }
+        #: Serving fleets that asked for alert-driven drain/replace
+        #: (:meth:`register_fleet`); a firing drain rule on one of their
+        #: replica runs opens a drain_replace operation.
+        self._fleets: List[Any] = []
         self.actions = 0
         self.errors = 0
         self.last_action_at: Optional[float] = None
+
+    # -- serving fleets --------------------------------------------------------
+    def register_fleet(self, fleet: Any) -> None:
+        if fleet not in self._fleets:
+            self._fleets.append(fleet)
+
+    def unregister_fleet(self, fleet: Any) -> None:
+        if fleet in self._fleets:
+            self._fleets.remove(fleet)
+
+    def _fleet_for(self, run_id: int) -> Optional[Any]:
+        for fleet in self._fleets:
+            try:
+                if fleet.handles_run(run_id):
+                    return fleet
+            except Exception:
+                continue
+        return None
 
     # -- bookkeeping ----------------------------------------------------------
     def _count(self, action: str, outcome: str) -> None:
@@ -222,6 +249,8 @@ class RemediationEngine:
                     self._on_checkpoint_rule(handle, rule)
                 if rule == "gang_straggler" and self.evict_enabled:
                     self._on_straggler(handle, rule, row.get("attrs") or {})
+                if rule in self.drain_rules:
+                    self._on_drain_rule(handle, rule)
             except Exception:
                 self.errors += 1
                 logger.warning(
@@ -286,6 +315,48 @@ class RemediationEngine:
         if self._issue_checkpoint_now(handle, rem, rule) is not None:
             self._audit(run_id, "checkpoint_now", "issued", trigger=rule)
             self._count("checkpoint_now", "issued")
+
+    def _on_drain_rule(self, handle: Any, rule: str) -> None:
+        """A drain-class alert (stale heartbeat, TTFT SLO burn) fired on
+        a run that belongs to a registered serving fleet: open a
+        ``drain_replace`` operation and hand it to the fleet — the fleet's
+        ``poll()`` advances the phases and closes the row."""
+        run_id = handle.run_id
+        fleet = self._fleet_for(run_id)
+        if fleet is None:
+            return  # not a fleet replica — drain means nothing here
+        if self._open(run_id, "drain_replace") or self._budget_left(run_id) <= 0:
+            return
+        rem = self.registry.add_remediation(
+            run_id,
+            "drain_replace",
+            trigger=rule,
+            status=RemediationStatus.IN_PROGRESS,
+            attrs={"alert": rule, "phase": "draining"},
+        )
+        started = False
+        try:
+            started = bool(
+                fleet.request_drain_replace(run_id, rem["id"], rule)
+            )
+        except Exception as exc:
+            self.registry.update_remediation(
+                rem["id"],
+                status=RemediationStatus.FAILED,
+                message=f"fleet drain request failed: {exc}",
+            )
+            self._count("drain_replace", "failed")
+            return
+        if not started:
+            self.registry.update_remediation(
+                rem["id"],
+                status=RemediationStatus.SKIPPED,
+                message="fleet declined (unknown replica or already draining)",
+            )
+            self._count("drain_replace", "skipped")
+            return
+        self._audit(run_id, "drain_replace", "started", trigger=rule)
+        self._count("drain_replace", "started")
 
     def _on_straggler(self, handle: Any, rule: str, attrs: Dict[str, Any]) -> None:
         run_id = handle.run_id
@@ -576,5 +647,7 @@ class RemediationEngine:
             "errors": self.errors,
             "last_action_at": self.last_action_at,
             "checkpoint_rules": sorted(self.checkpoint_rules),
+            "drain_rules": sorted(self.drain_rules),
+            "fleets": len(self._fleets),
             "backoff_max_s": self.backoff_max_s,
         }

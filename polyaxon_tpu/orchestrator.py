@@ -214,6 +214,10 @@ class Orchestrator:
             auditor=self.auditor,
             sender=self.send_command,
         )
+        #: Serving fleets (serving/fleet.py:ServingFleet) registered on
+        #: this control plane — the check_fleet probe and the fleet API
+        #: read replica/router state from here.
+        self.fleets: List[Any] = []
         artifacts_url = conf.get("stores.artifacts_url")
         self.artifact_store = None
         if artifacts_url:

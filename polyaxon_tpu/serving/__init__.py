@@ -12,6 +12,7 @@ itself is front-end-agnostic.
 """
 
 from polyaxon_tpu.serving.engine import (
+    EngineDrainingError,
     GenerationRequest,
     ServingEngine,
     SlotAllocator,
@@ -20,6 +21,7 @@ from polyaxon_tpu.serving.paging import BlockAllocator, PrefixCache
 
 __all__ = [
     "BlockAllocator",
+    "EngineDrainingError",
     "GenerationRequest",
     "PrefixCache",
     "ServingEngine",

@@ -62,13 +62,19 @@ from polyaxon_tpu.tracking.flightrec import get_progress
 from polyaxon_tpu.tracking.trace import get_tracer
 
 
+class EngineDrainingError(RuntimeError):
+    """Raised by :meth:`ServingEngine.submit` once :meth:`drain` has been
+    called — the engine finishes in-flight work but admits nothing new."""
+
+
 class GenerationRequest:
     """One queued generation: its prompt, its budget, and its results.
 
     ``stream`` yields token ids as they are generated (a ``None``
     sentinel marks completion); ``done`` is set when the request has
-    finished (or failed — see ``error``).  ``tokens`` accumulates the
-    generated ids in order.
+    finished (or failed — see ``error``; ``error_kind`` is the
+    machine-readable class: ``shed`` / ``cancelled`` / ``stopped``).
+    ``tokens`` accumulates the generated ids in order.
     """
 
     _ids = itertools.count()
@@ -87,8 +93,10 @@ class GenerationRequest:
         self.stream: "queue.Queue[Optional[int]]" = queue.Queue()
         self.done = threading.Event()
         self.error: Optional[str] = None
+        self.error_kind: Optional[str] = None
         self.submitted_at = time.time()
         self.started_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
 
     def wait(self, timeout: Optional[float] = None) -> List[int]:
@@ -328,6 +336,7 @@ class ServingEngine:
         self._cancels: set = set()
         self._cv = threading.Condition()
         self._stop = threading.Event()
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
 
         # Warmup / readiness gate: the scheduler thread compiles the fn
@@ -662,8 +671,22 @@ class ServingEngine:
         for req in drain.values():
             if not req.done.is_set():
                 req.error = "engine stopped"
+                req.error_kind = "stopped"
                 req.stream.put(None)
                 req.done.set()
+
+    def drain(self) -> None:
+        """Stop admitting new requests; in-flight work runs to completion.
+
+        The readiness state flips to ``"draining"`` (so health probes and
+        routers stop sending traffic) and :meth:`submit` raises
+        :class:`EngineDrainingError`.  Non-blocking — callers poll
+        ``stats()`` for ``slots_active == 0 and queue_depth == 0`` to know
+        the drain has finished, then :meth:`stop`.
+        """
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
 
     def submit(
         self,
@@ -695,6 +718,10 @@ class ServingEngine:
         with self._cv:
             if self._stop.is_set():
                 raise RuntimeError("engine is stopped")
+            if self._draining:
+                raise EngineDrainingError(
+                    "engine is draining (no new admissions)"
+                )
             self._queue.append(req)
             self._n_submitted += 1
             self._cv.notify_all()
@@ -717,6 +744,7 @@ class ServingEngine:
                     with self._stats_lock:
                         self._n_cancelled += 1
                     req.error = "request cancelled"
+                    req.error_kind = "cancelled"
                     req.stream.put(None)
                     req.done.set()
                     return True
@@ -818,7 +846,11 @@ class ServingEngine:
             )
             tps = window_tokens / window_span if window_span > 0 else 0.0
             return {
-                "state": "ready" if self._ready.is_set() else "warming",
+                "state": (
+                    "draining"
+                    if self._draining
+                    else "ready" if self._ready.is_set() else "warming"
+                ),
                 "warmup": {
                     "done": self._warmup_done,
                     "total": self._warmup_total,
@@ -1098,13 +1130,17 @@ class ServingEngine:
         guaranteed to free some), else the head prefill job."""
         if self._parked:
             self._fail_slot(
-                self._parked[-1], "KV block pool exhausted (request shed)"
+                self._parked[-1],
+                "KV block pool exhausted (request shed)",
+                kind="shed",
             )
             return
         if self._prefill:
             job = self._prefill.popleft()
             self._fail_slot(
-                job.slot, "KV block pool exhausted (request shed)"
+                job.slot,
+                "KV block pool exhausted (request shed)",
+                kind="shed",
             )
 
     def _process_cancels(self) -> None:
@@ -1120,7 +1156,7 @@ class ServingEngine:
                     self._prefill.remove(job)
             for slot, req in enumerate(self._slot_req):
                 if req is not None and req.id == rid:
-                    self._fail_slot(slot, "request cancelled")
+                    self._fail_slot(slot, "request cancelled", kind="cancelled")
                     with self._stats_lock:
                         self._n_cancelled += 1
         self._record_gauges()
@@ -1212,6 +1248,8 @@ class ServingEngine:
 
     def _emit(self, slot: int, req: GenerationRequest, tok: int) -> None:
         """Record one generated token; retire the slot when done."""
+        if req.first_token_at is None:
+            req.first_token_at = time.time()
         req.tokens.append(tok)
         req.stream.put(tok)
         with self._stats_lock:
@@ -1247,7 +1285,7 @@ class ServingEngine:
         with self._cv:
             self._cv.notify_all()
 
-    def _fail_slot(self, slot: int, msg: str) -> None:
+    def _fail_slot(self, slot: int, msg: str, kind: Optional[str] = None) -> None:
         req = self._slot_req[slot]
         self._active[slot] = False
         if slot in self._parked:
@@ -1257,5 +1295,6 @@ class ServingEngine:
         self.allocator.free(slot)
         if req is not None and not req.done.is_set():
             req.error = msg
+            req.error_kind = kind
             req.stream.put(None)
             req.done.set()

@@ -74,7 +74,13 @@ def poisson_load(
             if ttft is None:
                 ttft = time.perf_counter() - t_submit
             n_tokens += 1
-        results[i] = (ttft, n_tokens, time.perf_counter() - t_submit, req.error)
+        results[i] = (
+            ttft,
+            n_tokens,
+            time.perf_counter() - t_submit,
+            req.error,
+            getattr(req, "error_kind", None),
+        )
 
     threads: List[threading.Thread] = []
     t_start = time.perf_counter()
@@ -97,10 +103,15 @@ def poisson_load(
     completed = sum(
         1 for r in done if r[3] is None and r[1] >= max_new_tokens
     )
-    errors = sum(1 for r in done if r[3] is not None)
+    # A shed (engine refusing work it cannot fit) is LOAD SIGNAL, not a
+    # fault: count it apart from errors so an A/B at fixed offered load
+    # can't trade sheds for "failures" and call it even.
+    sheds = sum(1 for r in done if r[4] == "shed")
+    errors = sum(1 for r in done if r[3] is not None and r[4] != "shed")
     return {
         "n_requests": len(prompts),
         "completed": completed,
+        "sheds": sheds,
         "errors": errors,
         "offered_rps": round(float(rate_rps), 4),
         "wall_s": round(wall, 3),
@@ -120,4 +131,182 @@ def poisson_load(
             (round(r[0], 6) if r is not None and r[0] is not None else None)
             for r in results
         ],
+    }
+
+
+def shared_prefix_prompts(
+    n: int,
+    vocab_size: int,
+    *,
+    prefix_len: int,
+    suffix_len: int,
+    groups: int = 4,
+    seed: int = 0,
+) -> List[List[int]]:
+    """``n`` prompts in ``groups`` families sharing a common prefix —
+    the traffic class prefix-affinity routing exists for.
+
+    Every prompt in a family starts with the family's ``prefix_len``
+    tokens (drawn once) followed by a private ``suffix_len`` suffix.
+    Fully determined by ``seed``, so a fleet A/B offers the identical
+    byte-for-byte prompt set to both arms.
+    """
+    if n <= 0 or groups <= 0:
+        raise ValueError(f"need n > 0 and groups > 0, got n={n} groups={groups}")
+    rng = np.random.default_rng(seed)
+    prefixes = [
+        rng.integers(0, vocab_size, size=prefix_len).tolist()
+        for _ in range(groups)
+    ]
+    prompts = []
+    for i in range(n):
+        suffix = rng.integers(0, vocab_size, size=suffix_len).tolist()
+        prompts.append(prefixes[i % groups] + suffix)
+    return prompts
+
+
+def http_poisson_load(
+    base_url: str,
+    prompts: Sequence[Sequence[int]],
+    max_new_tokens: int,
+    *,
+    rate_rps: float,
+    temperature: float = 0.0,
+    seed: int = 0,
+    timeout_s: float = 600.0,
+    kill_at_s: Optional[Dict[str, float]] = None,
+    stall_at_s: Optional[Dict[str, float]] = None,
+    fleet: Any = None,
+) -> Dict[str, Any]:
+    """Poisson load over HTTP against a router or a single ``lm_server``.
+
+    The fleet analogue of :func:`poisson_load`, plus a seeded FAULT
+    SCHEDULE: ``kill_at_s`` / ``stall_at_s`` map replica name → seconds
+    after load start at which ``fleet.kill_replica`` /
+    ``fleet.stall_replica`` fires — so "one replica dies mid-load" is a
+    reproducible bench arm, not a flaky race.
+
+    Per-request outcomes are typed, mirroring the router's error model:
+
+    - ``completed`` — HTTP 200, all tokens;
+    - ``shed`` — typed 429 (engine pool or router occupancy ceiling);
+    - ``error:<kind>`` — any other typed HTTP error (exactly one per
+      request — the zero-silent-drops contract);
+    - ``failure`` — connection-level failure reaching the endpoint;
+    - ``hang`` — no outcome within ``timeout_s`` (must be ZERO — a hang
+      means a request was silently dropped).
+    """
+    import json as json_mod
+    import urllib.error
+    import urllib.request
+
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be positive, got {rate_rps}")
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=len(prompts))
+    base = base_url.rstrip("/")
+
+    outcomes: List[Optional[str]] = [None] * len(prompts)
+    ttfts_by_idx: List[Optional[float]] = [None] * len(prompts)
+    latencies: List[Optional[float]] = [None] * len(prompts)
+    tokens_out = [0] * len(prompts)
+
+    def fire(i: int, prompt: Sequence[int], t_submit: float) -> None:
+        payload = json_mod.dumps(
+            {
+                "prompts": [list(prompt)],
+                "max_new_tokens": max_new_tokens,
+                "temperature": temperature,
+            }
+        ).encode()
+        req = urllib.request.Request(
+            base + "/generate",
+            data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                body = json_mod.loads(resp.read() or b"{}")
+            tokens_out[i] = sum(len(t) for t in body.get("tokens") or [])
+            server_ttfts = [
+                t for t in (body.get("ttft_s") or []) if t is not None
+            ]
+            # Client-observed TTFT = queueing delay to the server plus
+            # the server-side first-token latency it reports.
+            ttfts_by_idx[i] = (
+                min(server_ttfts) if server_ttfts
+                else time.perf_counter() - t_submit
+            )
+            outcomes[i] = "completed"
+        except urllib.error.HTTPError as e:
+            try:
+                err = (json_mod.loads(e.read() or b"{}").get("error")) or {}
+            except ValueError:
+                err = {}
+            kind = str(err.get("kind") or f"http_{e.code}")
+            outcomes[i] = "shed" if e.code == 429 else f"error:{kind}"
+        except Exception as e:
+            outcomes[i] = f"failure:{type(e).__name__}"
+        latencies[i] = time.perf_counter() - t_submit
+
+    # Fault schedule: one timer thread per event, armed relative to load
+    # start so the schedule is part of the (seeded) experiment.
+    timers: List[threading.Timer] = []
+    for name, at_s in (kill_at_s or {}).items():
+        timers.append(
+            threading.Timer(float(at_s), fleet.kill_replica, args=(name,))
+        )
+    for name, at_s in (stall_at_s or {}).items():
+        timers.append(
+            threading.Timer(float(at_s), fleet.stall_replica, args=(name,))
+        )
+
+    threads: List[threading.Thread] = []
+    t_start = time.perf_counter()
+    for t in timers:
+        t.daemon = True
+        t.start()
+    try:
+        for i, prompt in enumerate(prompts):
+            time.sleep(float(gaps[i]))
+            th = threading.Thread(
+                target=fire,
+                args=(i, prompt, time.perf_counter()),
+                daemon=True,
+            )
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=timeout_s)
+    finally:
+        for t in timers:
+            t.cancel()
+    wall = time.perf_counter() - t_start
+
+    hangs = sum(1 for th in threads if th.is_alive())
+    completed = sum(1 for o in outcomes if o == "completed")
+    sheds = sum(1 for o in outcomes if o == "shed")
+    errors = sum(1 for o in outcomes if o and o.startswith("error:"))
+    failures = sum(1 for o in outcomes if o and o.startswith("failure:"))
+    total_tokens = sum(tokens_out)
+    ttfts = sorted(t for t in ttfts_by_idx if t is not None)
+    return {
+        "n_requests": len(prompts),
+        "completed": completed,
+        "sheds": sheds,
+        "errors": errors,
+        "failures": failures,
+        "hangs": hangs,
+        "offered_rps": round(float(rate_rps), 4),
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(total_tokens / wall, 1) if wall > 0 else 0.0,
+        "total_tokens": total_tokens,
+        "ttft_mean_s": round(float(np.mean(ttfts)), 6) if ttfts else 0.0,
+        "ttft_p50_s": round(_pct(ttfts, 50), 6),
+        "ttft_p95_s": round(_pct(ttfts, 95), 6),
+        "ttft_p99_s": round(_pct(ttfts, 99), 6),
+        "ttft_s": [
+            round(t, 6) if t is not None else None for t in ttfts_by_idx
+        ],
+        "outcomes": list(outcomes),
     }

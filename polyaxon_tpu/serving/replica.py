@@ -1,0 +1,91 @@
+"""Standalone ``lm_server`` replica: the fleet's subprocess entrypoint.
+
+``python -m polyaxon_tpu.serving.replica <spec.json>`` boots one
+engine + the production HTTP handler (``_make_lm_handler``) with no
+platform Context — the process-level unit
+:class:`~polyaxon_tpu.serving.fleet.LocalServingFleet` provisions via
+``spawner.transport.LocalExecTransport`` so fault injection (SIGKILL /
+SIGSTOP) hits a real OS process, not a thread.
+
+The spec is plain JSON::
+
+    {
+      "host": "127.0.0.1", "port": 8301, "seed": 0,
+      "model": {"vocab_size": 64, "d_model": 32, ...},  # TransformerConfig ints
+      "seq": 48, "slots": 4, "block_size": 16,
+      "kv_blocks": null, "prefill_chunk": 0,
+      "max_new_tokens": 64, "request_timeout_s": 600.0,
+      "retry_after_s": 1.0
+    }
+
+Random-init weights only (the fleet bench/test double); checkpointed
+fleets go through the control-plane path (``orchestrator`` +
+``builtins.services.lm_server``), which this entry deliberately does
+not duplicate.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def serve(spec: dict) -> None:
+    # Heavy imports stay inside serve() so `--help`-style failures and
+    # spec parse errors don't pay for jax.
+    import jax
+
+    from polyaxon_tpu.builtins.services import _make_lm_handler
+    from polyaxon_tpu.models import TransformerConfig, init_params
+    from polyaxon_tpu.serving import ServingEngine
+
+    model = {k: int(v) for k, v in (spec.get("model") or {}).items()}
+    seq = int(spec.get("seq", 128))
+    cfg = TransformerConfig(max_seq=seq, **model)
+    params = init_params(jax.random.PRNGKey(int(spec.get("seed", 0))), cfg)
+
+    kv_blocks = spec.get("kv_blocks")
+    prefill_chunk = int(spec.get("prefill_chunk", 0) or 0)
+    engine = ServingEngine(
+        params,
+        cfg,
+        slots=int(spec.get("slots", 4)),
+        max_len=seq,
+        block_size=int(spec.get("block_size", 16)),
+        num_blocks=int(kv_blocks) if kv_blocks is not None else None,
+        prefill_chunk=prefill_chunk if prefill_chunk > 0 else None,
+        seed=int(spec.get("seed", 0)),
+    ).start()
+
+    meta = {
+        "checkpoint_step": None,
+        "target": None,
+        "default_max_new": int(spec.get("max_new_tokens", 64)),
+        "request_timeout_s": float(spec.get("request_timeout_s", 600.0)),
+        "retry_after_s": float(spec.get("retry_after_s", 1.0)),
+    }
+    from http.server import ThreadingHTTPServer
+
+    handler = _make_lm_handler(engine, cfg, meta)
+    host = str(spec.get("host", "127.0.0.1"))
+    port = int(spec["port"])
+    server = ThreadingHTTPServer((host, port), handler)
+    print(f"replica: serving on {host}:{port}", flush=True)
+    try:
+        server.serve_forever()
+    finally:
+        engine.stop()
+
+
+def main(argv) -> int:
+    if len(argv) != 1:
+        print("usage: python -m polyaxon_tpu.serving.replica <spec.json>")
+        return 2
+    with open(argv[0]) as f:
+        spec = json.load(f)
+    serve(spec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

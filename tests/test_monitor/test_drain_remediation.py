@@ -1,0 +1,163 @@
+"""Alert-driven drain/replace dispatch: RemediationEngine ↔ fleet seam.
+
+Unit tests for the `drain_replace` action against a real registry and a
+fake fleet — firing edges on registered fleet replicas open exactly one
+IN_PROGRESS row and hand it to the fleet; everything else (unregistered
+runs, duplicates, exhausted budget, fleet refusal/crash) is gated or
+typed, never raised into the scheduler tick.
+"""
+
+import pytest
+
+from polyaxon_tpu.compiler.service import GangPlan
+from polyaxon_tpu.db.registry import RemediationStatus, RunRegistry
+from polyaxon_tpu.monitor.remediation import RemediationEngine
+
+SPEC = {
+    "kind": "service",
+    "declarations": {},
+    "environment": {"topology": {"accelerator": "cpu-1", "num_devices": 1}},
+}
+
+
+class FakeStats:
+    def __init__(self):
+        self.counters = {}
+
+    def incr(self, key, value=1):
+        self.counters[key] = self.counters.get(key, 0) + value
+
+
+class FakeHandle:
+    def __init__(self, run_id):
+        self.run_id = run_id
+        self.plan = GangPlan(
+            num_hosts=1,
+            devices_per_host=1,
+            mesh_axes={"data": 1},
+            strategy="data_parallel",
+            max_restarts=0,
+            backoff_seconds=0.1,
+        )
+
+
+class FakeFleet:
+    def __init__(self, run_ids, accept=True):
+        self._run_ids = set(run_ids)
+        self.accept = accept
+        self.requests = []
+
+    def handles_run(self, run_id):
+        return run_id in self._run_ids
+
+    def request_drain_replace(self, run_id, rem_id, rule):
+        self.requests.append((run_id, rem_id, rule))
+        if isinstance(self.accept, Exception):
+            raise self.accept
+        return self.accept
+
+
+@pytest.fixture()
+def reg(tmp_path):
+    r = RunRegistry(tmp_path / "reg.db")
+    yield r
+    r.close()
+
+
+def firing(rule):
+    return [{"state": "firing", "rule": rule, "run_id": 1}]
+
+
+def make_engine(reg, monkeypatch, **env):
+    for key, value in env.items():
+        monkeypatch.setenv(f"POLYAXON_TPU_REMEDIATION_{key}", value)
+    stats = FakeStats()
+    return RemediationEngine(reg, stats=stats), stats
+
+
+class TestDrainDispatch:
+    def test_firing_drain_rule_opens_row_and_calls_fleet(
+        self, reg, monkeypatch
+    ):
+        run = reg.create_run(SPEC, name="replica")
+        eng, stats = make_engine(reg, monkeypatch)
+        assert "heartbeat_stale" in eng.drain_rules  # knob default
+        fleet = FakeFleet({run.id})
+        eng.register_fleet(fleet)
+        eng.on_transitions(FakeHandle(run.id), firing("heartbeat_stale"))
+        rows = reg.get_remediations(run.id, action="drain_replace")
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["status"] == RemediationStatus.IN_PROGRESS
+        assert row["trigger"] == "heartbeat_stale"
+        assert row["attrs"]["phase"] == "draining"
+        assert fleet.requests == [(run.id, row["id"], "heartbeat_stale")]
+        assert any(
+            "drain_replace" in k and 'outcome="started"' in k
+            for k in stats.counters
+        )
+
+    def test_serving_ttft_rule_also_dispatches(self, reg, monkeypatch):
+        run = reg.create_run(SPEC, name="replica")
+        eng, _ = make_engine(reg, monkeypatch)
+        fleet = FakeFleet({run.id})
+        eng.register_fleet(fleet)
+        eng.on_transitions(FakeHandle(run.id), firing("serving_ttft_p99"))
+        assert len(fleet.requests) == 1
+
+    def test_non_fleet_run_is_ignored(self, reg, monkeypatch):
+        run = reg.create_run(SPEC, name="not-a-replica")
+        eng, _ = make_engine(reg, monkeypatch)
+        eng.register_fleet(FakeFleet(set()))  # fleet owns other runs
+        eng.on_transitions(FakeHandle(run.id), firing("heartbeat_stale"))
+        assert reg.get_remediations(run.id, action="drain_replace") == []
+
+    def test_open_row_dedups_second_edge(self, reg, monkeypatch):
+        run = reg.create_run(SPEC, name="replica")
+        eng, _ = make_engine(reg, monkeypatch)
+        fleet = FakeFleet({run.id})
+        eng.register_fleet(fleet)
+        eng.on_transitions(FakeHandle(run.id), firing("heartbeat_stale"))
+        eng.on_transitions(FakeHandle(run.id), firing("heartbeat_stale"))
+        assert len(reg.get_remediations(run.id, action="drain_replace")) == 1
+        assert len(fleet.requests) == 1
+
+    def test_budget_exhaustion_gates(self, reg, monkeypatch):
+        run = reg.create_run(SPEC, name="replica")
+        eng, _ = make_engine(reg, monkeypatch, BUDGET="0")
+        fleet = FakeFleet({run.id})
+        eng.register_fleet(fleet)
+        eng.on_transitions(FakeHandle(run.id), firing("heartbeat_stale"))
+        assert fleet.requests == []
+        assert reg.get_remediations(run.id, action="drain_replace") == []
+
+    def test_fleet_decline_marks_skipped(self, reg, monkeypatch):
+        run = reg.create_run(SPEC, name="replica")
+        eng, _ = make_engine(reg, monkeypatch)
+        eng.register_fleet(FakeFleet({run.id}, accept=False))
+        eng.on_transitions(FakeHandle(run.id), firing("heartbeat_stale"))
+        rows = reg.get_remediations(run.id, action="drain_replace")
+        assert rows[0]["status"] == RemediationStatus.SKIPPED
+
+    def test_fleet_crash_marks_failed_not_raised(self, reg, monkeypatch):
+        run = reg.create_run(SPEC, name="replica")
+        eng, _ = make_engine(reg, monkeypatch)
+        eng.register_fleet(FakeFleet({run.id}, accept=RuntimeError("boom")))
+        eng.on_transitions(FakeHandle(run.id), firing("heartbeat_stale"))
+        rows = reg.get_remediations(run.id, action="drain_replace")
+        assert rows[0]["status"] == RemediationStatus.FAILED
+        assert "boom" in rows[0]["message"]
+
+    def test_drain_rules_knob_override(self, reg, monkeypatch):
+        eng, _ = make_engine(reg, monkeypatch, DRAIN_ALERTS="my_rule")
+        assert eng.drain_rules == {"my_rule"}
+        assert "drain_rules" in eng.status()
+
+    def test_unregister_fleet(self, reg, monkeypatch):
+        run = reg.create_run(SPEC, name="replica")
+        eng, _ = make_engine(reg, monkeypatch)
+        fleet = FakeFleet({run.id})
+        eng.register_fleet(fleet)
+        eng.unregister_fleet(fleet)
+        eng.on_transitions(FakeHandle(run.id), firing("heartbeat_stale"))
+        assert fleet.requests == []

@@ -1,0 +1,154 @@
+"""LocalServingFleet integration: real subprocess replicas under real
+faults.
+
+These are the slowest serving tests (each replica is a fresh process
+importing jax), so one module-scoped 2-replica fleet serves every test
+and destructive tests run LAST in file order (tier-1 runs with random
+ordering disabled).  What only a real process can prove: SIGKILL
+mid-request yields exactly one typed error and zero hangs, and the
+seeded fault schedule in ``http_poisson_load`` loses no requests.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from polyaxon_tpu.serving.fleet import LocalServingFleet
+from polyaxon_tpu.serving.loadgen import http_poisson_load, shared_prefix_prompts
+from polyaxon_tpu.serving.router import FleetRouter, RouterError
+
+MODEL = {
+    "vocab_size": 64,
+    "d_model": 32,
+    "n_layers": 2,
+    "n_heads": 4,
+    "head_dim": 8,
+    "d_ff": 64,
+}
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    os.environ.setdefault("POLYAXON_TPU_SERVING_WARMUP", "0")
+    router = FleetRouter(
+        probe_interval_s=0.2,
+        probe_timeout_s=1.0,
+        request_timeout_s=60.0,
+        retry_limit=2,
+        eject_failures=2,
+        eject_backoff_s=0.3,
+    )
+    f = LocalServingFleet(
+        tmp_path_factory.mktemp("fleet"),
+        MODEL,
+        replicas=2,
+        seq=64,
+        slots=4,
+        seed=0,
+        router=router,
+    )
+    f.start()
+    assert f.wait_ready(timeout_s=120), "fleet never reached ready"
+    yield f
+    f.stop()
+
+
+class TestFleetServing:
+    def test_boot_is_clean_and_generates(self, fleet):
+        st = fleet.router.stats()
+        assert st["n_ready"] == 2
+        # Booting replicas stay warming — no spurious ejections.
+        assert st["counters"]["ejections"] == 0
+        out = fleet.router.generate([[1, 2, 3, 4]], max_new_tokens=8)
+        assert len(out["tokens"][0]) == 8
+        assert out["replica"] in st["replicas"]
+        assert out["ttft_s"][0] is not None
+
+    def test_shared_prefix_traffic_is_sticky(self, fleet):
+        # The shared prefix must cover the router's affinity window —
+        # shorter prefixes hash the private suffix too and spread.
+        prompts = shared_prefix_prompts(
+            6, MODEL["vocab_size"],
+            prefix_len=fleet.router.affinity_tokens, suffix_len=4,
+            groups=1, seed=3,
+        )
+        replicas = {
+            fleet.router.generate([p], max_new_tokens=2)["replica"]
+            for p in prompts
+        }
+        assert len(replicas) == 1  # one family → one PrefixCache
+
+    def test_http_poisson_load_no_faults_loses_nothing(self, fleet):
+        prompts = shared_prefix_prompts(
+            10, MODEL["vocab_size"], prefix_len=6, suffix_len=4,
+            groups=2, seed=7,
+        )
+        res = http_poisson_load(
+            fleet.router.replica(
+                fleet.router.replica_names()[0]
+            ).base_url,
+            prompts,
+            4,
+            rate_rps=20.0,
+            seed=7,
+            timeout_s=120.0,
+        )
+        assert res["hangs"] == 0
+        assert res["completed"] + res["sheds"] == res["n_requests"]
+        assert res["failures"] == 0 and res["errors"] == 0
+        assert res["tokens_per_s"] > 0
+
+    # -- destructive from here on ---------------------------------------------
+    def test_kill_mid_stream_gives_one_typed_error_or_failover(self, fleet):
+        router = fleet.router
+        victim = next(
+            n for n in fleet._procs if router.replica(n).state == "ready"
+        )
+        outcome = {}
+
+        def go():
+            try:
+                outcome["ok"] = router.generate(
+                    [[9, 9, 9, 9]], max_new_tokens=48
+                )
+            except RouterError as e:
+                outcome["err"] = e
+
+        th = threading.Thread(target=go)
+        th.start()
+        time.sleep(0.3)
+        fleet.kill_replica(victim)
+        th.join(timeout=60)
+        assert not th.is_alive(), "request hung after replica SIGKILL"
+        # Completed via failover or exactly one typed error — never silent.
+        assert ("ok" in outcome) ^ ("err" in outcome)
+        if "err" in outcome:
+            assert outcome["err"].kind in ("upstream_error", "no_replicas")
+
+    def test_dead_replica_ejects_and_traffic_continues(self, fleet):
+        router = fleet.router
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            router.probe_all()
+            states = {
+                n: router.replica(n).state for n in router.replica_names()
+            }
+            if "ejected" in states.values() and "ready" in states.values():
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail(f"dead replica never ejected: {router.stats()}")
+        out = router.generate([[2, 3, 4]], max_new_tokens=4)
+        assert len(out["tokens"][0]) == 4
+
+    def test_replace_restores_capacity(self, fleet):
+        router = fleet.router
+        dead = next(
+            n for n in router.replica_names()
+            if router.replica(n).state != "ready"
+        )
+        fleet.replace_replica(dead)
+        assert fleet.wait_ready(n=2, timeout_s=120)
+        assert router.stats()["n_ready"] == 2

@@ -1,0 +1,425 @@
+"""FleetRouter edge cases against scriptable fake replicas (no jax).
+
+The router is pure control plane — everything here runs against tiny
+stub HTTP servers whose ``/healthz`` / ``/v1/stats`` / ``/generate``
+responses the test scripts, so each edge case (all-warming, overload
+shed, ejection backoff, drain deadline, affinity fallback, failover
+exhaustion) is deterministic and sub-second.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from polyaxon_tpu.serving.router import (
+    FleetRouter,
+    RouterError,
+    make_router_handler,
+)
+
+
+def _free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class FakeReplica:
+    """A scriptable lm_server stand-in: mutate ``.state`` / ``.stats`` /
+    ``.generate_response`` between calls to script scenarios."""
+
+    def __init__(self):
+        self.state = "ready"
+        self.stats = {"slots": 4, "slots_active": 0, "queue_depth": 0}
+        #: (status_code, payload) for POST /generate; or "close" to
+        #: drop the connection mid-request (a dying replica).
+        self.generate_response = (200, {"tokens": [[1, 2]], "ttft_s": [0.01]})
+        self.requests = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/v1/stats":
+                    return self._json(200, dict(outer.stats))
+                return self._json(200, {"ok": True, "state": outer.state})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                outer.requests.append(json.loads(self.rfile.read(n)))
+                resp = outer.generate_response
+                if resp == "close":
+                    self.connection.close()
+                    return
+                return self._json(*resp)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def router():
+    r = FleetRouter(
+        probe_interval_s=0.05,
+        probe_timeout_s=0.5,
+        request_timeout_s=5.0,
+        shed_occupancy=0.9,
+        retry_after_s=2.0,
+        retry_limit=1,
+        eject_failures=2,
+        eject_backoff_s=0.2,
+        eject_backoff_max_s=5.0,
+        affinity_tokens=4,
+    )
+    yield r
+    r.stop()
+
+
+@pytest.fixture()
+def fakes():
+    reps = [FakeReplica(), FakeReplica()]
+    yield reps
+    for rep in reps:
+        rep.close()
+
+
+class TestSelection:
+    def test_all_warming_is_503_warming_not_429(self, router, fakes):
+        for f in fakes:
+            f.state = "warming"
+        router.add_replica("a", fakes[0].url)
+        router.add_replica("b", fakes[1].url)
+        router.probe_all()
+        with pytest.raises(RouterError) as e:
+            router.select([1, 2, 3])
+        assert e.value.kind == "warming"
+        assert e.value.status == 503
+        assert router.counters["sheds"] == 0
+
+    def test_no_replicas_is_typed_503(self, router):
+        with pytest.raises(RouterError) as e:
+            router.select([1])
+        assert e.value.kind == "no_replicas" and e.value.status == 503
+
+    def test_overload_sheds_429_with_retry_after(self, router, fakes):
+        for f in fakes:
+            f.stats = {"slots": 4, "slots_active": 4, "queue_depth": 2}
+        router.add_replica("a", fakes[0].url)
+        router.add_replica("b", fakes[1].url)
+        router.probe_all()
+        with pytest.raises(RouterError) as e:
+            router.select([1, 2, 3])
+        assert e.value.kind == "overloaded"
+        assert e.value.status == 429
+        assert e.value.retry_after_s == 2.0
+        assert router.counters["sheds"] == 1
+
+    def test_least_loaded_wins_without_affinity(self, router, fakes):
+        fakes[0].stats = {"slots": 4, "slots_active": 3, "queue_depth": 0}
+        fakes[1].stats = {"slots": 4, "slots_active": 0, "queue_depth": 0}
+        router.affinity_tokens = 0  # pure load balancing
+        router.add_replica("busy", fakes[0].url)
+        router.add_replica("idle", fakes[1].url)
+        router.probe_all()
+        assert router.select([1, 2]).name == "idle"
+
+    def test_prefix_affinity_sticky_and_falls_back_when_ejected(
+        self, router, fakes
+    ):
+        router.add_replica("a", fakes[0].url)
+        router.add_replica("b", fakes[1].url)
+        router.probe_all()
+        prompt = [7, 8, 9, 10, 11]
+        first = router.select(list(prompt))
+        # Same prefix → same replica, independent of the private suffix.
+        again = router.select(prompt[:4] + [99, 100])
+        assert again.name == first.name
+        for rep in (first, again):
+            rep.inflight = 0
+        # Eject the affine replica: traffic must fall back, not 503.
+        router.note_request_failure(first, "boom")
+        router.note_request_failure(first, "boom")
+        assert first.state == "ejected"
+        fallback = router.select(list(prompt))
+        assert fallback.name != first.name
+
+
+class TestEjection:
+    def test_ejects_after_consecutive_failures_and_readmits(self, router, fakes):
+        router.add_replica("a", fakes[0].url)
+        router.probe_all()
+        rep = router.replica("a")
+        assert rep.state == "ready"
+        router.note_request_failure(rep, "conn reset")
+        assert rep.state == "ready"  # one strike is not an ejection
+        router.note_request_failure(rep, "conn reset")
+        assert rep.state == "ejected"
+        assert router.counters["ejections"] == 1
+        # Inside the backoff window probe_all skips it entirely.
+        router.probe_all(now=rep.ejected_until - 0.05)
+        assert rep.state == "ejected"
+        # After the window a healthy probe re-admits and resets streaks.
+        router.probe_all(now=rep.ejected_until + 0.01)
+        assert rep.state == "ready"
+        assert rep.eject_streak == 0
+        assert router.counters["readmissions"] == 1
+
+    def test_failed_readmission_backoff_grows_exponentially(self, router, fakes):
+        router.add_replica("a", fakes[0].url)
+        router.probe_all()
+        rep = router.replica("a")
+        fakes[0].close()  # replica is now genuinely dead
+        router.note_request_failure(rep, "dead")
+        router.note_request_failure(rep, "dead")
+        assert rep.state == "ejected"
+        windows = []
+        now = rep.ejected_until
+        for _ in range(3):
+            now += 0.01
+            router.probe_all(now=now)  # re-admission probe fails
+            assert rep.state == "ejected"
+            windows.append(rep.ejected_until - now)
+            now = rep.ejected_until
+        assert windows[1] > windows[0] and windows[2] > windows[1]
+        assert windows[2] <= router.eject_backoff_max_s
+
+    def test_warming_replica_is_not_ejected_by_boot_failures(self, router):
+        # A replica whose socket nobody listens on yet stays WARMING —
+        # clients see 503 "warming", and no ejection counters fire.
+        router.add_replica("booting", f"http://127.0.0.1:{_free_port()}")
+        for _ in range(4):
+            router.probe_all()
+        rep = router.replica("booting")
+        assert rep.state == "warming"
+        assert router.counters["ejections"] == 0
+
+
+class TestDrain:
+    def test_drain_stops_routing_and_completes_when_idle(self, router, fakes):
+        drained = []
+        router.on_drained = lambda name, timed_out: drained.append(
+            (name, timed_out)
+        )
+        router.add_replica("a", fakes[0].url)
+        router.add_replica("b", fakes[1].url)
+        router.probe_all()
+        assert router.drain("a", deadline_s=30.0)
+        assert router.replica("a").state == "draining"
+        # Draining replicas take no new traffic.
+        for _ in range(4):
+            rep = router.select([1, 2, 3, 4])
+            assert rep.name == "b"
+            rep.inflight = 0
+        # Idle + a probe newer than the drain start → drained.
+        router.probe_all()
+        assert router.is_drained("a")
+        assert drained == [("a", False)]
+
+    def test_drain_deadline_expiry_forces_drained(self, router, fakes):
+        drained = []
+        router.on_drained = lambda name, timed_out: drained.append(
+            (name, timed_out)
+        )
+        fakes[0].stats = {"slots": 4, "slots_active": 2, "queue_depth": 1}
+        router.add_replica("a", fakes[0].url)
+        router.probe_all()
+        router.drain("a", deadline_s=0.2)
+        router.probe_all()
+        assert not router.is_drained("a")  # still busy, deadline not hit
+        time.sleep(0.25)
+        router.probe_all()
+        assert router.is_drained("a")
+        assert drained == [("a", True)]
+
+    def test_drain_unknown_replica_returns_false(self, router):
+        assert router.drain("ghost") is False
+
+
+class TestGenerate:
+    def test_proxies_and_reports_replica(self, router, fakes):
+        router.add_replica("a", fakes[0].url)
+        router.probe_all()
+        out = router.generate([[1, 2, 3]], max_new_tokens=2)
+        assert out["tokens"] == [[1, 2]]
+        assert out["replica"] == "a"
+        assert out["retries"] == 0
+        assert fakes[0].requests[-1]["max_new_tokens"] == 2
+
+    def test_failover_to_live_replica_on_connection_error(self, router, fakes):
+        # "dead" is a port with no listener: instant connection refusal.
+        router.affinity_tokens = 0  # pure least-loaded steering
+        router.add_replica("dead", f"http://127.0.0.1:{_free_port()}")
+        router.add_replica("live", fakes[0].url)
+        router.probe_all()
+        # Force the dead replica to look routable so generate targets it.
+        rep = router.replica("dead")
+        rep.state = "ready"
+        rep.slots = 4
+        router.replica("live").slots_active = 1  # dead sorts least-loaded
+        out = router.generate([[5, 6]], max_new_tokens=2)
+        assert out["replica"] == "live"
+        assert out["retries"] == 1
+        assert router.counters["retries"] == 1
+        assert router.counters["failovers"] == 1
+
+    def test_exhausted_failover_is_one_typed_error(self, router):
+        router.retry_limit = 2
+        for name in ("d1", "d2"):
+            router.add_replica(name, f"http://127.0.0.1:{_free_port()}")
+            rep = router.replica(name)
+            rep.state = "ready"
+            rep.slots = 4
+        with pytest.raises(RouterError) as e:
+            router.generate([[1]], max_new_tokens=2)
+        assert e.value.kind == "upstream_error"
+        assert e.value.status == 502
+
+    def test_engine_shed_429_propagates_typed(self, router, fakes):
+        fakes[0].generate_response = (
+            429,
+            {"error": {"kind": "shed", "message": "pool exhausted"}},
+        )
+        router.add_replica("a", fakes[0].url)
+        router.probe_all()
+        with pytest.raises(RouterError) as e:
+            router.generate([[1, 2]], max_new_tokens=2)
+        assert e.value.kind == "shed"
+        assert e.value.status == 429
+        assert e.value.retry_after_s is not None
+        assert router.counters["sheds"] == 1
+
+    def test_midstream_connection_drop_fails_over_then_types_out(
+        self, router, fakes
+    ):
+        fakes[0].generate_response = "close"  # dies after accepting
+        fakes[1].generate_response = "close"
+        router.add_replica("a", fakes[0].url)
+        router.add_replica("b", fakes[1].url)
+        router.probe_all()
+        with pytest.raises(RouterError) as e:
+            router.generate([[1, 2]], max_new_tokens=2)
+        assert e.value.kind == "upstream_error"
+        assert e.value.status == 502
+        # Exactly one typed error; both replicas were attempted.
+        assert router.counters["retries"] == 2
+
+    def test_inflight_always_released(self, router, fakes):
+        fakes[0].generate_response = (
+            400, {"error": {"kind": "bad_request", "message": "nope"}}
+        )
+        router.add_replica("a", fakes[0].url)
+        router.probe_all()
+        with pytest.raises(RouterError):
+            router.generate([[1]], max_new_tokens=2)
+        assert router.replica("a").inflight == 0
+
+
+class TestMetrics:
+    def test_state_gauge_and_counters_land_on_stats(self, router, fakes):
+        router.add_replica("a", fakes[0].url)
+        router.probe_all()
+        snap = router.metrics.snapshot()
+        key = 'fleet_replica_state{replica="a"}'
+        assert snap["gauges"][key] == 1.0  # ready
+        rep = router.replica("a")
+        router.note_request_failure(rep, "x")
+        router.note_request_failure(rep, "x")
+        snap = router.metrics.snapshot()
+        assert snap["gauges"][key] == 3.0  # ejected
+        assert snap["counters"]["router_ejections_total"] == 1
+
+    def test_stats_shed_rate(self, router, fakes):
+        fakes[0].stats = {"slots": 2, "slots_active": 2, "queue_depth": 2}
+        router.add_replica("a", fakes[0].url)
+        router.probe_all()
+        router.counters["requests"] = 4
+        for _ in range(2):
+            with pytest.raises(RouterError):
+                router.select([1])
+        assert router.stats()["shed_rate"] == 0.5
+
+
+class TestRouterHTTP:
+    @pytest.fixture()
+    def front(self, router, fakes):
+        router.add_replica("a", fakes[0].url)
+        router.probe_all()
+        handler = make_router_handler(router, {"fleet_name": "test"})
+        server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        yield f"http://127.0.0.1:{server.server_address[1]}"
+        server.shutdown()
+        server.server_close()
+
+    def _post(self, url, payload):
+        req = urllib.request.Request(
+            url + "/generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, json.load(r), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, json.load(e), dict(e.headers)
+
+    def test_generate_roundtrip(self, front):
+        status, body, _ = self._post(
+            front, {"prompts": [[1, 2, 3]], "max_new_tokens": 2}
+        )
+        assert status == 200
+        assert body["tokens"] == [[1, 2]]
+        assert body["replica"] == "a"
+
+    def test_shed_has_retry_after_header_and_kind(self, front, router):
+        router.shed_occupancy = 0.0  # everything sheds
+        status, body, headers = self._post(front, {"prompts": [[1]]})
+        assert status == 429
+        assert body["error"]["kind"] == "overloaded"
+        assert int(headers["Retry-After"]) >= 1
+
+    def test_bad_request_is_typed_400(self, front):
+        status, body, _ = self._post(front, {"prompts": "nope"})
+        assert status == 400
+        assert body["error"]["kind"] == "bad_request"
+
+    def test_healthz_and_stats(self, front):
+        with urllib.request.urlopen(front + "/healthz", timeout=10) as r:
+            health = json.load(r)
+        assert health["ok"] and health["state"] == "ready"
+        assert health["fleet"] == {"ready": 1}
+        with urllib.request.urlopen(front + "/v1/stats", timeout=10) as r:
+            stats = json.load(r)
+        assert stats["n_ready"] == 1
+        assert "a" in stats["replicas"]
+
+    def test_metrics_exposition(self, front, router):
+        rep = router.replica("a")
+        router.note_request_failure(rep, "x")
+        router.note_request_failure(rep, "x")
+        with urllib.request.urlopen(front + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "polyaxon_tpu_fleet_replica_state" in text
+        assert "polyaxon_tpu_router_ejections_total" in text

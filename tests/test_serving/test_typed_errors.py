@@ -1,0 +1,201 @@
+"""Typed error contract of the LM HTTP front-end.
+
+Every refusal the handler can produce carries a machine-readable
+``error.kind`` (and sheds carry ``Retry-After``) — the router and
+loadgen dispatch on these, so they are API, not log text.  The handler
+branches are driven through a scriptable fake engine (no jax, instant);
+the real engine's drain semantics get one integration test at the end.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from polyaxon_tpu.builtins.services import _make_lm_handler
+from polyaxon_tpu.serving.engine import EngineDrainingError
+
+
+class FakeRequest:
+    _ids = iter(range(10**6))
+
+    def __init__(self, error=None, error_kind=None, tokens=(1, 2)):
+        self.id = next(self._ids)
+        self.error = error
+        self.error_kind = error_kind
+        self.tokens = list(tokens)
+        self.first_token_at = None
+        self.done = threading.Event()
+        self.done.set()
+
+    def wait(self, timeout=None):
+        if self.error is not None:
+            raise RuntimeError(self.error)
+        return self.tokens
+
+
+class FakeEngine:
+    """Scriptable ServingEngine stand-in: set ``next_submit`` to an
+    exception type to raise at admission, or ``next_requests`` to the
+    FakeRequests /generate should wait on."""
+
+    def __init__(self):
+        self.next_submit = None
+        self.next_requests = None
+        self.cancelled = []
+
+    def submit(self, prompt, max_new_tokens, temperature=0.0):
+        if self.next_submit is not None:
+            raise self.next_submit
+        if self.next_requests:
+            return self.next_requests.pop(0)
+        return FakeRequest()
+
+    def cancel(self, rid):
+        self.cancelled.append(rid)
+        return True
+
+    def stats(self):
+        return {
+            "state": "ready", "slots": 4, "slots_active": 0,
+            "queue_depth": 0, "warmup": False,
+        }
+
+    def latency_summaries(self):
+        return {}
+
+
+class FakeCfg:
+    n_params = 0
+    vocab_size = 64
+    max_seq = 48
+    kv_heads = 1
+
+
+@pytest.fixture()
+def served():
+    engine = FakeEngine()
+    handler = _make_lm_handler(
+        engine,
+        FakeCfg(),
+        {"default_max_new": 4, "request_timeout_s": 5.0, "retry_after_s": 3},
+    )
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield engine, f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+    server.server_close()
+
+
+def _post(url, payload, path="/generate"):
+    req = urllib.request.Request(
+        url + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.load(r), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e), dict(e.headers)
+
+
+class TestTypedErrors:
+    def test_engine_shed_is_429_with_retry_after(self, served):
+        engine, url = served
+        engine.next_requests = [
+            FakeRequest(error="KV block pool exhausted (request shed)",
+                        error_kind="shed")
+        ]
+        status, body, headers = _post(url, {"prompts": [[1, 2]]})
+        assert status == 429
+        assert body["error"]["kind"] == "shed"
+        assert "exhausted" in body["error"]["message"]
+        assert headers["Retry-After"] == "3"
+
+    def test_draining_is_typed_503(self, served):
+        engine, url = served
+        engine.next_submit = EngineDrainingError("engine is draining")
+        status, body, headers = _post(url, {"prompts": [[1, 2]]})
+        assert status == 503
+        assert body["error"]["kind"] == "draining"
+        assert "Retry-After" in headers
+
+    def test_timeout_is_typed_503_and_cancels(self, served):
+        engine, url = served
+        req = FakeRequest()
+        req.error = "wait timed out"
+
+        def wait(timeout=None):
+            raise TimeoutError("request timed out after 5.0s")
+
+        req.wait = wait
+        req.done = threading.Event()  # still in flight → must be cancelled
+        engine.next_requests = [req]
+        status, body, _ = _post(url, {"prompts": [[1, 2]]})
+        assert status == 503
+        assert body["error"]["kind"] == "timeout"
+        assert engine.cancelled == [req.id]
+
+    def test_bad_request_kind(self, served):
+        _, url = served
+        status, body, _ = _post(url, {"prompts": "nope"})
+        assert status == 400
+        assert body["error"]["kind"] == "bad_request"
+
+    def test_not_found_kind(self, served):
+        _, url = served
+        status, body, _ = _post(url, {}, path="/nope")
+        assert status == 404
+        assert body["error"]["kind"] == "not_found"
+
+    def test_other_engine_error_keeps_503_with_kind(self, served):
+        engine, url = served
+        engine.next_requests = [
+            FakeRequest(error="engine stopped", error_kind="stopped")
+        ]
+        status, body, _ = _post(url, {"prompts": [[1, 2]]})
+        assert status == 503
+        assert body["error"]["kind"] == "stopped"
+
+    def test_success_reports_ttft(self, served):
+        engine, url = served
+        req = FakeRequest(tokens=[5, 6, 7])
+        req.first_token_at = 1.0  # set by _emit in the real engine
+        engine.next_requests = [req]
+        status, body, _ = _post(url, {"prompts": [[1, 2]]})
+        assert status == 200
+        assert body["tokens"] == [[5, 6, 7]]
+        assert len(body["ttft_s"]) == 1
+
+
+class TestEngineDrain:
+    def test_drain_blocks_new_admissions_but_finishes_inflight(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.models import TransformerConfig, init_params
+        from polyaxon_tpu.serving import ServingEngine
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+            head_dim=8, d_ff=64, max_seq=32, dtype=jnp.float32,
+        )
+        engine = ServingEngine(
+            init_params(jax.random.PRNGKey(0), cfg), cfg, slots=2, max_len=32
+        ).start()
+        try:
+            inflight = engine.submit([1, 2, 3], 8, 0.0)
+            engine.drain()
+            assert engine.stats()["state"] == "draining"
+            with pytest.raises(EngineDrainingError):
+                engine.submit([4, 5, 6], 4, 0.0)
+            # The in-flight request still runs to completion.
+            tokens = inflight.wait(timeout=120)
+            assert len(tokens) == 8
+            assert inflight.error is None
+        finally:
+            engine.stop()
