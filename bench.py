@@ -1511,6 +1511,142 @@ def main() -> None:
 
         traceback.print_exc(file=sys.stderr)
 
+    # Autoscale under chaos: the availability proof for the PR 15
+    # closed loop.  A 1-replica fleet with the FleetAutoscaler attached
+    # faces a seeded chaos schedule — an overload ramp (sustained sheds
+    # → scale-up), a mid-ramp SIGKILL of a serving replica (reap →
+    # capacity repair), a same-rate recovery phase, then an idle tail
+    # (drain back to min).  Claims: (1) ZERO lost requests — every
+    # request completes or ends typed, no hangs — across all of it;
+    # (2) the recovery-phase shed+error fraction drops well below the
+    # overload phase's once the autoscaler restores capacity; (3) the
+    # fleet returns to min_replicas with the autoscaler idle.
+    serving_autoscale_chaos = None
+    try:
+        import tempfile
+        import threading
+        from http.server import ThreadingHTTPServer
+
+        from polyaxon_tpu.serving.fleet import LocalServingFleet
+        from polyaxon_tpu.serving.loadgen import ChaosEvent, chaos_poisson_load
+        from polyaxon_tpu.serving.router import FleetRouter, make_router_handler
+
+        acmodel = {
+            "vocab_size": 64, "d_model": 16, "n_layers": 1,
+            "n_heads": 2, "head_dim": 8, "d_ff": 32,
+        }
+        ac_router = FleetRouter(
+            probe_interval_s=0.1, probe_timeout_s=1.0,
+            request_timeout_s=120.0, retry_limit=2,
+            eject_failures=2, eject_backoff_s=0.5,
+            shed_occupancy=0.8,
+        )
+        ac_fleet = LocalServingFleet(
+            Path(tempfile.mkdtemp()), acmodel,
+            replicas=1, seq=64, slots=2, seed=0, router=ac_router,
+            env={"POLYAXON_TPU_SERVING_WARMUP": "0"},
+        )
+        ac_fleet.start()
+        try:
+            if not ac_fleet.wait_ready(timeout_s=180):
+                raise RuntimeError("autoscale-chaos fleet never became ready")
+            ac_scaler = ac_fleet.attach_autoscaler(
+                enabled=True, shed_rate=0.25, idle_occupancy=0.3,
+                min_replicas=1, max_replicas=2,
+                up_hold_s=1.0, down_hold_s=1.0,
+                up_cooldown_s=1.0, down_cooldown_s=2.0,
+                budget=8,
+            )
+            ac_handler = make_router_handler(
+                ac_router, {"fleet_name": "autoscale-chaos"}
+            )
+            ac_front = ThreadingHTTPServer(("127.0.0.1", 0), ac_handler)
+            threading.Thread(
+                target=ac_front.serve_forever, daemon=True
+            ).start()
+            ac_url = f"http://127.0.0.1:{ac_front.server_address[1]}"
+            try:
+                ac_res = chaos_poisson_load(
+                    ac_url,
+                    [[i % 60, (i + 7) % 60, (i + 21) % 60, (i + 33) % 60]
+                     for i in range(12)],
+                    8,
+                    phases=[
+                        (6.0, 8.0),   # overload ramp on 1 replica
+                        (20.0, 8.0),  # sustain: scale-up + kill repair
+                        (8.0, 8.0),   # recovery: capacity restored
+                        (8.0, 0.0),   # idle tail: drain back to min
+                    ],
+                    seed=17,
+                    events=[ChaosEvent(3.0, "kill")],  # mid-ramp SIGKILL
+                    fleet=ac_fleet,
+                    pump=ac_fleet.poll,
+                    pump_interval_s=0.05,
+                    timeout_s=300.0,
+                )
+                # Drain-down may still be in flight when the load tail
+                # ends — keep pumping the control loop until it settles.
+                settle_deadline = time.time() + 90.0
+                while time.time() < settle_deadline:
+                    ac_fleet.poll()
+                    if (
+                        ac_router.stats()["n_ready"] == 1
+                        and len(ac_fleet._procs) == 1
+                        and ac_scaler.status()["state"] == "idle"
+                    ):
+                        break
+                    time.sleep(0.05)
+                accounted = (
+                    ac_res["completed"] + ac_res["sheds"]
+                    + ac_res["errors"] + ac_res["failures"]
+                )
+                overload = ac_res["by_phase"][0]
+                recovery = ac_res["by_phase"][2]
+                shed_frac = lambda p: (  # noqa: E731
+                    (p["sheds"] + p["errors"]) / p["n"] if p["n"] else None
+                )
+                st = ac_scaler.status()
+                serving_autoscale_chaos = {
+                    "n_requests": ac_res["n_requests"],
+                    "completed": ac_res["completed"],
+                    "sheds": ac_res["sheds"],
+                    "typed_errors": ac_res["errors"],
+                    "failures": ac_res["failures"],
+                    "hangs": ac_res["hangs"],
+                    # The contract: every request accounted for, none
+                    # hung — through scale-up, SIGKILL, and drain-down.
+                    "zero_lost": (
+                        accounted == ac_res["n_requests"]
+                        and ac_res["hangs"] == 0
+                        and ac_res["failures"] == 0
+                    ),
+                    "by_phase": ac_res["by_phase"],
+                    "overload_shed_frac": shed_frac(overload),
+                    "recovered_shed_frac": shed_frac(recovery),
+                    "shed_recovered": (
+                        shed_frac(recovery) is not None
+                        and shed_frac(recovery) < 0.3
+                    ),
+                    "decisions_spent": ac_scaler.decisions_spent,
+                    "back_to_min": (
+                        ac_router.stats()["n_ready"] == 1
+                        and len(ac_fleet._procs) == 1
+                        and st["state"] == "idle"
+                        and st["target_replicas"] == 1
+                    ),
+                    "last_decision": st["last_decision"],
+                }
+            finally:
+                ac_front.shutdown()
+                ac_front.server_close()
+        finally:
+            ac_fleet.stop()
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+
     baseline_path = Path(__file__).parent / "BENCH_BASELINE.json"
     vs_baseline = 1.0
     longctx_vs_baseline = None
@@ -1671,6 +1807,7 @@ def main() -> None:
                 "serving_fleet_tokens_per_s": serving_fleet,
                 "serving_fleet_vs_baseline": serving_fleet_vs_baseline,
                 "serving_fleet_failover": serving_fleet_failover,
+                "serving_autoscale_under_chaos": serving_autoscale_chaos,
                 "train_images_per_s": train_images,
                 "train_images_vs_baseline": train_images_vs_baseline,
                 "trace_overhead_pct": (

@@ -179,6 +179,42 @@ def check_fleet(orch) -> Tuple[bool, str]:
     return ok, "; ".join(parts)
 
 
+def check_autoscaler(orch) -> Tuple[bool, str]:
+    """Autoscaler posture per fleet: state, last decision, and budget
+    headroom.  No autoscaled fleet is fine (fixed-size fleets are a
+    choice); an autoscaler with zero budget remaining is diagnostic —
+    the fleet can no longer self-size and an operator should know."""
+    fleets = getattr(orch, "fleets", None) or []
+    scalers = [
+        f.autoscaler
+        for f in fleets
+        if getattr(f, "autoscaler", None) is not None
+    ]
+    if not scalers:
+        return True, "no fleet autoscaler attached"
+    parts = []
+    for scaler in scalers:
+        try:
+            st = scaler.status()
+        except Exception as e:
+            return False, f"status() failed: {type(e).__name__}: {e}"
+        last = st.get("last_decision") or {}
+        decision = (
+            f"last {last.get('direction')}:{last.get('outcome')}"
+            if last
+            else "no decisions yet"
+        )
+        parts.append(
+            f"{st['fleet']}: {st['state']}"
+            + ("" if st["enabled"] else " (disabled)")
+            + f", target {st['target_replicas']} "
+            + f"[{st['min_replicas']}..{st['max_replicas']}]"
+            + f", shed {st['shed_rate']:.2%}, occ {st['occupancy']:.2f}"
+            + f", {decision}, budget {st['budget_remaining']}/{st['budget']}"
+        )
+    return True, "; ".join(parts)
+
+
 def check_static_analysis(orch) -> Tuple[bool, str]:
     """graft-lint posture: what the last recorded run found, and whether
     it is stale.  Never-run and stale are diagnostic (ok=True) — a fresh
@@ -239,6 +275,7 @@ CHECKS: Dict[str, Callable] = {
     "alerts": check_alerts,
     "remediation": check_remediation,
     "fleet": check_fleet,
+    "autoscaler": check_autoscaler,
     "static_analysis": check_static_analysis,
 }
 

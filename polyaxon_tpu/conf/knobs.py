@@ -209,6 +209,13 @@ _ALL: List[Knob] = [
     Knob("POLYAXON_TPU_ROUTER_AFFINITY_TOKENS", "int", 16,
          "prompt-prefix length hashed for replica affinity (0 = no "
          "affinity, pure least-loaded)", "router"),
+    Knob("POLYAXON_TPU_ROUTER_AFFINITY_SLACK", "float", 0.25,
+         "base load excess (affine minus least-loaded, per-slot) the "
+         "affine replica may carry before affinity yields", "router"),
+    Knob("POLYAXON_TPU_ROUTER_AFFINITY_HIT_SLACK", "float", 0.75,
+         "extra affinity slack earned per unit of the affine replica's "
+         "prefix_cache_hit_rate (warm caches justify routing into a "
+         "busier replica)", "router"),
     # -- serving fleet (replica gang lifecycle) ----------------------------
     Knob("POLYAXON_TPU_FLEET_REPLICAS", "int", 2,
          "default replica count for a serving fleet", "fleet"),
@@ -218,6 +225,35 @@ _ALL: List[Knob] = [
     Knob("POLYAXON_TPU_FLEET_READY_TIMEOUT_S", "float", 120.0,
          "how long a replacement replica may take to reach ready "
          "before the drain/replace action fails", "fleet"),
+    # -- fleet autoscaler (shed/occupancy-driven N resizing) ---------------
+    Knob("POLYAXON_TPU_AUTOSCALER_ENABLED", "bool", True,
+         "fleet autoscaler master switch (an attached autoscaler still "
+         "tracks signals when off, but never resizes)", "autoscaler"),
+    Knob("POLYAXON_TPU_AUTOSCALER_SHED_RATE", "float", 0.05,
+         "windowed shed fraction (sheds/requests per tick) at/above "
+         "which sustained overload triggers scale-up", "autoscaler"),
+    Knob("POLYAXON_TPU_AUTOSCALER_IDLE_OCCUPANCY", "float", 0.1,
+         "fleet-mean occupancy floor; sustained occupancy below it "
+         "(with zero sheds) triggers drain-down", "autoscaler"),
+    Knob("POLYAXON_TPU_AUTOSCALER_MIN_REPLICAS", "int", 1,
+         "scale-down floor — the fleet never drains below this",
+         "autoscaler"),
+    Knob("POLYAXON_TPU_AUTOSCALER_MAX_REPLICAS", "int", 4,
+         "scale-up ceiling", "autoscaler"),
+    Knob("POLYAXON_TPU_AUTOSCALER_UP_HOLD_S", "float", 5.0,
+         "hysteresis: the shed signal must hold this long before a "
+         "scale-up fires", "autoscaler"),
+    Knob("POLYAXON_TPU_AUTOSCALER_DOWN_HOLD_S", "float", 30.0,
+         "hysteresis: the idle signal must hold this long before a "
+         "drain-down fires", "autoscaler"),
+    Knob("POLYAXON_TPU_AUTOSCALER_UP_COOLDOWN_S", "float", 15.0,
+         "min spacing between scale-up decisions", "autoscaler"),
+    Knob("POLYAXON_TPU_AUTOSCALER_DOWN_COOLDOWN_S", "float", 60.0,
+         "min spacing between scale-down decisions; a completed "
+         "scale-UP also re-arms it (flap suppression)", "autoscaler"),
+    Knob("POLYAXON_TPU_AUTOSCALER_BUDGET", "int", 0,
+         "hard cap on autoscaler decisions per fleet (0 = inherit "
+         "POLYAXON_TPU_REMEDIATION_BUDGET)", "autoscaler"),
     # -- worker / monitoring ------------------------------------------------
     Knob("POLYAXON_TPU_RESOURCE_INTERVAL", "float", 10.0,
          "host/device resource sampler cadence (s)", "worker"),

@@ -19,9 +19,22 @@ from polyaxon_tpu.serving.engine import (
 )
 from polyaxon_tpu.serving.paging import BlockAllocator, PrefixCache
 
+
+def __getattr__(name):
+    # FleetAutoscaler lives behind a lazy import: the serving package
+    # is imported by replica subprocesses that never autoscale, and the
+    # autoscaler pulls in the knob catalog + router early otherwise.
+    if name == "FleetAutoscaler":
+        from polyaxon_tpu.serving.autoscaler import FleetAutoscaler
+
+        return FleetAutoscaler
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "BlockAllocator",
     "EngineDrainingError",
+    "FleetAutoscaler",
     "GenerationRequest",
     "PrefixCache",
     "ServingEngine",

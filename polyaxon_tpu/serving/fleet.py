@@ -90,6 +90,7 @@ class LocalServingFleet:
         self.router = router if router is not None else FleetRouter()
         self._procs: Dict[str, Any] = {}
         self._counter = itertools.count()
+        self.autoscaler: Optional[Any] = None
 
     # -- lifecycle ------------------------------------------------------------
     def start(self) -> "LocalServingFleet":
@@ -181,12 +182,54 @@ class LocalServingFleet:
     def replace_replica(self, name: str) -> str:
         """Kill ``name`` (if alive), drop it from routing, launch a
         fresh replica — the local analogue of drain-and-replace."""
+        self.retire_replica(name)
+        return self.launch_replica()
+
+    def chaos_target(self) -> Optional[str]:
+        """Deterministic victim for an untargeted chaos event: the
+        first (by name) ready replica the router still routes to."""
+        ready = sorted(
+            n
+            for n in self.router.replica_names()
+            if (r := self.router.replica(n)) is not None
+            and r.state == "ready"
+            and n in self._procs
+        )
+        return ready[0] if ready else None
+
+    # -- resize protocol (FleetAutoscaler) -------------------------------------
+    def scale_up(self) -> str:
+        return self.launch_replica()
+
+    def retire_replica(self, name: str) -> None:
         ref = self._procs.pop(name, None)
         if ref is not None:
             ref.signal(signal.SIGKILL)
             ref.wait(timeout=10)
         self.router.remove_replica(name)
-        return self.launch_replica()
+
+    def run_id_for(self, name: str) -> Optional[int]:
+        return None  # subprocess replicas have no registry run
+
+    def attach_autoscaler(self, **kwargs: Any) -> Any:
+        from polyaxon_tpu.serving.autoscaler import FleetAutoscaler
+
+        self.autoscaler = FleetAutoscaler(self, **kwargs)
+        return self.autoscaler
+
+    def poll(self) -> None:
+        """Thread-free pump (mirrors :meth:`ServingFleet.poll`): reap
+        replicas whose subprocess died out from under us (a SIGKILLed
+        corpse would otherwise sit ejected forever, pinning autoscaler
+        membership at a capacity the router cannot route to), probe
+        when no router thread owns it, then tick the autoscaler."""
+        for name, ref in list(self._procs.items()):
+            if ref.poll() is not None:
+                self.retire_replica(name)
+        if getattr(self.router, "_thread", None) is None:
+            self.router.probe_all()
+        if self.autoscaler is not None:
+            self.autoscaler.evaluate()
 
 
 class ServingFleet:
@@ -239,6 +282,7 @@ class ServingFleet:
             else knob_float("POLYAXON_TPU_FLEET_READY_TIMEOUT_S")
         )
         self.router = router if router is not None else FleetRouter()
+        self.autoscaler: Optional[Any] = None
         #: replica name → registry run id (current membership).
         self._runs: Dict[str, int] = {}
         #: old run id → in-flight drain/replace operation state.
@@ -282,6 +326,28 @@ class ServingFleet:
                 return name
         return None
 
+    # -- resize protocol (FleetAutoscaler) -------------------------------------
+    def scale_up(self) -> str:
+        return self._submit_replica()
+
+    def retire_replica(self, name: str) -> None:
+        run_id = self._runs.pop(name, None)
+        if run_id is not None:
+            try:
+                self.orch.stop_run(run_id, actor="autoscaler")
+            except Exception:
+                pass
+        self.router.remove_replica(name)
+
+    def run_id_for(self, name: str) -> Optional[int]:
+        return self._runs.get(name)
+
+    def attach_autoscaler(self, **kwargs: Any) -> Any:
+        from polyaxon_tpu.serving.autoscaler import FleetAutoscaler
+
+        self.autoscaler = FleetAutoscaler(self, **kwargs)
+        return self.autoscaler
+
     # -- remediation entry point -----------------------------------------------
     def request_drain_replace(
         self, run_id: int, rem_id: int, rule: str
@@ -324,6 +390,8 @@ class ServingFleet:
                 self._poll_draining(run_id, op, now)
             elif op["phase"] == "replacing":
                 self._poll_replacing(run_id, op, now)
+        if self.autoscaler is not None:
+            self.autoscaler.evaluate(now)
 
     def _register_urls(self) -> None:
         for name, run_id in list(self._runs.items()):
@@ -415,6 +483,9 @@ class ServingFleet:
                 rid: {k: v for k, v in op.items() if k != "deadline"}
                 for rid, op in self._ops.items()
             },
+            "autoscaler": (
+                self.autoscaler.status() if self.autoscaler is not None else None
+            ),
         }
 
     def stop(self) -> None:

@@ -165,6 +165,61 @@ def shared_prefix_prompts(
     return prompts
 
 
+def _fire_one(
+    base: str,
+    prompt: Sequence[int],
+    max_new_tokens: int,
+    temperature: float,
+    timeout_s: float,
+    t_submit: float,
+) -> "tuple[str, Optional[float], int]":
+    """One ``/generate`` round-trip → (typed outcome, ttft, n_tokens).
+
+    The typed-outcome contract shared by every HTTP load harness:
+    ``completed`` / ``shed`` (429) / ``error:<kind>`` /
+    ``failure:<ExcType>`` — exactly one outcome per request.
+    """
+    import json as json_mod
+    import urllib.error
+    import urllib.request
+
+    payload = json_mod.dumps(
+        {
+            "prompts": [list(prompt)],
+            "max_new_tokens": max_new_tokens,
+            "temperature": temperature,
+        }
+    ).encode()
+    req = urllib.request.Request(
+        base + "/generate",
+        data=payload,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            body = json_mod.loads(resp.read() or b"{}")
+        n_tok = sum(len(t) for t in body.get("tokens") or [])
+        server_ttfts = [
+            t for t in (body.get("ttft_s") or []) if t is not None
+        ]
+        # Client-observed TTFT = queueing delay to the server plus
+        # the server-side first-token latency it reports.
+        ttft = (
+            min(server_ttfts) if server_ttfts
+            else time.perf_counter() - t_submit
+        )
+        return "completed", ttft, n_tok
+    except urllib.error.HTTPError as e:
+        try:
+            err = (json_mod.loads(e.read() or b"{}").get("error")) or {}
+        except ValueError:
+            err = {}
+        kind = str(err.get("kind") or f"http_{e.code}")
+        return ("shed" if e.code == 429 else f"error:{kind}"), None, 0
+    except Exception as e:
+        return f"failure:{type(e).__name__}", None, 0
+
+
 def http_poisson_load(
     base_url: str,
     prompts: Sequence[Sequence[int]],
@@ -196,10 +251,6 @@ def http_poisson_load(
     - ``hang`` — no outcome within ``timeout_s`` (must be ZERO — a hang
       means a request was silently dropped).
     """
-    import json as json_mod
-    import urllib.error
-    import urllib.request
-
     if rate_rps <= 0:
         raise ValueError(f"rate_rps must be positive, got {rate_rps}")
     rng = np.random.default_rng(seed)
@@ -212,41 +263,12 @@ def http_poisson_load(
     tokens_out = [0] * len(prompts)
 
     def fire(i: int, prompt: Sequence[int], t_submit: float) -> None:
-        payload = json_mod.dumps(
-            {
-                "prompts": [list(prompt)],
-                "max_new_tokens": max_new_tokens,
-                "temperature": temperature,
-            }
-        ).encode()
-        req = urllib.request.Request(
-            base + "/generate",
-            data=payload,
-            headers={"Content-Type": "application/json"},
+        outcome, ttft, n_tok = _fire_one(
+            base, prompt, max_new_tokens, temperature, timeout_s, t_submit
         )
-        try:
-            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-                body = json_mod.loads(resp.read() or b"{}")
-            tokens_out[i] = sum(len(t) for t in body.get("tokens") or [])
-            server_ttfts = [
-                t for t in (body.get("ttft_s") or []) if t is not None
-            ]
-            # Client-observed TTFT = queueing delay to the server plus
-            # the server-side first-token latency it reports.
-            ttfts_by_idx[i] = (
-                min(server_ttfts) if server_ttfts
-                else time.perf_counter() - t_submit
-            )
-            outcomes[i] = "completed"
-        except urllib.error.HTTPError as e:
-            try:
-                err = (json_mod.loads(e.read() or b"{}").get("error")) or {}
-            except ValueError:
-                err = {}
-            kind = str(err.get("kind") or f"http_{e.code}")
-            outcomes[i] = "shed" if e.code == 429 else f"error:{kind}"
-        except Exception as e:
-            outcomes[i] = f"failure:{type(e).__name__}"
+        tokens_out[i] = n_tok
+        ttfts_by_idx[i] = ttft
+        outcomes[i] = outcome
         latencies[i] = time.perf_counter() - t_submit
 
     # Fault schedule: one timer thread per event, armed relative to load
@@ -308,5 +330,260 @@ def http_poisson_load(
         "ttft_s": [
             round(t, 6) if t is not None else None for t in ttfts_by_idx
         ],
+        "outcomes": list(outcomes),
+    }
+
+
+class ChaosEvent:
+    """One scheduled fault/traffic event on the chaos timeline.
+
+    ``at_s`` seconds after load start, ``action`` one of:
+
+    - ``kill`` — SIGKILL ``target`` (or the fleet's deterministic
+      default victim) mid-whatever-it-was-doing;
+    - ``stall`` — SIGSTOP: freeze with sockets open;
+    - ``resume`` — SIGCONT a stalled replica (``target`` required);
+    - ``burst`` — ``n`` extra back-to-back arrivals on top of the
+      phase schedule (traffic chaos, not process chaos).
+    """
+
+    ACTIONS = ("kill", "stall", "resume", "burst")
+
+    def __init__(
+        self,
+        at_s: float,
+        action: str,
+        *,
+        target: Optional[str] = None,
+        n: int = 0,
+    ) -> None:
+        if action not in self.ACTIONS:
+            raise ValueError(f"unknown chaos action {action!r}")
+        if action == "resume" and target is None:
+            raise ValueError("resume requires an explicit target")
+        if action == "burst" and n <= 0:
+            raise ValueError("burst requires n > 0")
+        self.at_s = float(at_s)
+        self.action = action
+        self.target = target
+        self.n = int(n)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"ChaosEvent({self.at_s}, {self.action!r}, "
+            f"target={self.target!r}, n={self.n})"
+        )
+
+
+def chaos_schedule(
+    phases: Sequence["tuple[float, float]"],
+    *,
+    seed: int = 0,
+    events: Sequence[ChaosEvent] = (),
+) -> "List[tuple[float, int]]":
+    """Expand a phased-rate schedule + burst events into the exact
+    arrival timeline: a sorted list of ``(at_s, phase_idx)``.
+
+    ``phases`` is ``[(duration_s, rate_rps), ...]``; within each phase
+    arrivals are Poisson at that rate (rate 0 = idle phase, no
+    arrivals), drawn entirely from ``seed`` — same (phases, seed,
+    events) ⇒ byte-identical offered load, the property every chaos
+    A/B leans on.  ``burst`` events inject ``n`` simultaneous arrivals
+    at ``at_s``, tagged with the phase containing them.
+    """
+    rng = np.random.default_rng(seed)
+    arrivals: List["tuple[float, int]"] = []
+    t0 = 0.0
+    bounds: List["tuple[float, float]"] = []
+    for idx, (duration_s, rate_rps) in enumerate(phases):
+        if duration_s <= 0:
+            raise ValueError(f"phase {idx}: duration must be > 0")
+        bounds.append((t0, t0 + duration_s))
+        if rate_rps > 0:
+            t = t0
+            while True:
+                t += float(rng.exponential(1.0 / rate_rps))
+                if t >= t0 + duration_s:
+                    break
+                arrivals.append((t, idx))
+        t0 += duration_s
+    for ev in events:
+        if ev.action != "burst":
+            continue
+        idx = next(
+            (i for i, (lo, hi) in enumerate(bounds) if lo <= ev.at_s < hi),
+            max(0, len(bounds) - 1),
+        )
+        arrivals.extend((ev.at_s, idx) for _ in range(ev.n))
+    arrivals.sort()
+    return arrivals
+
+
+def chaos_poisson_load(
+    base_url: str,
+    prompts: Sequence[Sequence[int]],
+    max_new_tokens: int,
+    *,
+    phases: Sequence["tuple[float, float]"],
+    seed: int = 0,
+    events: Sequence[ChaosEvent] = (),
+    fleet: Any = None,
+    pump: Any = None,
+    pump_interval_s: float = 0.05,
+    temperature: float = 0.0,
+    timeout_s: float = 600.0,
+) -> Dict[str, Any]:
+    """Phased Poisson load composed with a seeded chaos timeline.
+
+    The autoscaler's proving ground: ``phases`` shapes offered load
+    over time (ramp → sustain → idle), ``events`` injects
+    kill/stall/resume/burst chaos at fixed offsets, and ``pump`` (e.g.
+    ``fleet.poll``) is called every ``pump_interval_s`` for the whole
+    run — so the thread-free control loop (probes, drain advancement,
+    autoscaler ticks) advances at a steady simulated monitor cadence
+    while traffic flows.  Prompts are consumed round-robin in arrival
+    order.
+
+    Returns the :func:`http_poisson_load` typed-outcome contract
+    (``completed + sheds + errors + failures + hangs == n_requests`` —
+    zero silent drops) plus ``by_phase`` per-phase accounting.
+    """
+    base = base_url.rstrip("/")
+    arrivals = chaos_schedule(phases, seed=seed, events=events)
+    total_s = sum(d for d, _ in phases)
+    n = len(arrivals)
+
+    outcomes: List[Optional[str]] = [None] * n
+    ttfts_by_idx: List[Optional[float]] = [None] * n
+    tokens_out = [0] * n
+    phase_of = [idx for _, idx in arrivals]
+
+    def fire(i: int, prompt: Sequence[int], t_submit: float) -> None:
+        outcome, ttft, n_tok = _fire_one(
+            base, prompt, max_new_tokens, temperature, timeout_s, t_submit
+        )
+        tokens_out[i] = n_tok
+        ttfts_by_idx[i] = ttft
+        outcomes[i] = outcome
+
+    def apply_event(ev: ChaosEvent) -> None:
+        if fleet is None or ev.action == "burst":
+            return
+        target = ev.target
+        if target is None:
+            picker = getattr(fleet, "chaos_target", None)
+            target = picker() if picker is not None else None
+        if target is None:
+            return
+        try:
+            if ev.action == "kill":
+                fleet.kill_replica(target)
+            elif ev.action == "stall":
+                fleet.stall_replica(target)
+            elif ev.action == "resume":
+                fleet.resume_replica(target)
+        except KeyError:
+            pass  # victim already gone — chaos got there first
+
+    # One merged timeline: arrivals and fault events fire in time
+    # order off the same clock, with the pump ticking in between.
+    timeline: List["tuple[float, int, Any]"] = [
+        (at, 0, (i, prompts[i % len(prompts)])) for i, (at, _) in enumerate(arrivals)
+    ]
+    timeline.extend(
+        (ev.at_s, 1, ev) for ev in events if ev.action != "burst"
+    )
+    timeline.sort(key=lambda item: (item[0], item[1]))
+
+    threads: List[threading.Thread] = []
+    t_start = time.perf_counter()
+    last_pump = 0.0
+
+    def tick_pump() -> None:
+        nonlocal last_pump
+        now = time.perf_counter() - t_start
+        if pump is not None and now - last_pump >= pump_interval_s:
+            last_pump = now
+            try:
+                pump()
+            except Exception:  # pragma: no cover - pump must not kill load
+                pass
+
+    for at_s, _, item in timeline:
+        while True:
+            elapsed = time.perf_counter() - t_start
+            if elapsed >= at_s:
+                break
+            time.sleep(min(pump_interval_s, at_s - elapsed))
+            tick_pump()
+        if isinstance(item, ChaosEvent):
+            apply_event(item)
+        else:
+            i, prompt = item
+            th = threading.Thread(
+                target=fire,
+                args=(i, prompt, time.perf_counter()),
+                daemon=True,
+            )
+            th.start()
+            threads.append(th)
+        tick_pump()
+    # Run out the remaining schedule (idle tail phases still need the
+    # pump — that is where drain-down decisions happen), then wait for
+    # stragglers, still pumping so in-flight control ops can finish.
+    while time.perf_counter() - t_start < total_s:
+        time.sleep(pump_interval_s)
+        tick_pump()
+    join_deadline = time.perf_counter() + timeout_s
+    for th in threads:
+        while th.is_alive() and time.perf_counter() < join_deadline:
+            th.join(timeout=pump_interval_s)
+            tick_pump()
+    wall = time.perf_counter() - t_start
+
+    hangs = sum(1 for th in threads if th.is_alive())
+    completed = sum(1 for o in outcomes if o == "completed")
+    sheds = sum(1 for o in outcomes if o == "shed")
+    errors = sum(1 for o in outcomes if o and o.startswith("error:"))
+    failures = sum(1 for o in outcomes if o and o.startswith("failure:"))
+    total_tokens = sum(tokens_out)
+    ttfts = sorted(t for t in ttfts_by_idx if t is not None)
+    by_phase = []
+    for idx in range(len(phases)):
+        sel = [i for i in range(n) if phase_of[i] == idx]
+        by_phase.append(
+            {
+                "n": len(sel),
+                "completed": sum(
+                    1 for i in sel if outcomes[i] == "completed"
+                ),
+                "sheds": sum(1 for i in sel if outcomes[i] == "shed"),
+                "errors": sum(
+                    1
+                    for i in sel
+                    if outcomes[i] and outcomes[i].startswith("error:")
+                ),
+                "failures": sum(
+                    1
+                    for i in sel
+                    if outcomes[i] and outcomes[i].startswith("failure:")
+                ),
+            }
+        )
+    return {
+        "n_requests": n,
+        "completed": completed,
+        "sheds": sheds,
+        "errors": errors,
+        "failures": failures,
+        "hangs": hangs,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(total_tokens / wall, 1) if wall > 0 else 0.0,
+        "total_tokens": total_tokens,
+        "ttft_mean_s": round(float(np.mean(ttfts)), 6) if ttfts else 0.0,
+        "ttft_p50_s": round(_pct(ttfts, 50), 6),
+        "ttft_p95_s": round(_pct(ttfts, 95), 6),
+        "ttft_p99_s": round(_pct(ttfts, 99), 6),
+        "by_phase": by_phase,
         "outcomes": list(outcomes),
     }

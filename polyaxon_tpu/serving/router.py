@@ -13,8 +13,12 @@ here:
 - **prefix affinity** — the first ``affinity_tokens`` prompt ids are
   hashed rendezvous-style over the ready set, so shared-prefix traffic
   lands on the replica whose ``PrefixCache`` already holds the blocks;
-  falls back to least-loaded when the affine replica is busy, draining,
-  or ejected;
+  when the affine replica is busier than the least-loaded alternative,
+  its probed ``prefix_hit_rate`` decides how much load excess affinity
+  is worth (``affinity_slack`` + hit_rate × ``affinity_hit_slack``) —
+  a genuinely warm cache justifies a busier replica, a cold one does
+  not; falls back to least-loaded beyond that slack or when the affine
+  replica is saturated, draining, or ejected;
 - **load shedding** — when the fleet-mean occupancy crosses
   ``shed_occupancy`` the router refuses admission with a typed 429
   (``error.kind == "overloaded"``) and a ``Retry-After`` header, same
@@ -188,6 +192,8 @@ class FleetRouter:
         eject_backoff_s: Optional[float] = None,
         eject_backoff_max_s: Optional[float] = None,
         affinity_tokens: Optional[int] = None,
+        affinity_slack: Optional[float] = None,
+        affinity_hit_slack: Optional[float] = None,
         on_drained: Optional[Callable[[str, bool], None]] = None,
     ) -> None:
         self.metrics = stats if stats is not None else MemoryStats()
@@ -240,6 +246,16 @@ class FleetRouter:
             affinity_tokens
             if affinity_tokens is not None
             else knob_int("POLYAXON_TPU_ROUTER_AFFINITY_TOKENS")
+        )
+        self.affinity_slack = (
+            affinity_slack
+            if affinity_slack is not None
+            else knob_float("POLYAXON_TPU_ROUTER_AFFINITY_SLACK")
+        )
+        self.affinity_hit_slack = (
+            affinity_hit_slack
+            if affinity_hit_slack is not None
+            else knob_float("POLYAXON_TPU_ROUTER_AFFINITY_HIT_SLACK")
         )
         self.on_drained = on_drained
         self._replicas: Dict[str, Replica] = {}
@@ -476,8 +492,13 @@ class FleetRouter:
         - 503 ``warming`` — replicas exist but none has reached ready
           (a booting fleet is not overloaded — clients should not back
           off the way a 429 tells them to);
-        - 503 ``unavailable`` — no routable replica (all ejected/
-          draining/drained);
+        - 503 ``no_replicas`` — the fleet is EMPTY of live capacity:
+          no replicas at all, or every replica ejected/dead/drained.
+          Distinct from the retry-exhausted 502 ``upstream_error``
+          (requests were attempted and failed) — here nothing was ever
+          attemptable;
+        - 503 ``unavailable`` — no ready replica right now, but at
+          least one is draining (in-flight work still finishing);
         - 429 ``overloaded`` — fleet-mean occupancy at/over the ceiling.
         """
         exclude = exclude or set()
@@ -489,9 +510,19 @@ class FleetRouter:
             ]
             ready = [r for r in candidates if r.state == "ready"]
             if not ready:
-                if not candidates:
+                if not candidates or all(
+                    r.state in ("ejected", "dead", "drained")
+                    for r in candidates
+                ):
                     raise RouterError(
-                        "no_replicas", "fleet has no replicas", status=503
+                        "no_replicas",
+                        "fleet has no live replicas"
+                        + (
+                            " (all ejected, dead, or drained)"
+                            if candidates
+                            else ""
+                        ),
+                        status=503,
                     )
                 if any(r.state == "warming" for r in candidates):
                     raise RouterError(
@@ -502,7 +533,7 @@ class FleetRouter:
                     )
                 raise RouterError(
                     "unavailable",
-                    "no ready replica (ejected or draining)",
+                    "no ready replica (draining in progress)",
                     status=503,
                     retry_after_s=self.retry_after_s,
                 )
@@ -517,9 +548,21 @@ class FleetRouter:
                     status=429,
                     retry_after_s=self.retry_after_s,
                 )
-            rep = self._affine(prompt, ready)
-            if rep is None or rep.load() >= 1.0:
-                rep = min(ready, key=lambda r: r.load())
+            affine = self._affine(prompt, ready)
+            rep = min(ready, key=lambda r: r.load())
+            if affine is not None and affine.load() < 1.0:
+                # Prefix-hit-aware affinity: a warm-but-busy affine
+                # replica is worth routing into only in proportion to
+                # how warm it actually is — its probed prefix_hit_rate
+                # buys extra slack over the least-loaded alternative
+                # (a cold replica gets only the base slack, so affinity
+                # can still bootstrap a cache).
+                slack = (
+                    self.affinity_slack
+                    + affine.prefix_hit_rate * self.affinity_hit_slack
+                )
+                if affine.load() - rep.load() <= slack:
+                    rep = affine
             rep.inflight += 1
             rep.requests += 1
             return rep

@@ -1,0 +1,214 @@
+"""Autoscaler e2e: sustained sheds → scale-up → shed rate recovers →
+idle → drain-down to min_replicas, all through the control plane.
+
+A 1-replica ``ServingFleet`` of real ``kind: service`` runs with
+``slots=2`` is offered three concurrent long-request loops: with two
+requests in flight the single replica sits at occupancy 1.0 ≥ the 0.8
+shed ceiling, so the third loop sheds continuously — the sustained
+signal the autoscaler scales up on.  Once the second replica probes
+ready the same offered load spreads (fleet mean ≤ 0.75 < 0.8) and
+sheds stop; stopping the load makes the fleet idle and the autoscaler
+drains back down.
+Every decision must land as a remediation row with phases, and no
+request may end untypred.
+"""
+
+import threading
+import time
+
+import pytest
+
+from polyaxon_tpu.db.registry import RemediationStatus
+from polyaxon_tpu.orchestrator import Orchestrator
+from polyaxon_tpu.serving.fleet import ServingFleet
+from polyaxon_tpu.serving.router import FleetRouter, RouterError
+from polyaxon_tpu.stats.metrics import labeled_key
+
+MODEL = {
+    "vocab_size": 64,
+    "d_model": 16,
+    "n_layers": 1,
+    "n_heads": 2,
+    "head_dim": 8,
+    "d_ff": 32,
+    "n_kv_heads": 1,
+}
+
+
+@pytest.mark.e2e
+class TestAutoscaleFlow:
+    def test_shed_scaleup_recovery_then_drain_down(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_SERVING_WARMUP", "0")
+        monkeypatch.setenv("POLYAXON_TPU_SCHEDULER_TERMINAL_GRACE", "0.5")
+        orch = Orchestrator(
+            tmp_path / "plat",
+            monitor_interval=0.05,
+            heartbeat_interval=0.2,
+            heartbeat_ttl=120.0,
+        )
+        router = FleetRouter(
+            probe_interval_s=0.05,
+            probe_timeout_s=0.5,
+            shed_occupancy=0.8,
+            eject_failures=4,
+        )
+        fleet = ServingFleet(
+            orch,
+            name="as-fleet",
+            declarations={**MODEL, "seq": 64, "slots": 2},
+            replicas=1,
+            drain_deadline_s=5.0,
+            ready_timeout_s=180.0,
+            router=router,
+        )
+        scaler = fleet.attach_autoscaler(
+            enabled=True,
+            shed_rate=0.3,
+            idle_occupancy=0.3,
+            min_replicas=1,
+            max_replicas=2,
+            up_hold_s=0.25,
+            down_hold_s=0.5,
+            up_cooldown_s=0.5,
+            down_cooldown_s=1.0,
+        )
+        stop = threading.Event()
+        outcomes = []
+
+        def long_requests():
+            while not stop.is_set():
+                try:
+                    out = fleet.router.generate(
+                        [[1, 2, 3, 4]], max_new_tokens=40
+                    )
+                    outcomes.append(("ok", out["replica"]))
+                except RouterError as e:
+                    outcomes.append(("err", e.kind))
+                time.sleep(0.01)
+
+        loaders = [
+            threading.Thread(target=long_requests, daemon=True)
+            for _ in range(3)
+        ]
+
+        def pump_until(cond, timeout, what):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                orch.pump(max_wait=0.05)
+                fleet.poll()
+                if cond():
+                    return
+            pytest.fail(
+                f"timed out waiting for {what}: "
+                f"autoscaler={scaler.status()} fleet={fleet.status()}"
+            )
+
+        try:
+            fleet.start()
+            pump_until(
+                lambda: router.stats()["n_ready"] >= 1, 180,
+                "first replica ready",
+            )
+            first_run_id = list(fleet.run_ids().values())[0]
+
+            for th in loaders:
+                th.start()
+
+            # Sustained sheds must open and complete a scale_up decision
+            # — ready-gated, so n_ready==2 when the row succeeds.
+            pump_until(
+                lambda: (
+                    scaler.last_decision is not None
+                    and scaler.last_decision.get("direction") == "up"
+                    and scaler.last_decision.get("outcome") == "succeeded"
+                ),
+                240,
+                "scale-up to complete",
+            )
+            assert router.stats()["n_ready"] == 2
+            assert len(fleet.run_ids()) == 2
+            new_name = scaler.last_decision["replica"]
+            new_run_id = fleet.run_ids()[new_name]
+            assert new_run_id != first_run_id
+            up_rows = orch.registry.get_remediations(
+                new_run_id, action="scale_up"
+            )
+            assert len(up_rows) == 1
+            assert up_rows[0]["trigger"] == "autoscaler"
+            assert up_rows[0]["status"] == RemediationStatus.SUCCEEDED
+            assert up_rows[0]["attrs"]["phase"] == "ready"
+
+            # Shed-rate recovery: with the load spread over 2 replicas
+            # the same traffic must shed (much) less than it did while
+            # the scale-up signal was accumulating.
+            c0 = dict(router.counters)
+            t_end = time.time() + 3.0
+            while time.time() < t_end:
+                orch.pump(max_wait=0.05)
+                fleet.poll()
+            c1 = dict(router.counters)
+            d_req = c1["requests"] - c0["requests"]
+            d_shed = c1["sheds"] - c0["sheds"]
+            assert d_req > 0, "load stopped flowing after scale-up"
+            recovered_rate = d_shed / d_req
+            assert recovered_rate < 0.3, (
+                f"shed rate did not recover: {recovered_rate:.2f} "
+                f"({d_shed}/{d_req} over 3s with 2 ready replicas)"
+            )
+        finally:
+            stop.set()
+        for th in loaders:
+            th.join(timeout=60)
+            assert not th.is_alive(), "load thread hung"
+        # Zero lost requests: every outcome completed or typed.
+        assert outcomes
+        bad = [
+            o for o in outcomes
+            if o[0] == "err" and o[1] not in ("overloaded", "shed")
+        ]
+        assert bad == [], f"untyped/faulted outcomes: {bad[:5]}"
+
+        try:
+            # Idle fleet → drain-down back to min_replicas, through the
+            # drain lifecycle (never a hard kill of a ready replica).
+            pump_until(
+                lambda: (
+                    len(fleet.run_ids()) == 1
+                    and router.stats()["n_ready"] == 1
+                    and scaler.last_decision.get("direction") == "down"
+                    and scaler.last_decision.get("outcome") == "succeeded"
+                ),
+                120,
+                "drain-down to min_replicas",
+            )
+            victim_rows = [
+                r
+                for rid in (first_run_id, new_run_id)
+                for r in orch.registry.get_remediations(
+                    rid, action="scale_down"
+                )
+            ]
+            assert len(victim_rows) == 1
+            assert victim_rows[0]["status"] == RemediationStatus.SUCCEEDED
+            assert victim_rows[0]["attrs"]["phase"] == "stopped"
+            assert victim_rows[0]["trigger"] == "autoscaler"
+
+            # Observability: target gauge is back at min, decision
+            # counters recorded both directions.
+            snap = router.metrics.snapshot()
+            gauge = labeled_key("fleet_target_replicas", fleet="as-fleet")
+            assert snap["gauges"][gauge] == 1.0
+            for direction in ("up", "down"):
+                key = labeled_key(
+                    "autoscaler_decision_total",
+                    direction=direction,
+                    outcome="succeeded",
+                )
+                assert snap["counters"][key] == 1
+            st = scaler.status()
+            assert st["state"] == "idle"
+            assert st["target_replicas"] == 1
+            assert st["budget_remaining"] == st["budget"] - 2
+        finally:
+            fleet.stop()
+            orch.stop()

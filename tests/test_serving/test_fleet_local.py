@@ -29,6 +29,17 @@ MODEL = {
 }
 
 
+def _sustained_load(router, stop, outcomes):
+    """Fire sequential requests until told to stop; every request ends
+    as ``("ok", replica)`` or ``("err", kind)`` — typed, never silent."""
+    while not stop.is_set():
+        try:
+            out = router.generate([[3, 1, 4, 1]], max_new_tokens=4)
+            outcomes.append(("ok", out["replica"]))
+        except RouterError as e:
+            outcomes.append(("err", e.kind))
+
+
 @pytest.fixture(scope="module")
 def fleet(tmp_path_factory):
     os.environ.setdefault("POLYAXON_TPU_SERVING_WARMUP", "0")
@@ -99,6 +110,78 @@ class TestFleetServing:
         assert res["completed"] + res["sheds"] == res["n_requests"]
         assert res["failures"] == 0 and res["errors"] == 0
         assert res["tokens_per_s"] > 0
+
+    # -- resize under load (fleet ends where it started: 2 ready) -------------
+    def test_scale_up_under_load_loses_nothing(self, fleet):
+        router = fleet.router
+        stop = threading.Event()
+        outcomes = []
+        threads = [
+            threading.Thread(
+                target=_sustained_load,
+                args=(router, stop, outcomes),
+                daemon=True,
+            )
+            for _ in range(2)
+        ]
+        for th in threads:
+            th.start()
+        try:
+            name = fleet.scale_up()
+            assert fleet.wait_ready(n=3, timeout_s=120), "3rd replica not ready"
+        finally:
+            stop.set()
+        for th in threads:
+            th.join(timeout=60)
+            assert not th.is_alive(), "load thread hung across scale-up"
+        assert outcomes, "no load was offered during the resize"
+        # Every request completed or was a typed load signal — adding a
+        # replica must never fault traffic in flight.
+        bad = [o for o in outcomes if o[0] == "err" and o[1] not in
+               ("overloaded", "shed")]
+        assert bad == []
+        assert router.replica(name).state == "ready"
+        assert router.stats()["n_ready"] == 3
+
+    def test_drain_idlest_under_load_loses_nothing(self, fleet):
+        router = fleet.router
+        assert router.stats()["n_ready"] == 3
+        stop = threading.Event()
+        outcomes = []
+        threads = [
+            threading.Thread(
+                target=_sustained_load,
+                args=(router, stop, outcomes),
+                daemon=True,
+            )
+            for _ in range(2)
+        ]
+        for th in threads:
+            th.start()
+        try:
+            ready = [
+                n for n in router.replica_names()
+                if router.replica(n).state == "ready"
+            ]
+            victim = min(ready, key=lambda n: (router.replica(n).load(), n))
+            assert router.drain(victim, deadline_s=30.0)
+            deadline = time.time() + 60
+            while time.time() < deadline and not router.is_drained(victim):
+                time.sleep(0.2)
+            assert router.is_drained(victim), "drain never completed"
+            fleet.retire_replica(victim)
+            time.sleep(0.5)  # keep load flowing on the shrunk fleet
+        finally:
+            stop.set()
+        for th in threads:
+            th.join(timeout=60)
+            assert not th.is_alive(), "load thread hung across drain-down"
+        assert outcomes
+        bad = [o for o in outcomes if o[0] == "err" and o[1] not in
+               ("overloaded", "shed")]
+        assert bad == []
+        assert victim not in router.replica_names()
+        assert router.stats()["n_ready"] == 2
 
     # -- destructive from here on ---------------------------------------------
     def test_kill_mid_stream_gives_one_typed_error_or_failover(self, fleet):

@@ -1,0 +1,198 @@
+"""Chaos schedule layer units: seeded determinism, phase accounting,
+event dispatch, and the zero-silent-drops contract — all against an
+in-process stub server, no subprocess replicas."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from polyaxon_tpu.serving.loadgen import (
+    ChaosEvent,
+    chaos_poisson_load,
+    chaos_schedule,
+)
+
+
+class StubServer:
+    """Minimal /generate endpoint; scriptable status code."""
+
+    def __init__(self):
+        self.code = 200
+        self.hits = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                outer.hits += 1
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                if outer.code == 200:
+                    body = json.dumps(
+                        {"tokens": [[1, 2, 3]], "ttft_s": [0.01]}
+                    ).encode()
+                else:
+                    body = json.dumps(
+                        {"error": {"kind": "overloaded", "message": "shed"}}
+                    ).encode()
+                self.send_response(outer.code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def stub():
+    s = StubServer()
+    yield s
+    s.close()
+
+
+class FakeChaosFleet:
+    def __init__(self):
+        self.calls = []
+
+    def chaos_target(self):
+        return "r0"
+
+    def kill_replica(self, name):
+        self.calls.append(("kill", name))
+
+    def stall_replica(self, name):
+        self.calls.append(("stall", name))
+
+    def resume_replica(self, name):
+        self.calls.append(("resume", name))
+
+
+class TestChaosSchedule:
+    def test_same_seed_same_timeline(self):
+        args = dict(seed=11, events=[ChaosEvent(1.2, "burst", n=3)])
+        a = chaos_schedule([(1.0, 8.0), (1.0, 0.0)], **args)
+        b = chaos_schedule([(1.0, 8.0), (1.0, 0.0)], **args)
+        assert a == b and len(a) > 3
+
+    def test_rate_zero_phase_has_no_arrivals(self):
+        sched = chaos_schedule([(1.0, 10.0), (2.0, 0.0)], seed=5)
+        assert sched
+        assert all(idx == 0 for _, idx in sched)
+        assert all(t < 1.0 for t, _ in sched)
+
+    def test_burst_lands_in_containing_phase(self):
+        sched = chaos_schedule(
+            [(1.0, 0.0), (1.0, 0.0)],
+            seed=0,
+            events=[ChaosEvent(1.5, "burst", n=4)],
+        )
+        assert sched == [(1.5, 1)] * 4
+
+    def test_schedules_are_time_sorted(self):
+        sched = chaos_schedule(
+            [(0.5, 20.0), (0.5, 20.0)],
+            seed=2,
+            events=[ChaosEvent(0.1, "burst", n=2)],
+        )
+        assert sched == sorted(sched)
+
+    def test_bad_phase_duration_raises(self):
+        with pytest.raises(ValueError):
+            chaos_schedule([(0.0, 5.0)])
+
+
+class TestChaosEvent:
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError):
+            ChaosEvent(1.0, "explode")
+
+    def test_resume_requires_target(self):
+        with pytest.raises(ValueError):
+            ChaosEvent(1.0, "resume")
+
+    def test_burst_requires_n(self):
+        with pytest.raises(ValueError):
+            ChaosEvent(1.0, "burst")
+
+
+class TestChaosPoissonLoad:
+    def test_accounting_and_by_phase(self, stub):
+        res = chaos_poisson_load(
+            stub.url,
+            [[1, 2, 3], [4, 5, 6]],
+            4,
+            phases=[(0.6, 15.0), (0.3, 0.0)],
+            seed=9,
+            timeout_s=30.0,
+        )
+        n = res["n_requests"]
+        assert n > 0
+        assert (
+            res["completed"] + res["sheds"] + res["errors"]
+            + res["failures"] + res["hangs"] == n
+        )
+        assert res["hangs"] == 0
+        assert res["completed"] == n
+        assert len(res["by_phase"]) == 2
+        assert res["by_phase"][0]["n"] == n  # idle phase offered nothing
+        assert res["by_phase"][1]["n"] == 0
+        assert sum(p["completed"] for p in res["by_phase"]) == n
+
+    def test_sheds_counted_apart_from_errors(self, stub):
+        stub.code = 429
+        res = chaos_poisson_load(
+            stub.url,
+            [[1, 2]],
+            4,
+            phases=[(0.4, 15.0)],
+            seed=3,
+            timeout_s=30.0,
+        )
+        assert res["sheds"] == res["n_requests"]
+        assert res["errors"] == 0 and res["failures"] == 0
+
+    def test_events_fire_and_pump_ticks(self, stub):
+        fleet = FakeChaosFleet()
+        pumps = []
+        res = chaos_poisson_load(
+            stub.url,
+            [[7, 7]],
+            4,
+            phases=[(0.5, 6.0)],
+            seed=1,
+            events=[
+                ChaosEvent(0.1, "stall", target="rX"),
+                ChaosEvent(0.2, "resume", target="rX"),
+                ChaosEvent(0.3, "kill"),  # untargeted → fleet.chaos_target()
+            ],
+            fleet=fleet,
+            pump=lambda: pumps.append(1),
+            pump_interval_s=0.02,
+            timeout_s=30.0,
+        )
+        assert fleet.calls == [
+            ("stall", "rX"), ("resume", "rX"), ("kill", "r0")
+        ]
+        assert len(pumps) >= 5  # the pump ticked throughout the run
+        assert res["hangs"] == 0
+
+    def test_burst_injects_extra_arrivals(self, stub):
+        base = chaos_poisson_load(
+            stub.url, [[1]], 2, phases=[(0.3, 5.0)], seed=4, timeout_s=30.0
+        )
+        burst = chaos_poisson_load(
+            stub.url, [[1]], 2, phases=[(0.3, 5.0)], seed=4,
+            events=[ChaosEvent(0.1, "burst", n=5)], timeout_s=30.0,
+        )
+        assert burst["n_requests"] == base["n_requests"] + 5

@@ -166,6 +166,106 @@ class TestSelection:
         assert fallback.name != first.name
 
 
+class TestPrefixHitAwareAffinity:
+    """The two regimes of warm-but-busy affinity: a COLD affine replica
+    yields to least-loaded at the base slack; a WARM one (high probed
+    prefix_hit_rate) earns extra slack and keeps its traffic."""
+
+    def _setup(self, router, fakes, prompt):
+        router.affinity_slack = 0.25
+        router.affinity_hit_slack = 0.75
+        router.add_replica("a", fakes[0].url)
+        router.add_replica("b", fakes[1].url)
+        router.probe_all()
+        with router._lock:
+            ready = list(router._replicas.values())
+        affine = router._affine(prompt, ready)
+        other = next(r for r in ready if r.name != affine.name)
+        # Affine replica busy at 0.75 load; the other idle.
+        affine.slots, affine.slots_active = 4, 3
+        other.slots, other.slots_active = 4, 0
+        return affine, other
+
+    def test_cold_busy_affine_yields_to_least_loaded(self, router, fakes):
+        prompt = [7, 8, 9, 10, 11]
+        affine, other = self._setup(router, fakes, prompt)
+        affine.prefix_hit_rate = 0.0  # cold cache: nothing to protect
+        # excess 0.75 > slack 0.25 + 0.0×0.75 → fall back.
+        assert router.select(list(prompt)).name == other.name
+
+    def test_warm_busy_affine_keeps_traffic(self, router, fakes):
+        prompt = [7, 8, 9, 10, 11]
+        affine, other = self._setup(router, fakes, prompt)
+        affine.prefix_hit_rate = 0.9  # warm cache
+        # excess 0.75 <= slack 0.25 + 0.9×0.75 = 0.925 → stay affine.
+        assert router.select(list(prompt)).name == affine.name
+
+    def test_saturated_affine_always_yields(self, router, fakes):
+        prompt = [7, 8, 9, 10, 11]
+        affine, other = self._setup(router, fakes, prompt)
+        affine.prefix_hit_rate = 1.0
+        affine.slots_active = 4  # load 1.0: no slack saves a full replica
+        assert router.select(list(prompt)).name == other.name
+
+
+class TestAllReplicasDown:
+    """Every replica ejected/dead/drained ⇒ ONE typed 503 no_replicas,
+    distinct from the retry-exhausted 502 upstream_error."""
+
+    def test_all_ejected_is_typed_no_replicas(self, router, fakes):
+        router.add_replica("a", fakes[0].url)
+        router.add_replica("b", fakes[1].url)
+        router.probe_all()
+        for name in ("a", "b"):
+            rep = router.replica(name)
+            router.note_request_failure(rep, "boom")
+            router.note_request_failure(rep, "boom")
+            assert rep.state == "ejected"
+        with pytest.raises(RouterError) as e:
+            router.select([1, 2])
+        assert e.value.kind == "no_replicas"
+        assert e.value.status == 503
+
+    def test_mixed_dead_and_drained_is_no_replicas(self, router, fakes):
+        router.add_replica("a", fakes[0].url)
+        router.add_replica("b", fakes[1].url)
+        router.probe_all()
+        router.replica("a").state = "dead"
+        router.replica("b").state = "drained"
+        with pytest.raises(RouterError) as e:
+            router.select([1, 2])
+        assert e.value.kind == "no_replicas" and e.value.status == 503
+
+    def test_draining_replica_keeps_it_unavailable_not_no_replicas(
+        self, router, fakes
+    ):
+        router.add_replica("a", fakes[0].url)
+        router.add_replica("b", fakes[1].url)
+        router.probe_all()
+        router.replica("a").state = "ejected"
+        router.replica("b").state = "draining"
+        # In-flight work is still finishing somewhere: the fleet is not
+        # EMPTY, it is momentarily unavailable.
+        with pytest.raises(RouterError) as e:
+            router.select([1, 2])
+        assert e.value.kind == "unavailable" and e.value.status == 503
+
+    def test_generate_surfaces_no_replicas_without_attempts(
+        self, router, fakes
+    ):
+        router.add_replica("a", fakes[0].url)
+        router.probe_all()
+        rep = router.replica("a")
+        router.note_request_failure(rep, "boom")
+        router.note_request_failure(rep, "boom")
+        with pytest.raises(RouterError) as e:
+            router.generate([[1, 2]], max_new_tokens=2)
+        # Nothing was attemptable — NOT the 502 that means "attempted
+        # and failed" (test_exhausted_failover_is_one_typed_error).
+        assert e.value.kind == "no_replicas"
+        assert e.value.status == 503
+
+
 class TestEjection:
     def test_ejects_after_consecutive_failures_and_readmits(self, router, fakes):
         router.add_replica("a", fakes[0].url)
