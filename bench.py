@@ -1211,6 +1211,123 @@ def main() -> None:
 
         traceback.print_exc(file=sys.stderr)
 
+    # Speculative-decoding A/B: templated (high n-gram self-overlap)
+    # traffic through the same engine twice — spec off, then on — on the
+    # identical Poisson schedule, offered above both arms' capacity so
+    # tokens/s measures service capacity rather than the offered rate.
+    # Decode-heavy on purpose (short prompts, LONG generations):
+    # speculation buys nothing during prefill, and on a random-init
+    # model the drafter's acceptance comes from greedy continuations
+    # settling into short cycles ~100 tokens in, so the generation must
+    # run long enough for the predictable tail to dominate — bench-notes
+    # has the full methodology and why that mechanism is the honest CPU
+    # stand-in for real templated traffic.  Greedy only (the engine's
+    # spec scope), prefix cache off so arm 2 can't ride arm 1's KV,
+    # warmup=True so the K-bucketed verify family compiles BEFORE the
+    # clock starts — the same zero-steady-state-compiles discipline the
+    # engine tests pin.  Gates on the tokens/s ratio AND unchanged
+    # completion/error accounting: a speedup that drops requests is a
+    # bug, not a win.
+    serving_spec_decode = None
+    try:
+        from polyaxon_tpu.serving import ServingEngine
+        from polyaxon_tpu.serving.loadgen import (
+            poisson_load,
+            templated_prompts,
+        )
+
+        # Small vocab + seed-0 params: the combination whose greedy
+        # continuations reliably reach short cycles within the window.
+        spec_cfg = TransformerConfig(
+            vocab_size=64,
+            d_model=64,
+            n_layers=2,
+            n_heads=4,
+            head_dim=16,
+            d_ff=256,
+            max_seq=512,
+            dtype=jnp.float32,
+        )
+        spec_params = init_params(jax.random.PRNGKey(0), spec_cfg)
+        spec_max_new, spec_k, spec_slots = 448, 4, 4
+        spec_prompts = templated_prompts(16, spec_cfg.vocab_size, seed=11)
+
+        def spec_run(spec_on, rate_rps=None):
+            eng = ServingEngine(
+                spec_params, spec_cfg, slots=spec_slots,
+                max_len=spec_cfg.max_seq, prefill_chunk=128,
+                prefix_cache=False, warmup=True,
+                spec_decode=spec_on, spec_k=spec_k, spec_min_ngram=2,
+            ).start()
+            try:
+                if not eng.wait_ready(timeout=600):
+                    raise RuntimeError("spec A/B engine warmup timed out")
+                if rate_rps is None:
+                    # Calibrate once, on THIS (spec-off) side: single-
+                    # stream service time svc makes slots/svc the batch
+                    # capacity ceiling; offer 2x that so both arms stay
+                    # saturated and the makespan is service-bound.
+                    t0 = time.perf_counter()
+                    for p in spec_prompts[:3]:
+                        eng.submit(p, spec_max_new).wait(timeout=600)
+                    svc = (time.perf_counter() - t0) / 3
+                    rate_rps = 2.0 * spec_slots / svc
+                res = poisson_load(
+                    eng, spec_prompts, spec_max_new,
+                    rate_rps=rate_rps, seed=29,
+                )
+                s = eng.stats()
+                res["spec_accept_rate"] = s["spec_accept_rate"]
+                res["steady_state_compiles"] = s["steady_state_compiles"]
+            finally:
+                eng.stop()
+            return res, rate_rps
+
+        spec_off, spec_rate = spec_run(False)
+        spec_on, _ = spec_run(True, rate_rps=spec_rate)
+        spec_speedup = (
+            round(spec_on["tokens_per_s"] / spec_off["tokens_per_s"], 3)
+            if spec_off["tokens_per_s"] > 0
+            else None
+        )
+        accounting_ok = (
+            spec_on["completed"] == spec_off["completed"]
+            and spec_on["errors"] == spec_off["errors"] == 0
+        )
+        serving_spec_decode = {  # [spec off, spec on]
+            "tokens_per_s": [
+                spec_off["tokens_per_s"], spec_on["tokens_per_s"]
+            ],
+            "speedup": spec_speedup,
+            "speedup_ok": (
+                spec_speedup is not None and spec_speedup >= 1.5
+            ),
+            "accounting_ok": accounting_ok,
+            "completed": [spec_off["completed"], spec_on["completed"]],
+            "errors": [spec_off["errors"], spec_on["errors"]],
+            "spec_accept_rate": spec_on["spec_accept_rate"],
+            "steady_state_compiles": [
+                spec_off["steady_state_compiles"],
+                spec_on["steady_state_compiles"],
+            ],
+            "spec_k": spec_k,
+            "max_new_tokens": spec_max_new,
+            "offered_rps": round(spec_rate, 2),
+            "n_requests": len(spec_prompts),
+        }
+        if not (serving_spec_decode["speedup_ok"] and accounting_ok):
+            import sys
+
+            print(
+                f"bench: serving_spec_decode gate failed: {serving_spec_decode}",
+                file=sys.stderr,
+            )
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+
     # Training input pipeline: the overlapped hot loop (host prefetch +
     # device prefetch + async metrics, runtime/pipeline.py) vs the same
     # loop fully synchronous, on a dataset-backed image-classifier config.
@@ -1654,6 +1771,7 @@ def main() -> None:
     serving_vs_baseline = None
     serving_int8_vs_baseline = None
     serving_loaded_vs_baseline = None
+    serving_spec_vs_baseline = None
     serving_fleet_vs_baseline = None
     train_images_vs_baseline = None
     if on_tpu:
@@ -1715,6 +1833,20 @@ def main() -> None:
                 base["serving_tokens_per_s_loaded"] = serving_loaded[
                     "tokens_per_s_loaded"
                 ]
+        # The speculative arm gates on its own baseline: a drafter or
+        # verify-kernel regression must not hide behind the unchanged
+        # non-speculative loaded number.
+        if serving_spec_decode is not None:
+            if base.get("serving_spec_tokens_per_s"):
+                serving_spec_vs_baseline = round(
+                    serving_spec_decode["tokens_per_s"][1]
+                    / base["serving_spec_tokens_per_s"],
+                    3,
+                )
+            else:
+                base["serving_spec_tokens_per_s"] = serving_spec_decode[
+                    "tokens_per_s"
+                ][1]
         # Fleet aggregate throughput gates on the N=2 arm — a router or
         # balancing regression shows up here even when the single-engine
         # serving numbers are unchanged.
@@ -1804,6 +1936,8 @@ def main() -> None:
                 ),
                 "serving_loaded": serving_loaded,
                 "serving_loaded_vs_baseline": serving_loaded_vs_baseline,
+                "serving_spec_decode": serving_spec_decode,
+                "serving_spec_vs_baseline": serving_spec_vs_baseline,
                 "serving_fleet_tokens_per_s": serving_fleet,
                 "serving_fleet_vs_baseline": serving_fleet_vs_baseline,
                 "serving_fleet_failover": serving_fleet_failover,

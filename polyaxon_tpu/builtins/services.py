@@ -368,9 +368,11 @@ def lm_server(ctx: Context) -> None:
     ``request_timeout_s`` (server-side wait budget per /generate),
     ``max_new_tokens`` (server default when a request omits it),
     ``eos_id`` (retire a slot early on this token), ``host``,
-    ``quantize`` (``int8`` weight-only decode).  The decode step's shapes
-    depend only on (slots, pool size) — steady-state serving never
-    recompiles.
+    ``quantize`` (``int8`` weight-only decode), ``spec_decode`` /
+    ``spec_k`` / ``spec_min_ngram`` (speculative decoding: self-drafted
+    multi-token steps for greedy requests — see docs/serving.md).  The
+    decode step's shapes depend only on (slots, pool size) —
+    steady-state serving never recompiles.
     """
     import jax
 
@@ -462,6 +464,19 @@ def lm_server(ctx: Context) -> None:
     kv_quantize = str(ctx.get_param("kv_quantize", "") or "") or None
     if kv_quantize:
         ctx.log_text(f"lm_server: kv_quantize={kv_quantize} KV pool enabled")
+    spec_decode = ctx.get_param("spec_decode")
+    spec_decode = (
+        None
+        if spec_decode is None
+        else str(spec_decode).lower() not in ("0", "false", "no", "")
+    )
+    spec_k = ctx.get_param("spec_k")
+    spec_min_ngram = ctx.get_param("spec_min_ngram")
+    if spec_decode:
+        ctx.log_text(
+            f"lm_server: speculative decoding enabled "
+            f"(spec_k={spec_k}, spec_min_ngram={spec_min_ngram})"
+        )
     engine = ServingEngine(
         params,
         cfg,
@@ -477,6 +492,11 @@ def lm_server(ctx: Context) -> None:
         mesh=mesh if template is not None else None,
         eos_id=int(eos_id) if eos_id is not None else None,
         seed=ctx.seed or 0,
+        spec_decode=spec_decode,
+        spec_k=int(spec_k) if spec_k is not None else None,
+        spec_min_ngram=(
+            int(spec_min_ngram) if spec_min_ngram is not None else None
+        ),
         # The process-wide registry: /metrics then also exports anything
         # else this worker records (pipeline waits, task timings).
         stats=stats_backends.get_stats(),

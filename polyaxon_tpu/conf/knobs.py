@@ -181,6 +181,14 @@ _ALL: List[Knob] = [
     Knob("POLYAXON_TPU_SERVING_WARMUP", "bool", True,
          "pre-compile the whole serving fn family behind the readiness "
          "gate before traffic", "serving"),
+    Knob("POLYAXON_TPU_SERVING_SPEC_DECODE", "bool", False,
+         "speculative decoding: self-draft multi-token steps on the "
+         "paged engine (greedy requests only)", "serving"),
+    Knob("POLYAXON_TPU_SERVING_SPEC_K", "int", 4,
+         "max drafted tokens per lane per verify step", "serving"),
+    Knob("POLYAXON_TPU_SERVING_SPEC_MIN_NGRAM", "int", 2,
+         "n-gram length the prompt-lookup drafter matches against the "
+         "request's own context", "serving"),
     # -- fleet router (control-plane request routing) ----------------------
     Knob("POLYAXON_TPU_ROUTER_PROBE_INTERVAL_S", "float", 1.0,
          "health/stats probe cadence per replica (s)", "router"),

@@ -450,7 +450,9 @@ def _pool_append(
 ) -> Dict[str, jax.Array]:
     """Scatter freshly-computed KV rows for one layer into per-layer pool
     leaves at (write_blk, write_off), quantizing on append for the int8
-    layout.  ``rows``: [N, Hkv, d] aligned with write_blk/write_off [N]."""
+    layout.  ``rows``: [..., Hkv, d] aligned with write_blk/write_off
+    [...] — one index pair per row, any leading shape (a decode step's
+    [S], a verify step's [S, T])."""
     if name + "_q" in pool_l:
         q, scale = _kv_quant(rows)
         return {
@@ -663,6 +665,129 @@ def paged_decode_step(
     x = _rmsnorm(x, params["final_norm"])
     logits = jnp.einsum("btd,dv->btv", x, _wdq(unembed, x.dtype))
     return logits[:, 0].astype(jnp.float32), new_pool
+
+
+def _attend_spec(q, ck, cv, qpos, group):
+    """Multi-query-row attention over block-table-gathered KV.
+
+    The T-row generalization of :func:`_attend_paged` for speculative
+    verification: q [S, T, H, d] carries one query row per drafted token,
+    ck/cv [S, W*bs, Hkv, d] sit in logical-position order, and qpos
+    [S, T] gives each row's absolute position.  Per output element the
+    contraction and the masked f32 softmax are identical to the T=1
+    step's, which is what keeps a verify row's logits bit-identical to
+    the single-token decode step that would have produced them — the
+    foundation of the greedy parity guarantee.
+    """
+    S, K, Hkv, d = ck.shape
+    T = q.shape[1]
+    scale = d**-0.5
+    qg = q.reshape(S, T, Hkv, group, d)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, ck) * scale  # [S,Hkv,g,T,K]
+    valid = jnp.arange(K)[None, None, :] <= qpos[:, :, None]  # [S,T,K]
+    s = jnp.where(valid[:, None, None, :, :], s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, cv)
+    return out.reshape(S, T, Hkv * group, d)
+
+
+def paged_verify_step(
+    params: Dict[str, Any],
+    pool: Dict[str, jax.Array],
+    tables: jax.Array,
+    tokens: jax.Array,
+    pos: jax.Array,
+    n_tok: jax.Array,
+    active: jax.Array,
+    cfg: TransformerConfig,
+    qweights: Optional[Dict[str, Any]] = None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Score a batch of drafted token runs in ONE forward pass.
+
+    The speculative-decoding verify kernel: ``tokens`` [S, T] holds, per
+    lane, the next token to feed followed by up to T-1 drafted
+    continuations (right-padded); ``pos`` [S] is the absolute position
+    of ``tokens[:, 0]`` and ``n_tok`` [S] the valid token count (1 for a
+    lane taking a plain single-token step, up to T for a fully drafted
+    lane — both are DATA, so one compilation serves every draft-length
+    mix).  Rows beyond ``n_tok`` (and every row of inactive lanes) write
+    their garbage KV to trash block 0; valid rows land at their real
+    (block, offset) exactly like :func:`paged_prefill_chunk`, and each
+    row attends causally to the whole table plus the rows written before
+    it in this same call.
+
+    Returns ``(logits [S, T, vocab] f32, new_pool)``.  ``logits[s, j]``
+    is the model's next-token distribution AFTER feeding
+    ``tokens[s, :j+1]``, so the caller accepts draft ``tokens[s, j+1]``
+    iff it equals ``argmax(logits[s, j])`` — the accept mask — and row
+    ``n_accept`` yields the bonus/correction token.  Rejected rows leave
+    stale KV beyond the lane's rolled-back position: masked out of every
+    later attention (position mask) and overwritten in place as decoding
+    proceeds; whole tail blocks are freed host-side
+    (:func:`~polyaxon_tpu.serving.paging.truncate_table`).
+
+    Numerics mirror :func:`paged_decode_step` exactly — same ``_wdq``
+    weight streaming (int8 qweights compose), same ``_pool_append`` /
+    ``_pool_gather`` (int8 KV pools compose), same masked f32 softmax —
+    so greedy outputs stay token-identical to the non-speculative path.
+    """
+    c = cfg
+    S, W = tables.shape
+    T = tokens.shape[1]
+    bs, Hkv, d = pool_geometry(pool)
+    pos = jnp.where(active, pos, 0)
+    qpos = pos[:, None] + jnp.arange(T)[None, :]  # [S, T] absolute
+    row_ok = active[:, None] & (jnp.arange(T)[None, :] < n_tok[:, None])
+    write_blk = jnp.where(
+        row_ok,
+        tables[jnp.arange(S)[:, None], jnp.clip(qpos // bs, 0, W - 1)],
+        0,
+    )
+    write_off = jnp.where(row_ok, qpos % bs, 0)
+
+    x = params["embed"].astype(c.dtype)[tokens]  # [S, T, D]
+
+    blk = params["block"]
+    if qweights is None:
+        layers = blk
+        unembed = params["unembed"]
+    else:
+        layers = {
+            "attn_norm": blk["attn_norm"],
+            "mlp_norm": blk["mlp_norm"],
+            **{k: qweights[k] for k in QUANTIZED_BLOCK_WEIGHTS},
+        }
+        unembed = qweights["unembed"]
+
+    def layer_body(carry, inputs):
+        x = carry
+        layer, pool_l = inputs  # pool_l leaves: [NB, bs, Hkv, ...]
+        h = _rmsnorm(x, layer["attn_norm"])
+        q = jnp.einsum("btd,dhk->bthk", h, _wdq(layer["wq"], h.dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, _wdq(layer["wk"], h.dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, _wdq(layer["wv"], h.dtype))
+        q = _rope(q, qpos, c.rope_theta)
+        k = _rope(k, qpos, c.rope_theta)
+        # Write every row, then gather: rows written earlier in the run
+        # ARE later rows' causal keys, exactly like a prefill chunk.
+        pool_l = _pool_append(pool_l, "k", k, write_blk, write_off)
+        pool_l = _pool_append(pool_l, "v", v, write_blk, write_off)
+        ck = _pool_gather(pool_l, "k", tables, h.dtype).reshape(S, W * bs, Hkv, d)
+        cv = _pool_gather(pool_l, "v", tables, h.dtype).reshape(S, W * bs, Hkv, d)
+        attn = _attend_spec(q, ck, cv, qpos, c.n_heads // c.kv_heads)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, _wdq(layer["wo"], h.dtype))
+
+        h = _rmsnorm(x, layer["mlp_norm"])
+        up = jnp.einsum("btd,df->btf", h, _wdq(layer["wi"], h.dtype))
+        gate = jnp.einsum("btd,df->btf", h, _wdq(layer["wg"], h.dtype))
+        y = jax.nn.silu(gate) * up
+        x = x + jnp.einsum("btf,fd->btd", y, _wdq(layer["wd"], h.dtype))
+        return x, pool_l
+
+    x, new_pool = lax.scan(layer_body, x, (layers, pool))
+    x = _rmsnorm(x, params["final_norm"])
+    logits = jnp.einsum("btd,dv->btv", x, _wdq(unembed, x.dtype))
+    return logits.astype(jnp.float32), new_pool
 
 
 def _fit_spec(spec, leaf, mesh_shape):

@@ -130,6 +130,7 @@ def goodput_status(
         "compile_cache_misses": 0,
         "hbm_peak_bytes": 0.0,
         "kv_pool_bytes": 0.0,
+        "spec_accept_rate": 0.0,
         "devices": 0,
         "device_kind": "",
         "final": False,
@@ -168,6 +169,19 @@ def goodput_status(
             or 0.0
         )
         for r in per_proc
+    )
+    # Speculative-decoding acceptance, gang-wide: recomputed from the
+    # summed proposed/accepted counters (a per-process rate average
+    # would overweight idle replicas).
+    def _extra(r, key):
+        return float(
+            (((r.get("attrs") or {}).get("extra") or {}).get(key)) or 0.0
+        )
+
+    proposed = sum(_extra(r, "spec_proposed_total") for r in per_proc)
+    accepted = sum(_extra(r, "spec_accepted_total") for r in per_proc)
+    out["spec_accept_rate"] = (
+        round(accepted / proposed, 6) if proposed else 0.0
     )
     out["devices"] = sum(r["devices"] or 0 for r in per_proc)
     out["device_kind"] = next(

@@ -14,10 +14,15 @@ itself is front-end-agnostic.
 from polyaxon_tpu.serving.engine import (
     EngineDrainingError,
     GenerationRequest,
+    NgramDrafter,
     ServingEngine,
     SlotAllocator,
 )
-from polyaxon_tpu.serving.paging import BlockAllocator, PrefixCache
+from polyaxon_tpu.serving.paging import (
+    BlockAllocator,
+    PrefixCache,
+    truncate_table,
+)
 
 
 def __getattr__(name):
@@ -36,7 +41,9 @@ __all__ = [
     "EngineDrainingError",
     "FleetAutoscaler",
     "GenerationRequest",
+    "NgramDrafter",
     "PrefixCache",
     "ServingEngine",
     "SlotAllocator",
+    "truncate_table",
 ]

@@ -55,8 +55,12 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from polyaxon_tpu.conf.knobs import knob_bool
-from polyaxon_tpu.serving.paging import BlockAllocator, PrefixCache
+from polyaxon_tpu.conf.knobs import knob_bool, knob_int
+from polyaxon_tpu.serving.paging import (
+    BlockAllocator,
+    PrefixCache,
+    truncate_table,
+)
 from polyaxon_tpu.stats import MemoryStats
 from polyaxon_tpu.tracking.flightrec import get_progress
 from polyaxon_tpu.tracking.trace import get_tracer
@@ -65,6 +69,67 @@ from polyaxon_tpu.tracking.trace import get_tracer
 class EngineDrainingError(RuntimeError):
     """Raised by :meth:`ServingEngine.submit` once :meth:`drain` has been
     called — the engine finishes in-flight work but admits nothing new."""
+
+
+#: Typed per-request speculative modes (``GenerationRequest.spec_mode``):
+#: ``off`` (engine not speculating), ``greedy`` (drafted + verified), or
+#: ``fallback:sampled`` (temperature>0 — sampling must see the model's
+#: real distribution each step, so the request transparently rides
+#: single-token rows of the batch; counted on ``spec_fallback_total``).
+SPEC_MODE_OFF = "off"
+SPEC_MODE_GREEDY = "greedy"
+SPEC_MODE_FALLBACK_SAMPLED = "fallback:sampled"
+
+
+class NgramDrafter:
+    """Per-request prompt-lookup drafter (self-drafting, no draft model).
+
+    Keeps the request's full context (prompt + every accepted token) and
+    a suffix index mapping each ``n``-gram to the END positions of its
+    two most recent occurrences.  ``draft(k)`` matches the context's
+    last ``n`` tokens against the index and proposes the continuation of
+    the previous occurrence — the prompt-lookup scheme (Saxena), which
+    wins exactly on templated/repetitive traffic.  O(1) per appended
+    token and per lookup; the index is built during prefill (over the
+    prompt) and updated per accepted token, so draft cost never scales
+    with context length.
+    """
+
+    __slots__ = ("n", "tokens", "_index")
+
+    def __init__(self, n: int) -> None:
+        if n < 1:
+            raise ValueError(f"ngram length must be positive, got {n}")
+        self.n = int(n)
+        self.tokens: List[int] = []
+        # ngram -> (second-latest end, latest end).  Two-deep because the
+        # context's own suffix is always the LATEST occurrence of itself;
+        # drafting wants the one before it.
+        self._index: Dict[tuple, tuple] = {}
+
+    def extend(self, toks) -> None:
+        for t in toks:
+            self.append(int(t))
+
+    def append(self, tok: int) -> None:
+        self.tokens.append(int(tok))
+        if len(self.tokens) >= self.n:
+            key = tuple(self.tokens[-self.n :])
+            prev = self._index.get(key)
+            self._index[key] = (prev[1] if prev else None, len(self.tokens))
+
+    def draft(self, k: int) -> List[int]:
+        """Up to ``k`` proposed continuation tokens ([] = no match)."""
+        t = self.tokens
+        if k < 1 or len(t) < self.n:
+            return []
+        ends = self._index.get(tuple(t[-self.n :]))
+        if ends is None:
+            return []
+        end = ends[1] if ends[1] < len(t) else ends[0]
+        if end is None:
+            return []
+        return t[end : end + k]
 
 
 class GenerationRequest:
@@ -90,6 +155,7 @@ class GenerationRequest:
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.tokens: List[int] = []
+        self.spec_mode: str = SPEC_MODE_OFF
         self.stream: "queue.Queue[Optional[int]]" = queue.Queue()
         self.done = threading.Event()
         self.error: Optional[str] = None
@@ -212,6 +278,17 @@ class ServingEngine:
         the ``serving.steady_state_compiles`` counter.  Default (None)
         reads ``POLYAXON_TPU_SERVING_WARMUP`` (on unless ``0``/``false``
         /``off``).
+    spec_decode / spec_k / spec_min_ngram : speculative decoding — a
+        host-side prompt-lookup drafter proposes up to ``spec_k``
+        continuation tokens per greedy lane (matching the context's last
+        ``spec_min_ngram`` tokens against the request's own suffix
+        index) and ONE ``paged_verify_step`` scores the whole run;
+        accepted tokens append, the block table rolls back past the
+        rejection point.  Greedy outputs stay token-identical to the
+        non-speculative engine (the accept rule emits exactly the
+        model's own argmax run); temperature>0 requests transparently
+        fall back to single-token rows (``spec_fallback_total``).
+        Defaults read the ``POLYAXON_TPU_SERVING_SPEC_*`` knobs (off).
     stats : a stats backend receiving latency histograms
         (``serving.queue_wait_s`` / ``serving.ttft_s`` /
         ``serving.decode_step_s`` / ``serving.batch_occupancy``) and
@@ -251,6 +328,9 @@ class ServingEngine:
         seed: int = 0,
         stats: Optional[Any] = None,
         warmup: Optional[bool] = None,
+        spec_decode: Optional[bool] = None,
+        spec_k: Optional[int] = None,
+        spec_min_ngram: Optional[int] = None,
     ) -> None:
         import jax
 
@@ -355,10 +435,38 @@ class ServingEngine:
         self._n_steady_compiles = 0
         self._compiled_baseline: Optional[int] = None
 
+        # Speculative decoding: self-drafting multi-token steps.  All
+        # three default from the POLYAXON_TPU_SERVING_SPEC_* knobs.
+        if spec_decode is None:
+            spec_decode = knob_bool("POLYAXON_TPU_SERVING_SPEC_DECODE")
+        self.spec_decode = bool(spec_decode)
+        self.spec_k = int(
+            spec_k if spec_k is not None
+            else knob_int("POLYAXON_TPU_SERVING_SPEC_K")
+        )
+        self.spec_min_ngram = int(
+            spec_min_ngram if spec_min_ngram is not None
+            else knob_int("POLYAXON_TPU_SERVING_SPEC_MIN_NGRAM")
+        )
+        if self.spec_decode and self.spec_k < 1:
+            raise ValueError(f"spec_k must be positive, got {self.spec_k}")
+        if self.spec_decode and self.spec_min_ngram < 1:
+            raise ValueError(
+                f"spec_min_ngram must be positive, got {self.spec_min_ngram}"
+            )
+        #: Per-slot drafter (None: slot empty, spec off, or the request
+        #: is sampled — the typed fallback path).
+        self._drafters: List[Optional[NgramDrafter]] = [None] * self.slots
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_fallbacks = 0
+        self._spec_steps = 0
+
         self._key = jax.random.PRNGKey(seed)
         self._rng = np.random.default_rng(seed)
         self._chunk_fns: Dict[int, Any] = {}
         self._copy_fn: Optional[Any] = None
+        self._verify_fns: Dict[int, Any] = {}
         self._step_fn = self._build_step()
 
         # Stats: lifetime counters plus a sliding window for tokens/s;
@@ -460,10 +568,88 @@ class ServingEngine:
             )
         return self._copy_fn
 
+    def _spec_widths(self) -> List[int]:
+        """The verify-step width family: draft-count buckets (powers of
+        two capped at ``spec_k``) plus one row for the current token.
+        Bucketing bounds compilations at log2(spec_k) whatever draft-
+        length mix live traffic produces; ``n_tok`` is data inside each
+        bucket."""
+        if not self.spec_decode:
+            return []
+        out = set()
+        k = 1
+        while k < self.spec_k:
+            out.add(k + 1)
+            k *= 2
+        out.add(self.spec_k + 1)
+        return sorted(out)
+
+    def _width_for(self, max_draft: int) -> int:
+        """Smallest warm verify width that fits ``max_draft`` drafts."""
+        for w in self._spec_widths():
+            if w >= max_draft + 1:
+                return w
+        return self.spec_k + 1
+
+    def _get_verify(self, width: int):
+        """The jitted verify step for one padded draft width: the kernel
+        plus on-device accept/sample resolution, so only [S, width]
+        tokens and [S] emit counts ever cross back to the host."""
+        import jax
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.models.decode import paged_verify_step
+
+        if width not in self._verify_fns:
+            cfg = self.cfg
+
+            def verify(
+                params, pool, tables, tokens, pos, n_tok, active, temps,
+                key, qweights,
+            ):
+                logits, pool = paged_verify_step(
+                    params, pool, tables, tokens, pos, n_tok, active, cfg,
+                    qweights=qweights,
+                )
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # Row 0 is always emitted; sampled lanes (which never
+                # draft) sample it exactly like the single-token step.
+                keys = jax.random.split(key, logits.shape[0])
+                safe = jnp.where(temps > 0, temps, 1.0)
+                sampled = jax.vmap(jax.random.categorical)(
+                    keys, logits[:, 0] / safe[:, None]
+                )
+                first = jnp.where(
+                    temps > 0, sampled, greedy[:, 0]
+                ).astype(jnp.int32)
+                out = jnp.concatenate([first[:, None], greedy[:, 1:]], axis=1)
+                # Accept mask: draft j+1 survives iff it equals the
+                # model's own pick after row j AND every draft before it
+                # survived (cumprod) — the Leviathan greedy accept rule.
+                drafts_ok = (
+                    jnp.arange(1, tokens.shape[1])[None, :] < n_tok[:, None]
+                ) & (temps[:, None] <= 0)
+                match = (tokens[:, 1:] == greedy[:, :-1]) & drafts_ok
+                n_emit = 1 + jnp.cumprod(
+                    match.astype(jnp.int32), axis=1
+                ).sum(axis=1)
+                out = jnp.where(active[:, None], out, 0)
+                n_emit = jnp.where(active, n_emit, 0).astype(jnp.int32)
+                return out, n_emit, pool
+
+            self._verify_fns[width] = jax.jit(
+                verify, donate_argnums=self._donate()
+            )
+        return self._verify_fns[width]
+
     def _compiled_count(self) -> int:
         """Total compiled entries across the engine's jitted fns (0 when
         the jax version exposes no ``_cache_size``)."""
-        fns = [self._step_fn, *self._chunk_fns.values()]
+        fns = [
+            self._step_fn,
+            *self._chunk_fns.values(),
+            *self._verify_fns.values(),
+        ]
         if self._copy_fn is not None:
             fns.append(self._copy_fn)
         n = 0
@@ -507,7 +693,10 @@ class ServingEngine:
         tracer = get_tracer()
         t0 = time.perf_counter()
         buckets = self._warmup_buckets() if self._warmup else []
-        self._warmup_total = len(buckets) + 2 if self._warmup else 0
+        widths = self._spec_widths() if self._warmup else []
+        self._warmup_total = (
+            len(buckets) + len(widths) + 2 if self._warmup else 0
+        )
         gauge = getattr(self.stats_registry, "gauge", None)
 
         def _tick() -> None:
@@ -551,6 +740,27 @@ class ServingEngine:
                             jnp.int32(0),
                         )
                         jax.block_until_ready(logits)
+                        _tick()
+                    # The verify family: every width bucket speculative
+                    # traffic can request, warmed all-inactive so writes
+                    # land in the trash block.
+                    for width in widths:
+                        if self._stop.is_set():
+                            break
+                        self._key, sub = jax.random.split(self._key)
+                        out, n_emit, self._pool = self._get_verify(width)(
+                            self._params,
+                            self._pool,
+                            jnp.asarray(tables),
+                            jnp.zeros((self.slots, width), jnp.int32),
+                            jnp.asarray(self._pos),
+                            jnp.ones(self.slots, jnp.int32),
+                            jnp.asarray(self._active),
+                            jnp.asarray(self._temps),
+                            sub,
+                            self._qweights,
+                        )
+                        jax.block_until_ready(out)
                         _tick()
                     self._pool = self._get_copy()(
                         self._pool, jnp.int32(0), jnp.int32(0)
@@ -643,6 +853,7 @@ class ServingEngine:
             self._thread = None
         if self._ledger is not None:
             paging = self._paging_snapshot()
+            spec = self._spec_snapshot()
             self._ledger.merge_extra(
                 **self._utilization_snapshot(),
                 block_occupancy=paging["block_occupancy"],
@@ -650,6 +861,9 @@ class ServingEngine:
                 prefill_backlog_chunks=paging["prefill_backlog_chunks"],
                 kv_pool_bytes=paging["kv_pool_bytes"],
                 kv_dtype=paging["kv_dtype"],
+                spec_proposed_total=spec["spec_proposed_total"],
+                spec_accepted_total=spec["spec_accepted_total"],
+                spec_accept_rate=spec["spec_accept_rate"],
             )
             self._ledger.flush(final=True)
             self._ledger = None
@@ -819,6 +1033,26 @@ class ServingEngine:
             "requests_cancelled": cancelled,
         }
 
+    def _spec_snapshot(self) -> Dict[str, Any]:
+        """Speculative-decoding acceptance state, shared by ``stats()``
+        (→ ``/v1/stats``), the gauges, and the final ledger row."""
+        with self._stats_lock:
+            proposed = self._spec_proposed
+            accepted = self._spec_accepted
+            fallbacks = self._spec_fallbacks
+            steps = self._spec_steps
+        return {
+            "spec_decode": self.spec_decode,
+            "spec_k": self.spec_k,
+            "spec_steps": steps,
+            "spec_proposed_total": proposed,
+            "spec_accepted_total": accepted,
+            "spec_fallback_total": fallbacks,
+            "spec_accept_rate": (
+                round(accepted / proposed, 6) if proposed else 0.0
+            ),
+        }
+
     def _ledger_account(self, dt: float, occ_frac: float, tokens: int) -> None:
         """Fold one device-busy interval into the utilization ledger."""
         with self._stats_lock:
@@ -836,6 +1070,7 @@ class ServingEngine:
     def stats(self) -> Dict[str, Any]:
         util = self._utilization_snapshot()
         paging = self._paging_snapshot()
+        spec = self._spec_snapshot()
         with self._stats_lock:
             now = time.time()
             while self._window and now - self._window[0][0] > 10.0:
@@ -867,6 +1102,7 @@ class ServingEngine:
                 "tokens_per_s": round(tps, 1),
                 "max_len": self.max_len,
                 **paging,
+                **spec,
                 **util,
             }
 
@@ -974,6 +1210,26 @@ class ServingEngine:
                 "serving.queue_wait_s", req.started_at - req.submitted_at
             )
             self._slot_req[slot] = req
+            # Speculative path selection is typed per request at
+            # admission: greedy requests get a drafter (its suffix index
+            # seeded from the prompt here — the prefix-cache path may
+            # skip recomputing matched tokens, but the drafter must
+            # still see them); sampled requests must see the model's
+            # true distribution every step, so they transparently ride
+            # single-token rows instead.
+            if self.spec_decode:
+                if req.temperature > 0:
+                    req.spec_mode = SPEC_MODE_FALLBACK_SAMPLED
+                    with self._stats_lock:
+                        self._spec_fallbacks += 1
+                    incr = getattr(self.stats_registry, "incr", None)
+                    if incr is not None:
+                        incr("serving.spec_fallback_total", 1)
+                else:
+                    req.spec_mode = SPEC_MODE_GREEDY
+                    drafter = NgramDrafter(self.spec_min_ngram)
+                    drafter.extend(req.prompt)
+                    self._drafters[slot] = drafter
             job = _PrefillJob(req, slot)
             if self.prefix_cache is not None:
                 matched = self.prefix_cache.match(req.prompt)
@@ -1180,42 +1436,155 @@ class ServingEngine:
                     self._tables[slot, bi] = fresh
         if not self._active.any():
             return
+        drafts = self._collect_drafts() if self.spec_decode else {}
         t0 = time.perf_counter()
         self._key, sub = jax.random.split(self._key)
         tables = np.where(self._tables >= 0, self._tables, 0).astype(np.int32)
-        toks, self._pool = self._step_fn(
+        n_live = int(self._active.sum())
+        emitted = 0
+        if drafts:
+            emitted = self._verify_once(drafts, tables, sub)
+        else:
+            toks, self._pool = self._step_fn(
+                self._params,
+                self._pool,
+                jnp.asarray(tables),
+                jnp.asarray(self._tok),
+                jnp.asarray(self._pos),
+                jnp.asarray(self._active),
+                jnp.asarray(self._temps),
+                sub,
+                self._qweights,
+            )
+            toks = np.asarray(toks)  # host sync — the loop's one device read
+            for slot in np.nonzero(self._active)[0]:
+                slot = int(slot)
+                req = self._slot_req[slot]
+                tok = int(toks[slot])
+                self._pos[slot] += 1
+                self._tok[slot] = tok
+                self._emit(slot, req, tok)
+                emitted += 1
+        with self._stats_lock:
+            self._n_steps += 1
+            self._window.append((time.time(), emitted))
+        # The step advances every live slot ≥1 token, so its wall time IS
+        # the per-token decode latency each of those requests observed
+        # (amortized over the accept run on speculative steps).
+        step_dt = time.perf_counter() - t0
+        self.stats_registry.timing("serving.decode_step_s", step_dt)
+        self.stats_registry.observe("serving.batch_occupancy", float(n_live))
+        self._ledger_account(step_dt, n_live / self.slots, tokens=emitted)
+        self._record_gauges()
+        if self._ready.is_set():
+            self._capture.on_step(self._n_steps)
+        self._progress.beat(step=self._n_steps)
+
+    def _collect_drafts(self) -> Dict[int, List[int]]:
+        """Ask each active greedy lane's drafter for a proposal, clipped
+        to the request's remaining budget (emits = accepts + 1 can never
+        overshoot ``max_new_tokens``) and to the KV blocks the pool can
+        actually cover — pool pressure degrades a draft to fewer tokens
+        (ultimately a plain single-token step) instead of parking."""
+        drafts: Dict[int, List[int]] = {}
+        bs = self.block_size
+        for slot in np.nonzero(self._active)[0]:
+            slot = int(slot)
+            drafter = self._drafters[slot]
+            if drafter is None:
+                continue
+            req = self._slot_req[slot]
+            budget = req.max_new_tokens - len(req.tokens)
+            k = min(self.spec_k, budget - 1)
+            if k < 1:
+                continue
+            prop = drafter.draft(k)
+            if not prop:
+                continue
+            # Block faults for the draft span (row j writes pos+j; the
+            # pos block was faulted by the caller's boundary loop).
+            pos = int(self._pos[slot])
+            for j in range(1, len(prop) + 1):
+                bi = (pos + j) // bs
+                if self._tables[slot, bi] < 0:
+                    fresh = self._alloc_block()
+                    if fresh is None:
+                        prop = prop[: j - 1]
+                        break
+                    self._tables[slot, bi] = fresh
+            if prop:
+                drafts[slot] = prop
+        return drafts
+
+    def _verify_once(
+        self, drafts: Dict[int, List[int]], tables: np.ndarray, sub
+    ) -> int:
+        """One draft→verify→rollback iteration: score every lane's run
+        in a single forward pass, append the accepted tokens, truncate
+        each table past its rolled-back write position.  Returns tokens
+        emitted."""
+        import jax.numpy as jnp
+
+        width = self._width_for(max(len(p) for p in drafts.values()))
+        tok_in = np.zeros((self.slots, width), np.int32)
+        tok_in[:, 0] = self._tok
+        n_tok = np.ones(self.slots, np.int32)
+        for slot, prop in drafts.items():
+            tok_in[slot, 1 : 1 + len(prop)] = prop
+            n_tok[slot] = 1 + len(prop)
+        out, n_emit, self._pool = self._get_verify(width)(
             self._params,
             self._pool,
             jnp.asarray(tables),
-            jnp.asarray(self._tok),
+            jnp.asarray(tok_in),
             jnp.asarray(self._pos),
+            jnp.asarray(n_tok),
             jnp.asarray(self._active),
             jnp.asarray(self._temps),
             sub,
             self._qweights,
         )
-        toks = np.asarray(toks)  # host sync — the loop's one device read
-        n_live = int(self._active.sum())
+        out = np.asarray(out)  # host sync — the loop's one device read
+        n_emit = np.asarray(n_emit)
+        emitted = 0
+        n_proposed = n_accepted = 0
+        observe = getattr(self.stats_registry, "observe", None)
         for slot in np.nonzero(self._active)[0]:
             slot = int(slot)
             req = self._slot_req[slot]
-            tok = int(toks[slot])
-            self._pos[slot] += 1
-            self._tok[slot] = tok
-            self._emit(slot, req, tok)
+            e = int(n_emit[slot])
+            prop = drafts.get(slot)
+            if prop is not None:
+                n_proposed += len(prop)
+                n_accepted += e - 1
+                if observe is not None:
+                    observe("serving.spec_accept_len", float(e - 1))
+            self._pos[slot] += e
+            self._tok[slot] = int(out[slot, e - 1])
+            # Rollback: rows past the accept run are garbage; blocks
+            # wholly beyond the next write position go back to the pool.
+            truncate_table(
+                self._tables[slot],
+                self.block_allocator,
+                int(self._pos[slot]),
+                self.block_size,
+            )
+            for j in range(e):
+                self._emit(slot, req, int(out[slot, j]))
+                emitted += 1
+                if req.done.is_set():
+                    break  # eos/budget retired the slot mid-run
         with self._stats_lock:
-            self._n_steps += 1
-            self._window.append((time.time(), n_live))
-        # The step advances every live slot one token, so its wall time IS
-        # the per-token decode latency each of those requests observed.
-        step_dt = time.perf_counter() - t0
-        self.stats_registry.timing("serving.decode_step_s", step_dt)
-        self.stats_registry.observe("serving.batch_occupancy", float(n_live))
-        self._ledger_account(step_dt, n_live / self.slots, tokens=n_live)
-        self._record_gauges()
-        if self._ready.is_set():
-            self._capture.on_step(self._n_steps)
-        self._progress.beat(step=self._n_steps)
+            self._spec_steps += 1
+            self._spec_proposed += n_proposed
+            self._spec_accepted += n_accepted
+        incr = getattr(self.stats_registry, "incr", None)
+        if incr is not None:
+            if n_proposed:
+                incr("serving.spec_proposed_total", n_proposed)
+            if n_accepted:
+                incr("serving.spec_accepted_total", n_accepted)
+        return emitted
 
     def _record_gauges(self) -> None:
         """Refresh paging gauges + backlog counters (scheduler thread)."""
@@ -1245,11 +1614,21 @@ class ServingEngine:
             round(pc.hit_rate, 6) if pc is not None else 0.0,
         )
         gauge("serving.prefill_backlog_chunks", float(backlog))
+        if self.spec_decode:
+            with self._stats_lock:
+                proposed, accepted = self._spec_proposed, self._spec_accepted
+            gauge(
+                "serving.spec_accept_rate",
+                round(accepted / proposed, 6) if proposed else 0.0,
+            )
 
     def _emit(self, slot: int, req: GenerationRequest, tok: int) -> None:
         """Record one generated token; retire the slot when done."""
         if req.first_token_at is None:
             req.first_token_at = time.time()
+        drafter = self._drafters[slot]
+        if drafter is not None:
+            drafter.append(tok)  # accepted tokens extend the suffix index
         req.tokens.append(tok)
         req.stream.put(tok)
         with self._stats_lock:
@@ -1277,6 +1656,7 @@ class ServingEngine:
             self._parked.remove(slot)
         self._release_slot_blocks(slot)
         self._slot_req[slot] = None
+        self._drafters[slot] = None
         self.allocator.free(slot)
         with self._stats_lock:
             self._n_finished += 1
@@ -1292,6 +1672,7 @@ class ServingEngine:
             self._parked.remove(slot)
         self._release_slot_blocks(slot)
         self._slot_req[slot] = None
+        self._drafters[slot] = None
         self.allocator.free(slot)
         if req is not None and not req.done.is_set():
             req.error = msg

@@ -63,6 +63,9 @@ class LocalServingFleet:
         block_size: int = 16,
         kv_blocks: Optional[int] = None,
         seed: int = 0,
+        spec_decode: Optional[bool] = None,
+        spec_k: Optional[int] = None,
+        spec_min_ngram: Optional[int] = None,
         request_timeout_s: float = 600.0,
         host: str = "127.0.0.1",
         router: Optional[FleetRouter] = None,
@@ -83,6 +86,11 @@ class LocalServingFleet:
         self.block_size = block_size
         self.kv_blocks = kv_blocks
         self.seed = seed
+        # Speculative decoding rides the replica spec (None = the
+        # replica's own POLYAXON_TPU_SERVING_SPEC_* knob defaults).
+        self.spec_decode = spec_decode
+        self.spec_k = spec_k
+        self.spec_min_ngram = spec_min_ngram
         self.request_timeout_s = request_timeout_s
         self.host = host
         self.env = dict(env or {})
@@ -113,6 +121,9 @@ class LocalServingFleet:
             "slots": self.slots,
             "block_size": self.block_size,
             "kv_blocks": self.kv_blocks,
+            "spec_decode": self.spec_decode,
+            "spec_k": self.spec_k,
+            "spec_min_ngram": self.spec_min_ngram,
             "request_timeout_s": self.request_timeout_s,
         }
         spec_path = self.workdir / f"{name}.json"

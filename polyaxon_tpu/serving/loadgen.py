@@ -165,6 +165,55 @@ def shared_prefix_prompts(
     return prompts
 
 
+def templated_prompts(
+    n: int,
+    vocab_size: int,
+    *,
+    n_templates: int = 4,
+    header_len: int = 16,
+    motif_len: int = 4,
+    rows: int = 4,
+    field_len: int = 2,
+    seed: int = 0,
+) -> List[List[int]]:
+    """``n`` prompts from ``n_templates`` template families with high
+    n-gram SELF-overlap — the traffic class speculative decoding's
+    prompt-lookup drafter wins on.
+
+    Each family fixes a ``header_len``-token header (shared across the
+    family, so prefix caching composes) and a ``motif_len``-token record
+    motif; each prompt is the header followed by ``rows`` records of
+    ``motif + private fields`` (``field_len`` tokens drawn per prompt).
+    The motif recurring every record gives the drafter's suffix index
+    repeated n-grams to match mid-generation, the way real templated
+    traffic (forms, logs, structured extraction) repeats boilerplate.
+    Fully determined by ``seed`` — an A/B offers byte-identical prompts
+    to both arms.
+    """
+    if n <= 0 or n_templates <= 0:
+        raise ValueError(
+            f"need n > 0 and n_templates > 0, got n={n} "
+            f"n_templates={n_templates}"
+        )
+    rng = np.random.default_rng(seed)
+    templates = [
+        (
+            rng.integers(0, vocab_size, size=header_len).tolist(),
+            rng.integers(0, vocab_size, size=motif_len).tolist(),
+        )
+        for _ in range(n_templates)
+    ]
+    prompts = []
+    for i in range(n):
+        header, motif = templates[i % n_templates]
+        body: List[int] = []
+        for _ in range(rows):
+            body += motif
+            body += rng.integers(0, vocab_size, size=field_len).tolist()
+        prompts.append(header + body)
+    return prompts
+
+
 def _fire_one(
     base: str,
     prompt: Sequence[int],

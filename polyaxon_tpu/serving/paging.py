@@ -92,6 +92,42 @@ class BlockAllocator:
         return self.num_blocks - 1 - len(self._free)
 
 
+def truncate_table(
+    table, allocator: BlockAllocator, next_pos: int, block_size: int
+) -> int:
+    """Speculative-decoding rollback: trim a slot's block table to the
+    blocks a sequence whose next write lands at ``next_pos`` still needs.
+
+    A verify step writes KV rows for every drafted token before knowing
+    which ones the model accepts; when the accept run stops short, the
+    tail rows are garbage.  Rows sharing the next-write block are simply
+    overwritten in place (and masked out of attention until then), but
+    blocks that lie ENTIRELY beyond ``next_pos`` hold nothing the
+    sequence will read before rewriting — so this drops one reference on
+    each (``table`` entries after the block containing ``next_pos``,
+    reset to -1) and returns how many references were dropped.
+
+    Uses ``decref``, never a force-free: a dropped block returns to the
+    free list only when no other holder remains, so prefix-cache shares
+    and COW invariants survive rollback by construction.  (In practice
+    the trimmed blocks are always private — they were faulted for this
+    lane's own draft span, past the prompt blocks sharing could cover.)
+
+    ``table`` is the engine's host-side row (a mutable int array,
+    -1 = unset), mutated in place.
+    """
+    keep = int(next_pos) // int(block_size)
+    freed = 0
+    for bi in range(keep + 1, len(table)):
+        block = int(table[bi])
+        if block < 0:
+            break  # tables fill contiguously; nothing set past here
+        allocator.decref(block)
+        table[bi] = -1
+        freed += 1
+    return freed
+
+
 class PrefixCache:
     """Block-granular shared-prefix cache over a :class:`BlockAllocator`.
 

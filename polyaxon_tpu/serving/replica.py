@@ -46,6 +46,9 @@ def serve(spec: dict) -> None:
 
     kv_blocks = spec.get("kv_blocks")
     prefill_chunk = int(spec.get("prefill_chunk", 0) or 0)
+    spec_decode = spec.get("spec_decode")
+    spec_k = spec.get("spec_k")
+    spec_min_ngram = spec.get("spec_min_ngram")
     engine = ServingEngine(
         params,
         cfg,
@@ -55,6 +58,11 @@ def serve(spec: dict) -> None:
         num_blocks=int(kv_blocks) if kv_blocks is not None else None,
         prefill_chunk=prefill_chunk if prefill_chunk > 0 else None,
         seed=int(spec.get("seed", 0)),
+        spec_decode=bool(spec_decode) if spec_decode is not None else None,
+        spec_k=int(spec_k) if spec_k is not None else None,
+        spec_min_ngram=(
+            int(spec_min_ngram) if spec_min_ngram is not None else None
+        ),
     ).start()
 
     meta = {

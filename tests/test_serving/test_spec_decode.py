@@ -1,0 +1,355 @@
+"""Speculative decoding on the paged engine: self-drafting multi-token
+steps with block-table rollback.
+
+The acceptance bar: GREEDY outputs with speculation ON are
+token-identical to sequential ``generate()`` — through the f32 pool, the
+int8 pool, and prefix-cache hits — while the K-bucketed verify family
+compiles only at warmup (``steady_state_compiles`` stays 0) and the
+drafter genuinely lands multi-token accepts.  Plus the pieces in
+isolation: the prompt-lookup drafter's self-match exclusion, the verify
+kernel's row-wise argmax parity with the sequential step (including the
+garbage-draft invariance that underwrites rollback), ``truncate_table``'s
+decref-only trim, the sampled-request fallback in a mixed batch, and the
+templated traffic class's determinism.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models import TransformerConfig, decode, init_params
+from polyaxon_tpu.serving import (
+    BlockAllocator,
+    NgramDrafter,
+    ServingEngine,
+    truncate_table,
+)
+from polyaxon_tpu.serving.loadgen import templated_prompts
+
+CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    head_dim=8,
+    d_ff=64,
+    max_seq=48,
+    dtype=jnp.float32,
+)
+# Seed 2: this config's greedy continuations settle into a short cycle,
+# so the prompt-lookup drafter reliably lands accepts — speculation gets
+# EXERCISED (multi-token steps, rejections, rollback), not just compiled.
+KEY = jax.random.PRNGKey(2)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(KEY, CFG)
+
+
+def _ref(params, prompt, max_new):
+    out = decode.generate(
+        params, jnp.asarray([prompt]), CFG, max_new_tokens=max_new
+    )
+    return np.asarray(out)[0].tolist()
+
+
+class TestNgramDrafter:
+    def test_draft_uses_previous_occurrence_not_self(self):
+        d = NgramDrafter(2)
+        d.extend([1, 2, 3, 9, 1, 2])
+        # The suffix (1, 2) is its own latest occurrence; the draft must
+        # come from the PREVIOUS one — the continuation after index 2.
+        assert d.draft(2) == [3, 9]
+
+    def test_draft_runs_through_to_the_present(self):
+        d = NgramDrafter(2)
+        d.extend([5, 6, 7, 5, 6])
+        # Continuation of the earlier (5, 6) reaches the context's end.
+        assert d.draft(10) == [7, 5, 6]
+
+    def test_most_recent_prior_occurrence_wins(self):
+        d = NgramDrafter(2)
+        d.extend([1, 2, 3, 1, 2, 4, 1, 2])
+        # Three occurrences of (1, 2); drafting follows the latest
+        # non-self one (ending at 5), not the stale first.
+        assert d.draft(3) == [4, 1, 2]
+
+    def test_no_match_and_short_context_return_empty(self):
+        d = NgramDrafter(3)
+        d.extend([1, 2])
+        assert d.draft(4) == []  # context shorter than n
+        d.append(3)
+        assert d.draft(4) == []  # (1,2,3) occurs only once (itself)
+        assert d.draft(0) == []  # k < 1 never proposes
+
+    def test_incremental_append_matches_bulk_extend(self):
+        toks = [7, 1, 7, 1, 7, 2, 7, 1]
+        a = NgramDrafter(2)
+        a.extend(toks)
+        b = NgramDrafter(2)
+        for t in toks:
+            b.append(t)
+        assert a.draft(5) == b.draft(5)
+
+    def test_bad_ngram_length_rejected(self):
+        with pytest.raises(ValueError, match="positive"):
+            NgramDrafter(0)
+
+
+class TestTruncateTable:
+    def test_frees_blocks_entirely_beyond_next_pos(self):
+        a = BlockAllocator(8)
+        table = [a.alloc(), a.alloc(), a.alloc(), -1]
+        # next_pos 6 lives in logical block 1 (bs=4): block 2 is dead.
+        freed = truncate_table(table, a, next_pos=6, block_size=4)
+        assert freed == 1
+        assert table[2] == -1 and table[1] >= 0
+        assert a.n_used == 2
+
+    def test_block_boundary_keeps_the_next_write_block(self):
+        a = BlockAllocator(8)
+        table = [a.alloc(), a.alloc(), a.alloc(), -1]
+        # next_pos 8 writes INTO logical block 2: nothing to free.
+        assert truncate_table(table, a, next_pos=8, block_size=4) == 0
+        assert table[2] >= 0 and a.n_used == 3
+
+    def test_shared_block_is_decrefed_never_force_freed(self):
+        a = BlockAllocator(8)
+        b0, b1, b2 = a.alloc(), a.alloc(), a.alloc()
+        a.incref(b2)  # another holder (a prefix-cache share, say)
+        table = [b0, b1, b2, -1]
+        # next_pos 3 still writes into block 0: blocks 1 and 2 are dead.
+        assert truncate_table(table, a, next_pos=3, block_size=4) == 2
+        assert a.refcount(b1) == 0  # private: freed
+        assert a.refcount(b2) == 1  # shared: still alive for its holder
+
+
+class TestVerifyKernelParity:
+    """paged_verify_step row j's argmax == the j-th sequential
+    paged_decode_step's — the property the engine's accept rule and the
+    greedy parity guarantee both stand on."""
+
+    BS, W, N_GEN = 4, 12, 6
+
+    def _prefill(self, params, prompt, kvq):
+        pool = decode.init_block_pool(CFG, 1 + self.W, self.BS, kv_dtype=kvq)
+        table = jnp.arange(1, self.W + 1, dtype=jnp.int32)
+        chunk_fn = jax.jit(decode.paged_prefill_chunk, static_argnums=(6,))
+        logits, pool = chunk_fn(
+            params, pool, table, jnp.asarray(prompt, jnp.int32),
+            jnp.int32(0), jnp.int32(len(prompt)), CFG,
+        )
+        return pool, table, int(np.argmax(np.asarray(logits)))
+
+    @pytest.mark.parametrize("kvq", [None, "int8"], ids=["f32", "int8kv"])
+    @pytest.mark.parametrize("qw", [False, True], ids=["f32w", "int8w"])
+    def test_verify_rows_match_sequential_steps(self, params, kvq, qw):
+        qweights = decode.quantize_weights(params) if qw else None
+        prompt = [3, 7] * 4
+        step_fn = jax.jit(decode.paged_decode_step, static_argnums=(6,))
+        verify_fn = jax.jit(decode.paged_verify_step, static_argnums=(7,))
+
+        # Sequential reference chain through the paged pool.
+        pool, table, tok = self._prefill(params, prompt, kvq)
+        ref, pos = [tok], len(prompt)
+        while len(ref) < 1 + self.N_GEN:
+            logits, pool = step_fn(
+                params, pool, table[None],
+                jnp.asarray([ref[-1]], jnp.int32),
+                jnp.asarray([pos], jnp.int32),
+                jnp.asarray([True]), CFG, qweights,
+            )
+            ref.append(int(np.argmax(np.asarray(logits[0]))))
+            pos += 1
+
+        # One verify call fed the true greedy chain as its draft: every
+        # row's argmax must reproduce the matching sequential step.
+        pool, table, tok = self._prefill(params, prompt, kvq)
+        toks = jnp.asarray([[tok] + ref[1 : 1 + self.N_GEN]], jnp.int32)
+        vlogits, _ = verify_fn(
+            params, pool, table[None], toks,
+            jnp.asarray([len(prompt)], jnp.int32),
+            jnp.asarray([1 + self.N_GEN], jnp.int32),
+            jnp.asarray([True]), CFG, qweights,
+        )
+        got = np.argmax(np.asarray(vlogits[0]), axis=-1).tolist()
+        assert got[: self.N_GEN] == ref[1 : 1 + self.N_GEN]
+
+    def test_row0_invariant_under_garbage_draft(self, params):
+        """A rejected draft must not disturb the tokens the engine DOES
+        emit: row 0 attends only to positions <= its own, so its argmax
+        is identical whatever garbage fills the draft rows — this is
+        what makes rollback purely a host-side bookkeeping operation."""
+        prompt = [3, 7] * 4
+        verify_fn = jax.jit(decode.paged_verify_step, static_argnums=(7,))
+        outs = []
+        for draft in ([0, 0, 0], [63, 1, 42]):
+            pool, table, tok = self._prefill(params, prompt, None)
+            vlogits, _ = verify_fn(
+                params, pool, table[None],
+                jnp.asarray([[tok] + draft], jnp.int32),
+                jnp.asarray([len(prompt)], jnp.int32),
+                jnp.asarray([4], jnp.int32),
+                jnp.asarray([True]), CFG, None,
+            )
+            outs.append(np.asarray(vlogits[0, 0]))
+        np.testing.assert_array_equal(outs[0], outs[1])
+
+
+class TestEngineSpecParity:
+    def test_greedy_parity_with_spec_on_and_zero_steady_compiles(
+        self, params, monkeypatch
+    ):
+        """The headline acceptance test: warmup compiles the whole
+        verify-width family up front, a mixed wave of templated and
+        random prompts decodes token-identical to ``generate()``, the
+        drafter lands real accepts, and nothing compiles post-warmup."""
+        monkeypatch.setenv("POLYAXON_TPU_SERVING_WARMUP", "1")
+        eng = ServingEngine(
+            params, CFG, slots=2, max_len=48,
+            block_size=4, prefix_cache=False, warmup=True,
+            spec_decode=True, spec_k=4, spec_min_ngram=2,
+        ).start()
+        try:
+            assert eng.wait_ready(timeout=300)
+            rng = np.random.default_rng(31)
+            wave = [
+                ([3, 7] * 4, 20),
+                (list(rng.integers(0, 64, 10)), 24),
+                ([5, 9, 11] * 3, 16),
+            ]
+            for prompt, mn in wave:
+                assert eng.submit(prompt, mn).wait(timeout=120) == _ref(
+                    params, prompt, mn
+                )
+            s = eng.stats()
+            assert s["steady_state_compiles"] == 0
+            assert s["spec_decode"] is True
+            assert s["spec_steps"] > 0, "no multi-token verify step ran"
+            assert s["spec_proposed_total"] > 0
+            assert s["spec_accepted_total"] > 0, "drafter never landed"
+            assert 0.0 < s["spec_accept_rate"] <= 1.0
+            # Rollback bookkeeping: every block came home.
+            assert s["blocks_free"] == s["blocks_total"]
+        finally:
+            eng.stop()
+
+    def test_int8_pool_spec_matches_int8_pool_plain(self, params):
+        """Speculation composes with the int8 KV pool: same quantized
+        numerics path, so spec-on output is token-identical to the
+        spec-off int8 engine (the int8 engines' own parity baseline)."""
+        prompts = [([3, 7] * 4, 16), ([2, 4, 6] * 3, 12)]
+
+        def run(spec):
+            eng = ServingEngine(
+                params, CFG, slots=2, max_len=48, block_size=4,
+                prefix_cache=False, kv_quantize="int8",
+                spec_decode=spec, spec_k=4, spec_min_ngram=2,
+            ).start()
+            try:
+                return [
+                    eng.submit(p, mn).wait(timeout=120) for p, mn in prompts
+                ]
+            finally:
+                eng.stop()
+
+        assert run(True) == run(False)
+
+    def test_prefix_cache_hits_compose_with_spec(self, params):
+        """A duplicate prompt reuses cached blocks (COW) and STILL
+        decodes token-identical with speculation on: rollback's
+        decref-only trim never touched the shared prefix blocks."""
+        prompt = [3, 7] * 8  # exactly four 4-blocks: full-hit bait
+        ref = _ref(params, prompt, 8)
+        eng = ServingEngine(
+            params, CFG, slots=2, max_len=48,
+            block_size=4, prefix_cache=True,
+            spec_decode=True, spec_k=4, spec_min_ngram=2,
+        ).start()
+        try:
+            assert eng.submit(prompt, 8).wait(timeout=120) == ref
+            assert eng.submit(prompt, 8).wait(timeout=120) == ref
+            assert eng.submit(prompt, 8).wait(timeout=120) == ref
+            s = eng.stats()
+            assert eng.prefix_cache.hits >= 1
+            assert s["spec_accepted_total"] > 0
+        finally:
+            eng.stop()
+
+    def test_sampled_requests_fall_back_in_a_mixed_batch(self, params):
+        """temperature > 0 rides along as single-token rows: the greedy
+        neighbor keeps exact parity, the sampled request completes with
+        in-vocabulary tokens, and the fallback is counted and typed."""
+        eng = ServingEngine(
+            params, CFG, slots=2, max_len=48,
+            block_size=4, prefix_cache=False,
+            spec_decode=True, spec_k=4, spec_min_ngram=2,
+        ).start()
+        try:
+            greedy_p = [3, 7] * 4
+            ra = eng.submit(greedy_p, 16)
+            rb = eng.submit([1, 2, 3, 4, 5], 16, temperature=0.9)
+            out_a = ra.wait(timeout=120)
+            out_b = rb.wait(timeout=120)
+            assert out_a == _ref(params, greedy_p, 16)
+            assert len(out_b) == 16
+            assert all(0 <= t < CFG.vocab_size for t in out_b)
+            assert ra.spec_mode == "greedy"
+            assert rb.spec_mode == "fallback:sampled"
+            s = eng.stats()
+            assert s["spec_fallback_total"] == 1
+            assert s["blocks_free"] == s["blocks_total"]
+        finally:
+            eng.stop()
+
+    def test_spec_off_engine_reports_inert_counters(self, params):
+        eng = ServingEngine(params, CFG, slots=1, max_len=48)
+        try:
+            s = eng.stats()
+            assert s["spec_decode"] is False
+            assert s["spec_proposed_total"] == 0
+            assert s["spec_accept_rate"] == 0.0
+        finally:
+            eng.stop()
+
+
+class TestTemplatedPrompts:
+    def test_deterministic_per_seed(self):
+        a = templated_prompts(8, 64, seed=5)
+        b = templated_prompts(8, 64, seed=5)
+        c = templated_prompts(8, 64, seed=6)
+        assert a == b
+        assert a != c
+
+    def test_shape_and_vocab(self):
+        ps = templated_prompts(
+            6, 64, n_templates=2, header_len=8, motif_len=3,
+            rows=4, field_len=2, seed=0,
+        )
+        assert len(ps) == 6
+        for p in ps:
+            assert len(p) == 8 + 4 * (3 + 2)
+            assert all(0 <= t < 64 for t in p)
+
+    def test_family_reuse_and_motif_repetition(self):
+        ps = templated_prompts(
+            4, 64, n_templates=2, header_len=8, motif_len=4,
+            rows=3, field_len=2, seed=1,
+        )
+        # Prompts 0 and 2 share a family: identical headers.
+        assert ps[0][:8] == ps[2][:8]
+        # The motif recurs every record — the drafter's food.
+        motif = tuple(ps[0][8:12])
+        body = ps[0][8:]
+        hits = sum(
+            1
+            for i in range(len(body) - 3)
+            if tuple(body[i : i + 4]) == motif
+        )
+        assert hits >= 3
+
+    def test_bad_args_rejected(self):
+        with pytest.raises(ValueError, match="n > 0"):
+            templated_prompts(0, 64)

@@ -315,6 +315,31 @@ class TestLedgerPipeline:
         # Latest row per process wins — 384 + 384, not the stale 1024.
         assert g["kv_pool_bytes"] == 768.0
 
+    def test_goodput_status_aggregates_spec_counters_from_extras(self, rig):
+        """Speculative-decoding engines ship proposed/accepted draft
+        counts under extras; /goodput recomputes the gang-wide accept
+        rate from the SUMS (never averages per-proc rates)."""
+        registry, watcher, handle = rig
+        _append(handle.paths, 0, [
+            _ledger_event(0, 1, 10.0, 8.0, final=True, extra={
+                "spec_proposed_total": 80, "spec_accepted_total": 60,
+            }),
+        ])
+        _append(handle.paths, 1, [
+            _ledger_event(1, 1, 10.0, 8.0, final=True, extra={
+                "spec_proposed_total": 20, "spec_accepted_total": 5,
+            }),
+        ])
+        watcher.ingest(handle)
+        g = goodput_status(registry, handle.run_id)
+        assert g["spec_accept_rate"] == pytest.approx(65 / 100)
+
+    def test_goodput_status_spec_rate_zero_without_proposals(self, rig):
+        registry, watcher, handle = rig
+        _append(handle.paths, 0, [_ledger_event(0, 1, 10.0, 8.0, final=True)])
+        watcher.ingest(handle)
+        assert goodput_status(registry, handle.run_id)["spec_accept_rate"] == 0.0
+
     def test_goodput_status_empty_until_rows_land(self, rig):
         registry, _, handle = rig
         g = goodput_status(registry, handle.run_id)
