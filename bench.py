@@ -1328,6 +1328,236 @@ def main() -> None:
 
         traceback.print_exc(file=sys.stderr)
 
+    # Hierarchical KV A/B: the host offload tier's AVAILABILITY claim at
+    # fixed HBM.  Same mix and seeded Poisson schedule as the loaded
+    # section, but the pool is ONE long span + one block — any two longs
+    # in flight (or a long mid-prefill beside a parked decoder) want ~2x
+    # the pool.  Offload OFF, a parked sequence sits on its blocks and
+    # contention resolves by deadlock-shedding; offload ON, parking
+    # SPILLS the blocks to pinned host memory, so the same schedule
+    # absorbs with zero sheds — oversubscription now costs restore
+    # latency (the bounded-TTFT number) instead of availability.
+    # warmup=True so the export/import fns compile before the clock
+    # starts: the gate includes steady_state_compiles == 0 WITH
+    # spill/restore active.
+    serving_kv_offload = None
+    try:
+        if serving_loaded is None:
+            raise RuntimeError(
+                "loaded serving section did not run; skipping KV offload A/B"
+            )
+        ob_bs = 16
+        ospan = -(-(long_len + lmax_new) // ob_bs)  # blocks one long spans
+        kv_blocks_sub = 1 + ospan + 1  # trash + one long span + one block
+        # 2x the loaded section's calibrated rate: the pool-contention
+        # window (two longs in flight) must open RELIABLY, not by
+        # arrival luck — at 0.6 utilization the off arm can dodge it.
+        orate = 2.0 * lrate
+
+        def offload_run(kv_offload):
+            eng = ServingEngine(
+                lparams, lcfg, slots=lslots, max_len=lcfg.max_seq,
+                block_size=ob_bs, num_blocks=kv_blocks_sub,
+                prefill_chunk=lchunk, prefix_cache=False, warmup=True,
+                kv_offload=kv_offload,
+            ).start()
+            try:
+                if not eng.wait_ready(timeout=600):
+                    raise RuntimeError("KV offload A/B warmup timed out")
+                res = poisson_load(
+                    eng, loaded_prompts, lmax_new, rate_rps=orate, seed=23
+                )
+                s = eng.stats()
+                for k in (
+                    "block_parks",
+                    "host_spilled_blocks_total",
+                    "host_restored_blocks_total",
+                    "steady_state_compiles",
+                ):
+                    res[k] = s[k]
+            finally:
+                eng.stop()
+            return res
+
+        kv_off = offload_run(False)
+        kv_on = offload_run(True)
+        usable = kv_blocks_sub - 1
+        serving_kv_offload = {  # [offload off, offload on]
+            "kv_blocks": kv_blocks_sub,
+            "long_span_blocks": ospan,
+            "oversubscription_x": round(2 * ospan / usable, 2),
+            "completed": [kv_off["completed"], kv_on["completed"]],
+            "sheds": [kv_off["sheds"], kv_on["sheds"]],
+            "errors": [kv_off["errors"], kv_on["errors"]],
+            "block_parks": [
+                kv_off["block_parks"], kv_on["block_parks"]
+            ],
+            "spilled_blocks": [
+                kv_off["host_spilled_blocks_total"],
+                kv_on["host_spilled_blocks_total"],
+            ],
+            "restored_blocks": [
+                kv_off["host_restored_blocks_total"],
+                kv_on["host_restored_blocks_total"],
+            ],
+            "ttft_p99_s": [kv_off["ttft_p99_s"], kv_on["ttft_p99_s"]],
+            "tokens_per_s": [
+                kv_off["tokens_per_s"], kv_on["tokens_per_s"]
+            ],
+            "steady_state_compiles": [
+                kv_off["steady_state_compiles"],
+                kv_on["steady_state_compiles"],
+            ],
+            "zero_sheds_ok": (
+                kv_on["sheds"] == 0
+                and kv_on["errors"] == 0
+                and kv_on["completed"] == n_loaded
+            ),
+            "spill_active_ok": (
+                kv_on["host_spilled_blocks_total"] > 0
+                and kv_on["steady_state_compiles"] == 0
+            ),
+            "offered_rps": round(orate, 2),
+            "n_requests": n_loaded,
+        }
+        if not (
+            serving_kv_offload["zero_sheds_ok"]
+            and serving_kv_offload["spill_active_ok"]
+        ):
+            import sys
+
+            print(
+                f"bench: serving_kv_offload gate failed: {serving_kv_offload}",
+                file=sys.stderr,
+            )
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+
+    # Warm replica boot: the persistent prefix store's TTFT claim.  An
+    # incumbent engine serves the shared prefixes once and persists its
+    # hot prefix blocks on stop; a COLD and a WARM replacement then face
+    # the IDENTICAL seeded schedule of prefix+tail traffic.  The warm
+    # replica preloaded the prefixes during warmup, so its first
+    # requests skip the long prefix prefill — exactly the chaos
+    # scale-up scenario (the autoscaler's replacement boots while the
+    # fleet is most loaded).  Greedy parity probes pin that the warm KV
+    # is the SAME KV: outputs warm vs cold must be token-identical.
+    serving_warm_boot = None
+    try:
+        import tempfile
+
+        if serving_loaded is None:
+            raise RuntimeError(
+                "loaded serving section did not run; skipping warm-boot A/B"
+            )
+        wb_bs = 16
+        n_pref, pref_len, tail_len, wb_max_new = 2, 240, 8, 4
+        wrng = np.random.default_rng(47)
+        wb_prefixes = [
+            [int(x) for x in wrng.integers(0, lcfg.vocab_size, pref_len)]
+            for _ in range(n_pref)
+        ]
+        wb_prompts = [
+            wb_prefixes[i % n_pref]
+            + [int(x) for x in wrng.integers(0, lcfg.vocab_size, tail_len)]
+            for i in range(12)
+        ]
+        wb_probe = wb_prefixes[0] + [3, 1, 4, 1, 5, 9, 2, 6]
+        wb_blocks = 96  # preload budget (96-1)//2 = 47 >= the 30 stored
+
+        def wb_engine(persist_dir):
+            return ServingEngine(
+                lparams, lcfg, slots=lslots, max_len=lcfg.max_seq,
+                block_size=wb_bs, num_blocks=wb_blocks,
+                prefill_chunk=lchunk, prefix_cache=True, warmup=True,
+                kv_persist_dir=persist_dir, kv_persist_sig="bench",
+                kv_persist_blocks=48,
+            )
+
+        with tempfile.TemporaryDirectory() as wb_dir:
+            # Incumbent: compute + persist the shared prefixes.
+            inc = wb_engine(wb_dir).start()
+            try:
+                if not inc.wait_ready(timeout=600):
+                    raise RuntimeError("warm-boot incumbent warmup timed out")
+                t0 = time.perf_counter()
+                for pref in wb_prefixes:
+                    inc.submit(list(pref), wb_max_new).wait(timeout=600)
+                wb_svc = (time.perf_counter() - t0) / n_pref
+            finally:
+                inc.stop()  # final persist happens here
+            wb_rate = 0.6 / wb_svc
+
+            def replacement_run(persist_dir):
+                eng = wb_engine(persist_dir).start()
+                try:
+                    if not eng.wait_ready(timeout=600):
+                        raise RuntimeError("warm-boot arm warmup timed out")
+                    preloaded = eng.stats()["kv_preloaded_blocks"]
+                    res = poisson_load(
+                        eng, wb_prompts, wb_max_new,
+                        rate_rps=wb_rate, seed=31,
+                    )
+                    res["kv_preloaded_blocks"] = preloaded
+                    res["prefix_cache_hit_rate"] = eng.stats()[
+                        "prefix_cache_hit_rate"
+                    ]
+                    res["probe_tokens"] = eng.submit(
+                        list(wb_probe), wb_max_new
+                    ).wait(timeout=600)
+                finally:
+                    eng.stop()
+                return res
+
+            cold = replacement_run(None)
+            warm = replacement_run(wb_dir)
+        token_identical = cold["probe_tokens"] == warm["probe_tokens"]
+        serving_warm_boot = {  # [cold boot, warm boot]
+            "kv_preloaded_blocks": [
+                cold["kv_preloaded_blocks"], warm["kv_preloaded_blocks"]
+            ],
+            "first_requests_ttft_p99_s": [
+                cold["ttft_p99_s"], warm["ttft_p99_s"]
+            ],
+            "first_requests_ttft_mean_s": [
+                cold["ttft_mean_s"], warm["ttft_mean_s"]
+            ],
+            "prefix_cache_hit_rate": [
+                cold["prefix_cache_hit_rate"], warm["prefix_cache_hit_rate"]
+            ],
+            "completed": [cold["completed"], warm["completed"]],
+            "errors": [cold["errors"], warm["errors"]],
+            "ttft_p99_speedup": (
+                round(cold["ttft_p99_s"] / warm["ttft_p99_s"], 2)
+                if warm["ttft_p99_s"] > 0
+                else None
+            ),
+            "token_identical": token_identical,
+            "warm_boot_ok": (
+                token_identical
+                and warm["kv_preloaded_blocks"] > 0
+                and cold["kv_preloaded_blocks"] == 0
+                and warm["ttft_p99_s"] < cold["ttft_p99_s"]
+            ),
+            "offered_rps": round(wb_rate, 2),
+            "n_requests": len(wb_prompts),
+        }
+        if not serving_warm_boot["warm_boot_ok"]:
+            import sys
+
+            print(
+                f"bench: serving_warm_boot gate failed: {serving_warm_boot}",
+                file=sys.stderr,
+            )
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+
     # Training input pipeline: the overlapped hot loop (host prefetch +
     # device prefetch + async metrics, runtime/pipeline.py) vs the same
     # loop fully synchronous, on a dataset-backed image-classifier config.
@@ -1938,6 +2168,8 @@ def main() -> None:
                 "serving_loaded_vs_baseline": serving_loaded_vs_baseline,
                 "serving_spec_decode": serving_spec_decode,
                 "serving_spec_vs_baseline": serving_spec_vs_baseline,
+                "serving_kv_offload": serving_kv_offload,
+                "serving_warm_boot": serving_warm_boot,
                 "serving_fleet_tokens_per_s": serving_fleet,
                 "serving_fleet_vs_baseline": serving_fleet_vs_baseline,
                 "serving_fleet_failover": serving_fleet_failover,

@@ -370,9 +370,15 @@ def lm_server(ctx: Context) -> None:
     ``eos_id`` (retire a slot early on this token), ``host``,
     ``quantize`` (``int8`` weight-only decode), ``spec_decode`` /
     ``spec_k`` / ``spec_min_ngram`` (speculative decoding: self-drafted
-    multi-token steps for greedy requests — see docs/serving.md).  The
-    decode step's shapes depend only on (slots, pool size) —
-    steady-state serving never recompiles.
+    multi-token steps for greedy requests — see docs/serving.md),
+    ``kv_offload`` / ``kv_offload_blocks`` (pinned-host KV tier: parked
+    sequences spill blocks to host instead of holding the pool, cold
+    prefixes demote instead of evicting), ``kv_persist`` /
+    ``kv_persist_dir`` (persist hot prefix blocks to the shared store's
+    ``kv_cache/`` dir so replacement/scale-up replicas boot
+    prefix-warm; ``kv_persist: true`` defaults the dir from the store
+    layout).  The decode step's shapes depend only on (slots, pool
+    size) — steady-state serving never recompiles.
     """
     import jax
 
@@ -477,6 +483,34 @@ def lm_server(ctx: Context) -> None:
             f"lm_server: speculative decoding enabled "
             f"(spec_k={spec_k}, spec_min_ngram={spec_min_ngram})"
         )
+    kv_offload = ctx.get_param("kv_offload")
+    kv_offload = (
+        None
+        if kv_offload is None
+        else str(kv_offload).lower() not in ("0", "false", "no", "")
+    )
+    kv_offload_blocks = ctx.get_param("kv_offload_blocks")
+    kv_persist_dir = ctx.get_param("kv_persist_dir")
+    if kv_persist_dir is None and str(
+        ctx.get_param("kv_persist", "") or ""
+    ).lower() in ("1", "true", "yes"):
+        # Default the persist dir from the shared store layout: runs/
+        # sits under the layout base, and kv_cache/ beside it (see
+        # StoreLayout.kv_cache_dir) — every replica of a fleet lands on
+        # the same store, which is what makes warm boot work.
+        runs_root = ctx.runs_root or ctx.outputs_path.parent.parent
+        kv_persist_dir = runs_root.parent / "kv_cache"
+    # Weight identity for the persisted KV fingerprint: prefix blocks
+    # are only reusable under the exact weights (and weight-quantize
+    # mode) that produced them.
+    kv_persist_sig = (
+        f"ckpt:{target}:{step}" if target is not None
+        else f"random:{ctx.seed or 0}"
+    ) + (":wq-int8" if qweights is not None else "")
+    if kv_offload:
+        ctx.log_text("lm_server: host KV offload tier enabled")
+    if kv_persist_dir:
+        ctx.log_text(f"lm_server: prefix KV persistence at {kv_persist_dir}")
     engine = ServingEngine(
         params,
         cfg,
@@ -497,6 +531,12 @@ def lm_server(ctx: Context) -> None:
         spec_min_ngram=(
             int(spec_min_ngram) if spec_min_ngram is not None else None
         ),
+        kv_offload=kv_offload,
+        kv_offload_blocks=(
+            int(kv_offload_blocks) if kv_offload_blocks is not None else None
+        ),
+        kv_persist_dir=str(kv_persist_dir) if kv_persist_dir else None,
+        kv_persist_sig=kv_persist_sig,
         # The process-wide registry: /metrics then also exports anything
         # else this worker records (pipeline waits, task timings).
         stats=stats_backends.get_stats(),

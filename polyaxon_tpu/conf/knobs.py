@@ -189,6 +189,24 @@ _ALL: List[Knob] = [
     Knob("POLYAXON_TPU_SERVING_SPEC_MIN_NGRAM", "int", 2,
          "n-gram length the prompt-lookup drafter matches against the "
          "request's own context", "serving"),
+    # -- hierarchical KV (host offload tier + persistent prefix store) -----
+    Knob("POLYAXON_TPU_KV_OFFLOAD", "bool", False,
+         "host-memory KV tier: parked sequences spill their private "
+         "blocks to host and cold prefixes demote instead of evicting",
+         "kv-tier"),
+    Knob("POLYAXON_TPU_KV_OFFLOAD_BLOCKS", "int", 0,
+         "host-tier budget for DEMOTED prefix blocks (0 = unbounded; "
+         "parked-sequence spills are pinned and never count)", "kv-tier"),
+    Knob("POLYAXON_TPU_KV_PERSIST_DIR", "str", "",
+         "prefix-store directory ('' = persistence off); normally the "
+         "store layout's kv_cache/ dir so every replica shares it",
+         "kv-tier"),
+    Knob("POLYAXON_TPU_KV_PERSIST_BLOCKS", "int", 64,
+         "max prefix blocks per persisted snapshot (hottest-first with "
+         "chain closure)", "kv-tier"),
+    Knob("POLYAXON_TPU_KV_PERSIST_INTERVAL_S", "float", 60.0,
+         "min spacing of idle-time prefix-store snapshots (stop() "
+         "always writes a final one)", "kv-tier"),
     # -- fleet router (control-plane request routing) ----------------------
     Knob("POLYAXON_TPU_ROUTER_PROBE_INTERVAL_S", "float", 1.0,
          "health/stats probe cadence per replica (s)", "router"),

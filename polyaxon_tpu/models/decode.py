@@ -495,6 +495,47 @@ def copy_block(
     return out
 
 
+def export_block(
+    pool: Dict[str, jax.Array], src: jax.Array
+) -> Dict[str, jax.Array]:
+    """Slice one physical block's KV rows (all layers) OUT of the pool —
+    the device→host half of the hierarchical-KV spill path.  ``src`` is a
+    traced scalar, so every spill reuses one compilation.  Returns
+    ``{leaf: [L, block_size, ...]}`` in the pool's own storage dtypes
+    (an int8 pool exports int8 rows + f32 scales), so a spilled block's
+    payload is the block's bits, never a requantization.
+
+    Jit this WITHOUT donation: the engine donates the pool to every
+    subsequent step/chunk/import call, and a non-donating jitted slice
+    returns independent buffers — the runtime orders the read before any
+    later donated write, so the device→host copy can drain asynchronously
+    while serving moves on (materialize with ``np.asarray`` when the
+    payload is actually needed)."""
+    return {
+        name: lax.dynamic_slice_in_dim(leaf, src, 1, axis=1)[:, 0]
+        for name, leaf in pool.items()
+    }
+
+
+def import_block(
+    pool: Dict[str, jax.Array],
+    data: Dict[str, jax.Array],
+    dst: jax.Array,
+) -> Dict[str, jax.Array]:
+    """Write an :func:`export_block` payload back into the pool at block
+    ``dst`` — the host→device half of spill/restore.  ``dst`` is a traced
+    scalar and ``data`` leaves keep the pool's storage dtypes, so the
+    round trip is bit-exact for both pool layouts (values never
+    requantize; only the block's address changes).  Jit with the pool
+    donated, like every other pool-mutating fn."""
+    out = {}
+    for name, leaf in pool.items():
+        blk = jnp.expand_dims(data[name].astype(leaf.dtype), 1)
+        idx = (0, dst) + (0,) * (leaf.ndim - 2)
+        out[name] = lax.dynamic_update_slice(leaf, blk, idx)
+    return out
+
+
 def paged_prefill_chunk(
     params: Dict[str, Any],
     pool: Dict[str, jax.Array],

@@ -20,6 +20,7 @@ from polyaxon_tpu.serving.engine import (
 )
 from polyaxon_tpu.serving.paging import (
     BlockAllocator,
+    HostKVTier,
     PrefixCache,
     truncate_table,
 )
@@ -41,6 +42,7 @@ __all__ = [
     "EngineDrainingError",
     "FleetAutoscaler",
     "GenerationRequest",
+    "HostKVTier",
     "NgramDrafter",
     "PrefixCache",
     "ServingEngine",

@@ -55,9 +55,10 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from polyaxon_tpu.conf.knobs import knob_bool, knob_int
+from polyaxon_tpu.conf.knobs import knob_bool, knob_float, knob_int, knob_str
 from polyaxon_tpu.serving.paging import (
     BlockAllocator,
+    HostKVTier,
     PrefixCache,
     truncate_table,
 )
@@ -289,6 +290,31 @@ class ServingEngine:
         model's own argmax run); temperature>0 requests transparently
         fall back to single-token rows (``spec_fallback_total``).
         Defaults read the ``POLYAXON_TPU_SERVING_SPEC_*`` knobs (off).
+    kv_offload / kv_offload_blocks : the host-memory KV tier.  When on,
+        a parked sequence's private blocks spill to host memory (pinned
+        — parking RELEASES pool capacity instead of sitting on it, so
+        oversubscription costs restore latency instead of sheds) and
+        cold prefix-cache entries DEMOTE to the tier instead of hard-
+        evicting (a later hit restores them through a fresh block; the
+        verify-on-hit token compare is unchanged).  Both copies move the
+        pool's storage leaves bit-exact — an int8 pool spills int8 rows
+        + scales, values never requantize.  ``kv_offload_blocks`` bounds
+        the DEMOTED population (LRU drop; 0 = unbounded); pinned spills
+        never count.  Defaults read ``POLYAXON_TPU_KV_OFFLOAD`` /
+        ``POLYAXON_TPU_KV_OFFLOAD_BLOCKS``.
+    kv_persist_dir / kv_persist_blocks / kv_persist_sig : the persistent
+        prefix store (``serving/kvstore.py``; point ``kv_persist_dir``
+        at ``StoreLayout.kv_cache_dir``).  The engine snapshots its
+        hottest ``kv_persist_blocks`` prefix blocks — torn-write-safe,
+        idle-time throttled by ``POLYAXON_TPU_KV_PERSIST_INTERVAL_S``,
+        plus a final snapshot on ``stop()`` — and warmup preloads the
+        newest complete snapshot before the ready gate opens, so a
+        replacement/scale-up replica serves its first request
+        prefix-warm.  ``kv_persist_sig`` is the model-identity
+        fingerprint stored with (and required of) a snapshot: pass
+        something that changes with the weights (seed, checkpoint step)
+        so a replica never preloads KV another model computed.
+        Defaults read the ``POLYAXON_TPU_KV_PERSIST_*`` knobs (off).
     stats : a stats backend receiving latency histograms
         (``serving.queue_wait_s`` / ``serving.ttft_s`` /
         ``serving.decode_step_s`` / ``serving.batch_occupancy``) and
@@ -331,6 +357,11 @@ class ServingEngine:
         spec_decode: Optional[bool] = None,
         spec_k: Optional[int] = None,
         spec_min_ngram: Optional[int] = None,
+        kv_offload: Optional[bool] = None,
+        kv_offload_blocks: Optional[int] = None,
+        kv_persist_dir: Optional[str] = None,
+        kv_persist_blocks: Optional[int] = None,
+        kv_persist_sig: str = "",
     ) -> None:
         import jax
 
@@ -462,6 +493,48 @@ class ServingEngine:
         self._spec_fallbacks = 0
         self._spec_steps = 0
 
+        # Hierarchical KV: the host offload tier and the persistent
+        # prefix store, both defaulting from the POLYAXON_TPU_KV_* knobs.
+        if kv_offload is None:
+            kv_offload = knob_bool("POLYAXON_TPU_KV_OFFLOAD")
+        self.kv_offload = bool(kv_offload)
+        self.kv_offload_blocks = int(
+            kv_offload_blocks if kv_offload_blocks is not None
+            else knob_int("POLYAXON_TPU_KV_OFFLOAD_BLOCKS")
+        )
+        if kv_persist_dir is None:
+            kv_persist_dir = knob_str("POLYAXON_TPU_KV_PERSIST_DIR")
+        self.kv_persist_dir = str(kv_persist_dir) if kv_persist_dir else None
+        self.kv_persist_blocks = int(
+            kv_persist_blocks if kv_persist_blocks is not None
+            else knob_int("POLYAXON_TPU_KV_PERSIST_BLOCKS")
+        )
+        self.kv_persist_sig = str(kv_persist_sig or "")
+        self._kv_persist_interval_s = knob_float(
+            "POLYAXON_TPU_KV_PERSIST_INTERVAL_S"
+        )
+        self._host_tier = (
+            HostKVTier(self.kv_offload_blocks) if self.kv_offload else None
+        )
+        self._export_fn: Optional[Any] = None
+        self._import_fn: Optional[Any] = None
+        #: Parked-sequence spill map: slot -> {table index: tier handle}.
+        self._spilled: Dict[int, Dict[int, int]] = {}
+        self._n_spilled_blocks = 0
+        self._n_restored_blocks = 0
+        self._n_shed = 0
+        self._kv_preloaded_blocks = 0
+        self._kv_persisted_blocks = 0
+        self._last_persist_t = 0.0
+        self._last_persist_len = -1
+        if self._host_tier is not None and self.prefix_cache is not None:
+            self.prefix_cache.attach_tier(
+                self._host_tier,
+                spill=self._spill_to_tier,
+                restore=self._restore_from_tier,
+                alloc=self._alloc_block,
+            )
+
         self._key = jax.random.PRNGKey(seed)
         self._rng = np.random.default_rng(seed)
         self._chunk_fns: Dict[int, Any] = {}
@@ -568,6 +641,69 @@ class ServingEngine:
             )
         return self._copy_fn
 
+    def _get_export(self):
+        import jax
+
+        from polyaxon_tpu.models.decode import export_block
+
+        if self._export_fn is None:
+            # NO donation, deliberately: the slice's result must be an
+            # independent buffer, because the pool is donated to every
+            # later step/chunk/import call — the runtime orders the read
+            # before any subsequent donated write, which is what lets the
+            # device→host copy drain while serving moves on.
+            self._export_fn = jax.jit(export_block)
+        return self._export_fn
+
+    def _get_import(self):
+        import jax
+
+        from polyaxon_tpu.models.decode import import_block
+
+        if self._import_fn is None:
+            self._import_fn = jax.jit(
+                import_block, donate_argnums=(0,) if self._donate() else ()
+            )
+        return self._import_fn
+
+    def _export_blocks(self, blocks: List[int]) -> List[Dict[str, np.ndarray]]:
+        """Device→host copy of ``blocks``' payloads, double-buffered:
+        every block's slice is DISPATCHED before any is materialized, so
+        block i+1's device-side copy overlaps block i's host conversion
+        — the ``runtime/pipeline.py`` prefetch idea applied to spill."""
+        import jax.numpy as jnp
+
+        fn = self._get_export()
+        pending = [fn(self._pool, jnp.int32(b)) for b in blocks]
+        return [
+            {name: np.asarray(leaf) for name, leaf in tree.items()}
+            for tree in pending
+        ]
+
+    def _import_block(self, block: int, data: Dict[str, np.ndarray]) -> None:
+        """Host→device copy of one payload into pool block ``block``."""
+        import jax.numpy as jnp
+
+        self._pool = self._get_import()(self._pool, data, jnp.int32(block))
+        with self._stats_lock:
+            self._n_restored_blocks += 1
+
+    def _spill_to_tier(self, block: int) -> Optional[int]:
+        """PrefixCache demotion callback: move one cached block's payload
+        host-side; returns its tier handle (None = tier refused, entry
+        hard-evicts instead)."""
+        [data] = self._export_blocks([block])
+        handle = self._host_tier.put(data, pinned=False)
+        if handle is not None:
+            with self._stats_lock:
+                self._n_spilled_blocks += 1
+        return handle
+
+    def _restore_from_tier(self, handle: int, block: int) -> None:
+        """PrefixCache restore callback: write a demoted entry's payload
+        back into the freshly allocated device block."""
+        self._import_block(block, self._host_tier.pop(handle))
+
     def _spec_widths(self) -> List[int]:
         """The verify-step width family: draft-count buckets (powers of
         two capped at ``spec_k``) plus one row for the current token.
@@ -652,6 +788,10 @@ class ServingEngine:
         ]
         if self._copy_fn is not None:
             fns.append(self._copy_fn)
+        if self._export_fn is not None:
+            fns.append(self._export_fn)
+        if self._import_fn is not None:
+            fns.append(self._import_fn)
         n = 0
         for fn in fns:
             try:
@@ -692,10 +832,21 @@ class ServingEngine:
 
         tracer = get_tracer()
         t0 = time.perf_counter()
+        # Warm replica boot: hydrate the prefix cache from the persisted
+        # store BEFORE the ready gate opens, so a scale-up replica's
+        # first request already walks a warm cache.  Best-effort —
+        # a missing/torn/mismatched store just boots cold.
+        try:
+            self._preload_prefixes()
+        except Exception:
+            pass
+        spillers = self._host_tier is not None or bool(self.kv_persist_dir)
         buckets = self._warmup_buckets() if self._warmup else []
         widths = self._spec_widths() if self._warmup else []
         self._warmup_total = (
-            len(buckets) + len(widths) + 2 if self._warmup else 0
+            len(buckets) + len(widths) + 2 + (1 if spillers else 0)
+            if self._warmup
+            else 0
         )
         gauge = getattr(self.stats_registry, "gauge", None)
 
@@ -767,6 +918,16 @@ class ServingEngine:
                     )
                     jax.block_until_ready(self._pool)
                     _tick()
+                    if spillers:
+                        # Spill/restore round trip through the trash
+                        # block: compiles export+import so steady-state
+                        # park-spill and demotion never mint a compile.
+                        [data] = self._export_blocks([0])
+                        self._pool = self._get_import()(
+                            self._pool, data, jnp.int32(0)
+                        )
+                        jax.block_until_ready(self._pool)
+                        _tick()
         except Exception:
             pass
         finally:
@@ -851,6 +1012,10 @@ class ServingEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        # Final prefix-store snapshot (scheduler thread is down — the
+        # pool is ours again): whatever this replica learned, the next
+        # one boots with.
+        self._maybe_persist(force=True)
         if self._ledger is not None:
             paging = self._paging_snapshot()
             spec = self._spec_snapshot()
@@ -858,6 +1023,15 @@ class ServingEngine:
                 **self._utilization_snapshot(),
                 block_occupancy=paging["block_occupancy"],
                 prefix_cache_hit_rate=paging["prefix_cache_hit_rate"],
+                prefix_cache_hits=paging["prefix_cache_hits"],
+                prefix_cache_misses=paging["prefix_cache_misses"],
+                prefix_cache_evictions=paging["prefix_cache_evictions"],
+                prefix_cache_demotions=paging["prefix_cache_demotions"],
+                prefix_cache_restores=paging["prefix_cache_restores"],
+                parked_sequences=paging["parked_sequences"],
+                requests_shed=paging["requests_shed"],
+                host_spilled_blocks_total=paging["host_spilled_blocks_total"],
+                host_restored_blocks_total=paging["host_restored_blocks_total"],
                 prefill_backlog_chunks=paging["prefill_backlog_chunks"],
                 kv_pool_bytes=paging["kv_pool_bytes"],
                 kv_dtype=paging["kv_dtype"],
@@ -1007,12 +1181,18 @@ class ServingEngine:
         alloc = self.block_allocator
         total = alloc.num_blocks - 1
         pc = self.prefix_cache
+        tier = self._host_tier
         with self._stats_lock:
             backlog = self._backlog_chunks
             jobs = self._prefill_jobs
             parks = self._n_parks
             cow = self._n_cow
             cancelled = self._n_cancelled
+            shed = self._n_shed
+            spilled = self._n_spilled_blocks
+            restored = self._n_restored_blocks
+            preloaded = self._kv_preloaded_blocks
+            persisted = self._kv_persisted_blocks
         return {
             "block_size": self.block_size,
             "kv_dtype": self.kv_dtype,
@@ -1026,6 +1206,22 @@ class ServingEngine:
             "prefix_cache_hit_rate": (
                 round(pc.hit_rate, 6) if pc is not None else 0.0
             ),
+            "prefix_cache_hits": pc.hits if pc is not None else 0,
+            "prefix_cache_misses": pc.misses if pc is not None else 0,
+            "prefix_cache_evictions": pc.evictions if pc is not None else 0,
+            "prefix_cache_demotions": pc.demotions if pc is not None else 0,
+            "prefix_cache_restores": (
+                pc.demote_restores if pc is not None else 0
+            ),
+            "parked_sequences": len(self._parked),
+            "requests_shed": shed,
+            "kv_offload": self.kv_offload,
+            "host_tier_blocks": len(tier) if tier is not None else 0,
+            "host_tier_bytes": tier.nbytes if tier is not None else 0,
+            "host_spilled_blocks_total": spilled,
+            "host_restored_blocks_total": restored,
+            "kv_preloaded_blocks": preloaded,
+            "kv_persisted_blocks": persisted,
             "prefill_backlog_chunks": backlog,
             "prefill_jobs": jobs,
             "block_parks": parks,
@@ -1123,6 +1319,115 @@ class ServingEngine:
                 out[wanted[key]] = {k: round(v, 6) for k, v in summary.items()}
         return out
 
+    # -- persistent prefix store (warm replica boot) ---------------------------
+
+    def _kv_store_meta(self) -> Dict[str, Any]:
+        """The compatibility fingerprint a snapshot must match exactly:
+        pool geometry + storage dtype (shape compatibility) and the
+        caller's model signature (weight identity — geometry alone can't
+        tell two checkpoints apart)."""
+        c = self.cfg
+        return {
+            "sig": self.kv_persist_sig,
+            "kv_dtype": self.kv_dtype,
+            "block_size": self.block_size,
+            "n_layers": int(c.n_layers),
+            "kv_heads": int(c.kv_heads),
+            "head_dim": int(c.head_dim),
+            "vocab_size": int(c.vocab_size),
+        }
+
+    def persist_prefixes(self) -> int:
+        """Snapshot the hottest prefix-cache blocks (chain-closed, see
+        ``PrefixCache.hottest_chains``) to ``kv_persist_dir``; returns
+        blocks written.  Demoted entries persist straight from their
+        host payloads — no device traffic.  Must run on whichever thread
+        owns the pool (the scheduler loop, or any thread after join)."""
+        pc = self.prefix_cache
+        if not self.kv_persist_dir or pc is None:
+            return 0
+        from polyaxon_tpu.serving import kvstore
+
+        entries = []
+        for chain, block, handle in pc.hottest_chains(self.kv_persist_blocks):
+            if block >= 0:
+                [data] = self._export_blocks([block])
+            elif handle is not None and self._host_tier is not None:
+                data = self._host_tier.get(handle)
+            else:
+                continue
+            entries.append((chain, data))
+        if not entries:
+            return 0
+        version = kvstore.save_prefix_store(
+            self.kv_persist_dir, entries, meta=self._kv_store_meta()
+        )
+        if version is None:
+            return 0
+        self._last_persist_t = time.monotonic()
+        self._last_persist_len = len(pc)
+        with self._stats_lock:
+            self._kv_persisted_blocks = len(entries)
+        return len(entries)
+
+    def _maybe_persist(self, force: bool = False) -> None:
+        """Throttled best-effort snapshot: at most one per
+        ``POLYAXON_TPU_KV_PERSIST_INTERVAL_S``, and only when the cache
+        changed since the last write.  The scheduler calls this from its
+        idle branch — incumbents must publish while still RUNNING,
+        because scale-up replicas boot exactly when nobody is stopping."""
+        pc = self.prefix_cache
+        if not self.kv_persist_dir or pc is None or not len(pc):
+            return
+        if len(pc) == self._last_persist_len:
+            return
+        if not force:
+            now = time.monotonic()
+            if now - self._last_persist_t < self._kv_persist_interval_s:
+                return
+        try:
+            self.persist_prefixes()
+        except Exception:
+            pass
+
+    def _preload_prefixes(self) -> None:
+        """Warm boot: hydrate the prefix cache from the newest complete
+        snapshot under ``kv_persist_dir`` (scheduler thread, before the
+        ready gate).  Loads stop at pool pressure — a preload must never
+        starve live admissions of their whole pool."""
+        pc = self.prefix_cache
+        if not self.kv_persist_dir or pc is None:
+            return
+        from polyaxon_tpu.serving import kvstore
+
+        loaded = kvstore.load_prefix_store(
+            self.kv_persist_dir, expect=self._kv_store_meta()
+        )
+        if not loaded:
+            return
+        n = 0
+        # Never fill the pool completely: leave at least half for live
+        # traffic (preloaded entries are refcount-1 cache entries, so
+        # demotion/eviction can reclaim them, but starting gridlocked
+        # would stall first admissions behind evictions).
+        budget = max(0, (self.block_allocator.num_blocks - 1) // 2)
+        for chain, data in loaded:
+            if n >= budget:
+                break
+            block = self.block_allocator.alloc()
+            if block is None:
+                break
+            self._import_block(block, data)
+            if not pc.install(chain, block):
+                continue
+            n += 1
+        with self._stats_lock:
+            self._kv_preloaded_blocks = n
+        # A freshly preloaded cache equals the stored one — don't turn
+        # around and persist it right back.
+        self._last_persist_len = len(pc)
+        self._last_persist_t = time.monotonic()
+
     # -- scheduler loop --------------------------------------------------------
 
     def _loop(self) -> None:
@@ -1189,6 +1494,10 @@ class ServingEngine:
                 # shed one so the rest can make progress.
                 self._resolve_block_deadlock()
                 continue
+            # Fully idle: a good moment to snapshot the prefix store
+            # (throttled; scale-up replicas preload whatever incumbents
+            # last published).
+            self._maybe_persist()
             with self._cv:
                 if not self._queue and not self._stop.is_set():
                     self._cv.wait(timeout=0.2)
@@ -1358,35 +1667,134 @@ class ServingEngine:
 
     def _park(self, slot: int) -> None:
         """Pool exhausted at a block boundary: deactivate the slot with
-        its state and blocks intact.  The active mask is data, so parking
-        and resuming never recompile."""
+        its state intact.  The active mask is data, so parking and
+        resuming never recompile.  With the host tier armed, the slot's
+        PRIVATE blocks (refcount 1 — shared prefix blocks stay resident,
+        they cost the parked slot nothing) spill to pinned host memory
+        and their device blocks free: parking RELEASES capacity instead
+        of sitting on it, so an oversubscribed pool trades restore
+        latency for sheds."""
         self._active[slot] = False
         self._parked.append(slot)
         with self._stats_lock:
             self._n_parks += 1
+        if self._host_tier is not None:
+            self._spill_slot(slot)
+
+    def _spill_slot(self, slot: int) -> None:
+        """Move a parked slot's private blocks to the host tier (pinned)."""
+        alloc = self.block_allocator
+        spill_bi: List[int] = []
+        for bi in range(self._table_width):
+            block = int(self._tables[slot, bi])
+            if block >= 0 and alloc.refcount(block) == 1:
+                spill_bi.append(bi)
+        if not spill_bi:
+            return
+        payloads = self._export_blocks(
+            [int(self._tables[slot, bi]) for bi in spill_bi]
+        )
+        handles = self._spilled.setdefault(slot, {})
+        for bi, data in zip(spill_bi, payloads):
+            handles[bi] = self._host_tier.put(data, pinned=True)
+            alloc.decref(int(self._tables[slot, bi]))
+            self._tables[slot, bi] = -1
+        with self._stats_lock:
+            self._n_spilled_blocks += len(spill_bi)
+
+    def _restore_slot(self, slot: int) -> tuple:
+        """Stream a parked slot's spilled blocks back (host→device into
+        fresh blocks).  ALL-OR-NOTHING: restoration starts only once the
+        pool — after demoting/evicting cold prefixes — covers the slot's
+        whole remaining need, faulted pos block included.  A partial
+        restore would hold device blocks while still parked, and that
+        hold-and-wait livelocks against a mid-prefill job holding the
+        rest: the restore pass runs FIRST each loop, so it re-grabs
+        every block the prefill frees and neither side ever finishes.
+        Refusing to start leaves the free list to whoever can actually
+        use it.  Returns ``(moved, complete)``."""
+        handles = self._spilled.get(slot)
+        if not handles:
+            return False, True
+        need = len(handles)
+        bi_pos = int(self._pos[slot]) // self.block_size
+        if self._tables[slot, bi_pos] < 0 and bi_pos not in handles:
+            need += 1  # the faulted pos block resumes alongside
+        alloc = self.block_allocator
+        if alloc.n_free < need and self.prefix_cache is not None:
+            self.prefix_cache.evict(need - alloc.n_free)
+        if alloc.n_free < need:
+            return False, False
+        for bi in sorted(handles):
+            fresh = self._alloc_block()
+            self._import_block(fresh, self._host_tier.pop(handles.pop(bi)))
+            self._tables[slot, bi] = fresh
+        self._spilled.pop(slot, None)
+        return True, True
 
     def _resume_parked(self) -> bool:
-        """Give parked slots another shot at their faulted block."""
-        resumed = False
+        """Give parked slots another shot at their faulted block, oldest
+        first (spilled blocks restore before the fault retries — the
+        sequence needs its whole KV back to decode)."""
+        progressed = False
         for slot in list(self._parked):
+            moved, complete = self._restore_slot(slot)
+            if moved:
+                progressed = True
+            if not complete:
+                continue
             bi = int(self._pos[slot]) // self.block_size
             if self._tables[slot, bi] < 0:
                 fresh = self._alloc_block()
                 if fresh is None:
                     continue
                 self._tables[slot, bi] = fresh
-            self._parked.remove(slot)
+            self._unpark(slot)
             self._active[slot] = True
-            resumed = True
-        return resumed
+            progressed = True
+        return progressed
+
+    def _unpark(self, slot: int) -> None:
+        """The ONE bookkeeping site for leaving the parked list — resume,
+        retire, and failure all funnel here, so parked-list membership
+        and the spill map can never drift apart.  Any payload still
+        spilled is discarded (the resume path has already drained its
+        map; retire/fail genuinely abandon theirs)."""
+        if slot in self._parked:
+            self._parked.remove(slot)
+        handles = self._spilled.pop(slot, None)
+        if handles and self._host_tier is not None:
+            for handle in handles.values():
+                self._host_tier.discard(handle)
 
     def _resolve_block_deadlock(self) -> None:
         """Nobody active, nobody progressing, eviction exhausted: shed
         the newest parked request (it holds blocks, so shedding is
-        guaranteed to free some), else the head prefill job."""
-        if self._parked:
+        guaranteed to free some), else the head prefill job.
+
+        Newest-parked is the DELIBERATE victim policy, not an accident
+        of list order: the newest parked slot has the least compute
+        invested and the oldest has waited longest (parking order is
+        arrival order at the wall), so LIFO shedding minimizes wasted
+        work while keeping rough arrival fairness for the survivors —
+        the same reasoning as classic LIFO preemption under overload.
+        With the host tier armed a parked slot has already spilled its
+        private blocks, so this path fires only when host+device
+        together can't cover the working set (the tier makes sheds
+        rare, not cheap).  A FULLY-spilled parked slot holds no device
+        blocks at all — shedding it frees nothing — so the victim scan
+        prefers parked slots still holding blocks, then the head
+        prefill job (whose partial KV is what a true prefill gridlock
+        is made of), and only then a spilled slot (unservable: the pool
+        can't cover its restore even with everything else idle)."""
+        holding = [
+            slot
+            for slot in self._parked
+            if bool((self._tables[slot] >= 0).any())
+        ]
+        if holding:
             self._fail_slot(
-                self._parked[-1],
+                holding[-1],
                 "KV block pool exhausted (request shed)",
                 kind="shed",
             )
@@ -1395,6 +1803,13 @@ class ServingEngine:
             job = self._prefill.popleft()
             self._fail_slot(
                 job.slot,
+                "KV block pool exhausted (request shed)",
+                kind="shed",
+            )
+            return
+        if self._parked:
+            self._fail_slot(
+                self._parked[-1],
                 "KV block pool exhausted (request shed)",
                 kind="shed",
             )
@@ -1614,6 +2029,14 @@ class ServingEngine:
             round(pc.hit_rate, 6) if pc is not None else 0.0,
         )
         gauge("serving.prefill_backlog_chunks", float(backlog))
+        gauge("serving.parked_sequences", float(len(self._parked)))
+        if pc is not None:
+            gauge("serving.prefix_cache_evictions", float(pc.evictions))
+            gauge("serving.prefix_cache_demotions", float(pc.demotions))
+            gauge("serving.prefix_cache_restores", float(pc.demote_restores))
+        if self._host_tier is not None:
+            gauge("serving.host_tier_blocks", float(len(self._host_tier)))
+            gauge("serving.host_tier_bytes", float(self._host_tier.nbytes))
         if self.spec_decode:
             with self._stats_lock:
                 proposed, accepted = self._spec_proposed, self._spec_accepted
@@ -1652,8 +2075,7 @@ class ServingEngine:
         req.stream.put(None)
         req.done.set()
         self._active[slot] = False
-        if slot in self._parked:
-            self._parked.remove(slot)
+        self._unpark(slot)
         self._release_slot_blocks(slot)
         self._slot_req[slot] = None
         self._drafters[slot] = None
@@ -1668,12 +2090,14 @@ class ServingEngine:
     def _fail_slot(self, slot: int, msg: str, kind: Optional[str] = None) -> None:
         req = self._slot_req[slot]
         self._active[slot] = False
-        if slot in self._parked:
-            self._parked.remove(slot)
+        self._unpark(slot)
         self._release_slot_blocks(slot)
         self._slot_req[slot] = None
         self._drafters[slot] = None
         self.allocator.free(slot)
+        if kind == "shed":
+            with self._stats_lock:
+                self._n_shed += 1
         if req is not None and not req.done.is_set():
             req.error = msg
             req.error_kind = kind

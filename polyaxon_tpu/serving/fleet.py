@@ -66,6 +66,10 @@ class LocalServingFleet:
         spec_decode: Optional[bool] = None,
         spec_k: Optional[int] = None,
         spec_min_ngram: Optional[int] = None,
+        kv_offload: Optional[bool] = None,
+        kv_offload_blocks: Optional[int] = None,
+        kv_persist_dir: Optional[str] = None,
+        kv_persist_sig: str = "",
         request_timeout_s: float = 600.0,
         host: str = "127.0.0.1",
         router: Optional[FleetRouter] = None,
@@ -91,6 +95,14 @@ class LocalServingFleet:
         self.spec_decode = spec_decode
         self.spec_k = spec_k
         self.spec_min_ngram = spec_min_ngram
+        # KV hierarchy rides the spec too: every replica (including
+        # autoscaler scale-ups, which re-enter launch_replica) shares
+        # one kv_persist_dir, so a new replica boots prefix-warm from
+        # whatever the incumbents last persisted.
+        self.kv_offload = kv_offload
+        self.kv_offload_blocks = kv_offload_blocks
+        self.kv_persist_dir = kv_persist_dir
+        self.kv_persist_sig = kv_persist_sig
         self.request_timeout_s = request_timeout_s
         self.host = host
         self.env = dict(env or {})
@@ -124,6 +136,10 @@ class LocalServingFleet:
             "spec_decode": self.spec_decode,
             "spec_k": self.spec_k,
             "spec_min_ngram": self.spec_min_ngram,
+            "kv_offload": self.kv_offload,
+            "kv_offload_blocks": self.kv_offload_blocks,
+            "kv_persist_dir": self.kv_persist_dir,
+            "kv_persist_sig": self.kv_persist_sig,
             "request_timeout_s": self.request_timeout_s,
         }
         spec_path = self.workdir / f"{name}.json"

@@ -1,0 +1,189 @@
+"""Persistent prefix-KV store: warm replica boot for the serving engine.
+
+Serializes hot :class:`~polyaxon_tpu.serving.paging.PrefixCache` blocks
+(payload + the FULL token chain that identifies each entry) under a
+store directory — normally ``StoreLayout.kv_cache_dir`` — so a
+replacement or scale-up replica can hydrate its prefix cache during
+warmup and serve its first requests prefix-warm instead of paying cold
+TTFT exactly when the fleet is most loaded.
+
+Durability protocol is the checkpoint one (``runtime/checkpoint.py``):
+versioned snapshot directories plus a ``.complete/<version>`` marker
+written LAST, each rename atomic.  A crash mid-write leaves either the
+previous complete version or an ignorable torn directory — readers
+trust only marked versions.  Concurrent writers (several replicas
+persisting into one shared dir) race benignly: version numbers are
+claimed by the directory rename, a loser just retries one higher.
+
+Two deliberate format choices:
+
+- entries store **tokens, not chain keys** — ``PrefixCache`` keys are
+  built with Python's string ``hash()``, which is randomized per
+  process; the loader rebuilds keys in its own process via the cache's
+  own chain walk.
+- payloads store the **pool's storage leaves verbatim** — an int8 pool
+  persists int8 rows + f32 scales, so quantization halves the bytes on
+  disk exactly as it does in HBM, and a loaded block is the original's
+  bits (never a requantization).  Leaf dtypes are recorded BY NAME next
+  to the payload: ``np.load`` reads extension dtypes (bfloat16 — the
+  TPU default) back as raw void bytes, so the loader view-casts each
+  leaf to its recorded dtype instead of handing jit an invalid array.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+#: Marker directory: ``<root>/.complete/<version>`` exists iff snapshot
+#: ``<root>/<version>/`` finished writing (same protocol as
+#: ``runtime/checkpoint.py``).
+_COMPLETE_DIR = ".complete"
+
+#: Complete snapshots kept after a successful save (older versions GC).
+_KEEP_VERSIONS = 2
+
+#: One persisted prefix block: (full chain tokens, {pool leaf: array}).
+Entry = Tuple[Tuple[int, ...], Dict[str, np.ndarray]]
+
+
+def complete_versions(root: Union[str, Path]) -> List[int]:
+    """All snapshot versions whose finalize marker exists, ascending."""
+    root = Path(root)
+    marker_dir = root / _COMPLETE_DIR
+    if not marker_dir.is_dir():
+        return []
+    return sorted(
+        int(p.name)
+        for p in marker_dir.iterdir()
+        if p.name.isdigit() and (root / p.name).is_dir()
+    )
+
+
+def latest_complete_version(root: Union[str, Path]) -> Optional[int]:
+    versions = complete_versions(root)
+    return versions[-1] if versions else None
+
+
+def save_prefix_store(
+    root: Union[str, Path],
+    entries: Sequence[Entry],
+    meta: Dict[str, Any],
+) -> Optional[int]:
+    """Write one snapshot (payloads + chains + ``meta``); returns its
+    version, or ``None`` when nothing was written (no entries, or the
+    version race lost too many times).  ``meta`` is the compatibility
+    fingerprint the loader matches exactly — geometry, kv dtype, and the
+    caller's model signature."""
+    if not entries:
+        return None
+    root = Path(root)
+    root.mkdir(parents=True, exist_ok=True)
+    (root / _COMPLETE_DIR).mkdir(exist_ok=True)
+    for attempt in range(3):
+        version = (latest_complete_version(root) or 0) + 1 + attempt
+        final = root / str(version)
+        if final.exists():
+            continue  # a concurrent writer claimed it (possibly torn)
+        tmp = root / f"{version}.tmp-{os.getpid()}"
+        try:
+            tmp.mkdir()
+            arrays: Dict[str, np.ndarray] = {}
+            records = []
+            for i, (chain, data) in enumerate(entries):
+                records.append(
+                    {
+                        "tokens": [int(t) for t in chain],
+                        "leaves": sorted(data),
+                        "dtypes": {
+                            name: str(np.asarray(arr).dtype)
+                            for name, arr in data.items()
+                        },
+                    }
+                )
+                for name, arr in data.items():
+                    arrays[f"e{i}.{name}"] = np.asarray(arr)
+            np.savez(tmp / "blocks.npz", **arrays)
+            (tmp / "meta.json").write_text(
+                json.dumps({"meta": dict(meta), "entries": records})
+            )
+            os.replace(tmp, final)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+            continue
+        # Data is in place — now, and only now, the finalize marker.
+        marker = root / _COMPLETE_DIR / str(version)
+        marker_tmp = root / _COMPLETE_DIR / f"{version}.tmp-{os.getpid()}"
+        marker_tmp.write_text("")
+        os.replace(marker_tmp, marker)
+        _gc_versions(root)
+        return version
+    return None
+
+
+def load_prefix_store(
+    root: Union[str, Path],
+    expect: Optional[Dict[str, Any]] = None,
+) -> Optional[List[Entry]]:
+    """Entries of the newest COMPLETE snapshot, ancestors-first — or
+    ``None`` when there is no usable store (missing, torn, unreadable,
+    or any ``expect`` key differs from the stored meta: a geometry or
+    model-signature mismatch makes the payloads garbage, so the loader
+    walks away rather than serving wrong KV)."""
+    root = Path(root)
+    version = latest_complete_version(root)
+    if version is None:
+        return None
+    snap = root / str(version)
+    try:
+        doc = json.loads((snap / "meta.json").read_text())
+        stored = doc["meta"]
+        if expect:
+            for key, want in expect.items():
+                if stored.get(key) != want:
+                    return None
+        out: List[Entry] = []
+        with np.load(snap / "blocks.npz") as z:
+            for i, rec in enumerate(doc["entries"]):
+                dtypes = rec.get("dtypes") or {}
+                data = {}
+                for name in rec["leaves"]:
+                    arr = z[f"e{i}.{name}"]
+                    want = dtypes.get(name)
+                    if want and str(arr.dtype) != want:
+                        arr = arr.view(_np_dtype(want))
+                    data[name] = arr
+                out.append((tuple(int(t) for t in rec["tokens"]), data))
+        return out
+    except Exception:
+        return None
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a recorded dtype name; extension names (``bfloat16``)
+    only resolve once ``ml_dtypes`` has registered them with numpy."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # noqa: F401  (registers bfloat16 et al.)
+
+        return np.dtype(name)
+
+
+def _gc_versions(root: Path) -> None:
+    """Keep the newest ``_KEEP_VERSIONS`` complete snapshots; older
+    versions lose their marker FIRST (so a reader never trusts a
+    half-deleted dir), then their data.  Stray tmp dirs are left alone —
+    they may belong to a live concurrent writer."""
+    for version in complete_versions(root)[:-_KEEP_VERSIONS]:
+        marker = root / _COMPLETE_DIR / str(version)
+        try:
+            marker.unlink()
+        except OSError:
+            continue
+        shutil.rmtree(root / str(version), ignore_errors=True)

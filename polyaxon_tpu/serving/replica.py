@@ -14,6 +14,8 @@ The spec is plain JSON::
       "model": {"vocab_size": 64, "d_model": 32, ...},  # TransformerConfig ints
       "seq": 48, "slots": 4, "block_size": 16,
       "kv_blocks": null, "prefill_chunk": 0,
+      "kv_offload": false, "kv_offload_blocks": 0,
+      "kv_persist_dir": null, "kv_persist_sig": "",
       "max_new_tokens": 64, "request_timeout_s": 600.0,
       "retry_after_s": 1.0
     }
@@ -49,6 +51,9 @@ def serve(spec: dict) -> None:
     spec_decode = spec.get("spec_decode")
     spec_k = spec.get("spec_k")
     spec_min_ngram = spec.get("spec_min_ngram")
+    kv_offload = spec.get("kv_offload")
+    kv_offload_blocks = spec.get("kv_offload_blocks")
+    kv_persist_dir = spec.get("kv_persist_dir")
     engine = ServingEngine(
         params,
         cfg,
@@ -63,6 +68,12 @@ def serve(spec: dict) -> None:
         spec_min_ngram=(
             int(spec_min_ngram) if spec_min_ngram is not None else None
         ),
+        kv_offload=bool(kv_offload) if kv_offload is not None else None,
+        kv_offload_blocks=(
+            int(kv_offload_blocks) if kv_offload_blocks is not None else None
+        ),
+        kv_persist_dir=str(kv_persist_dir) if kv_persist_dir else None,
+        kv_persist_sig=str(spec.get("kv_persist_sig", "")),
     ).start()
 
     meta = {

@@ -301,6 +301,26 @@ def artifact_store_from_url(url: str) -> ArtifactStore:
     )
 
 
+#: Store key prefix for the shared prefix-KV store (layout-level, not
+#: per-run: every replica of every serving run reads the same warm set).
+KV_CACHE_PREFIX = "kv_cache"
+
+
+def sync_kv_cache_up(store: ArtifactStore, layout) -> int:
+    """Upload the layout's persistent prefix-KV store (complete
+    snapshots + markers); returns file count.  Marker files ride along
+    with their data dirs, so a partially uploaded tree at worst loses
+    the newest version — never trusts a torn one."""
+    return store.upload_tree(layout.kv_cache_dir, KV_CACHE_PREFIX)
+
+
+def sync_kv_cache_down(store: ArtifactStore, layout) -> int:
+    """Restore the prefix-KV store onto a fresh host (new TPU-VM slice)
+    before its replicas boot, so warm boot survives host replacement
+    exactly like the compile cache does."""
+    return store.download_tree(KV_CACHE_PREFIX, layout.kv_cache_dir)
+
+
 # -- run-level sync -----------------------------------------------------------
 def sync_run_up(store: ArtifactStore, run_paths, run_uuid: str) -> int:
     """Upload a run's durable subdirs to ``runs/<uuid>/``; returns file count."""
