@@ -313,7 +313,10 @@ class ServingEngine:
         prefix-warm.  ``kv_persist_sig`` is the model-identity
         fingerprint stored with (and required of) a snapshot: pass
         something that changes with the weights (seed, checkpoint step)
-        so a replica never preloads KV another model computed.
+        so a replica never preloads KV another model computed.  Left
+        empty, the engine derives one by fingerprinting the weights
+        themselves (geometry can't tell checkpoints apart, so an
+        unsigned store is never written).
         Defaults read the ``POLYAXON_TPU_KV_PERSIST_*`` knobs (off).
     stats : a stats backend receiving latency histograms
         (``serving.queue_wait_s`` / ``serving.ttft_s`` /
@@ -510,6 +513,28 @@ class ServingEngine:
             else knob_int("POLYAXON_TPU_KV_PERSIST_BLOCKS")
         )
         self.kv_persist_sig = str(kv_persist_sig or "")
+        if self.kv_persist_dir and not self.kv_persist_sig:
+            # No model identity provided: the store meta's geometry +
+            # dtype cannot tell two checkpoints of the same config
+            # apart, and an empty sig would let replicas serving
+            # DIFFERENT weights exchange KV through a shared store.
+            # Derive a fingerprint from the weights themselves; if that
+            # fails, disable persistence rather than silently allow it.
+            self.kv_persist_sig = self._auto_persist_sig(
+                params, qweights, seed
+            )
+            if not self.kv_persist_sig:
+                import warnings
+
+                warnings.warn(
+                    "kv_persist_dir is set but no kv_persist_sig was "
+                    "given and no weight fingerprint could be derived; "
+                    "disabling KV persistence (an unsigned shared store "
+                    "could serve KV computed by a different model)",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                self.kv_persist_dir = None
         self._kv_persist_interval_s = knob_float(
             "POLYAXON_TPU_KV_PERSIST_INTERVAL_S"
         )
@@ -526,7 +551,7 @@ class ServingEngine:
         self._kv_preloaded_blocks = 0
         self._kv_persisted_blocks = 0
         self._last_persist_t = 0.0
-        self._last_persist_len = -1
+        self._last_persist_mut = -1
         if self._host_tier is not None and self.prefix_cache is not None:
             self.prefix_cache.attach_tier(
                 self._host_tier,
@@ -1321,6 +1346,42 @@ class ServingEngine:
 
     # -- persistent prefix store (warm replica boot) ---------------------------
 
+    @staticmethod
+    def _auto_persist_sig(params: Any, qweights: Any, seed: int) -> str:
+        """Weight-identity fingerprint for an unsigned persistent store:
+        tree structure plus a bounded byte sample (head + tail) of every
+        weight leaf — cheap (a few tiny device→host reads) yet it
+        changes with the checkpoint, which geometry alone cannot.
+        Returns ``""`` when the weights can't be sampled."""
+        import hashlib
+
+        import jax
+
+        try:
+            h = hashlib.sha256()
+            h.update(f"seed:{int(seed)};wq:{qweights is not None};".encode())
+            for tree in (params, qweights):
+                if tree is None:
+                    continue
+                leaves, treedef = jax.tree_util.tree_flatten(tree)
+                h.update(str(treedef).encode())
+                for leaf in leaves:
+                    flat = (
+                        leaf if hasattr(leaf, "reshape") else np.asarray(leaf)
+                    ).reshape(-1)
+                    sample = np.concatenate(
+                        [
+                            np.asarray(jax.device_get(flat[:16])),
+                            np.asarray(jax.device_get(flat[-16:])),
+                        ]
+                    )
+                    h.update(str(sample.dtype).encode())
+                    h.update(str(flat.shape).encode())
+                    h.update(sample.tobytes())
+            return "auto:" + h.hexdigest()[:16]
+        except Exception:
+            return ""
+
     def _kv_store_meta(self) -> Dict[str, Any]:
         """The compatibility fingerprint a snapshot must match exactly:
         pool geometry + storage dtype (shape compatibility) and the
@@ -1365,7 +1426,7 @@ class ServingEngine:
         if version is None:
             return 0
         self._last_persist_t = time.monotonic()
-        self._last_persist_len = len(pc)
+        self._last_persist_mut = pc.mutations
         with self._stats_lock:
             self._kv_persisted_blocks = len(entries)
         return len(entries)
@@ -1379,7 +1440,10 @@ class ServingEngine:
         pc = self.prefix_cache
         if not self.kv_persist_dir or pc is None or not len(pc):
             return
-        if len(pc) == self._last_persist_len:
+        # Content churn at constant size (evict+offer of different
+        # prefixes, demotions/restores) must re-persist, so freshness
+        # keys off the cache's mutation counter, never its len().
+        if pc.mutations == self._last_persist_mut:
             return
         if not force:
             now = time.monotonic()
@@ -1425,7 +1489,7 @@ class ServingEngine:
             self._kv_preloaded_blocks = n
         # A freshly preloaded cache equals the stored one — don't turn
         # around and persist it right back.
-        self._last_persist_len = len(pc)
+        self._last_persist_mut = pc.mutations
         self._last_persist_t = time.monotonic()
 
     # -- scheduler loop --------------------------------------------------------

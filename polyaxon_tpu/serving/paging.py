@@ -282,6 +282,11 @@ class PrefixCache:
         self.evictions = 0
         self.demotions = 0
         self.demote_restores = 0
+        #: Monotonic content-change counter: bumped whenever the entry
+        #: SET changes (offer/install adds, evict/demote/restore/drop
+        #: removals or tier moves).  len() can't detect churn at
+        #: constant size, so persistence freshness keys off this.
+        self.mutations = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -333,6 +338,7 @@ class PrefixCache:
         self._entries.pop(key, None)
         self._chains.pop(key, None)
         self.evictions += 1
+        self.mutations += 1
 
     def _keys_for(self, prompt: Sequence[int]) -> List[Tuple[int, Tuple[int, ...]]]:
         """Chained (key, tokens) per FULL block of the prompt."""
@@ -374,18 +380,31 @@ class PrefixCache:
         handle = self._demoted.get(key)
         if handle is None or self._restore is None:
             return None
-        # MRU first: the allocation below may demote LRU entries to make
-        # room, and must never cascade onto the entry being restored.
+        # MRU first on BOTH levels: the allocation below may demote LRU
+        # entries to make room (cache side), and each demotion's
+        # tier.put may LRU-drop tier payloads (tier side) — neither
+        # cascade may land on the entry being restored.
         self._entries.move_to_end(key)
+        if self._tier is not None and handle in self._tier:
+            self._tier.get(handle)
         alloc = self._alloc_fn or self._alloc.alloc
         block = alloc()
         if block is None:
+            return None
+        # A tier smaller than the eviction cascade can still have
+        # dropped this handle during alloc (on_drop already forgot the
+        # entry): the payload is gone, so treat it as a miss.
+        if self._demoted.get(key) != handle or (
+            self._tier is not None and handle not in self._tier
+        ):
+            self._alloc.decref(block)
             return None
         self._restore(handle, block)
         del self._demoted[key]
         self._handle_key.pop(handle, None)
         self._entries[key] = (block, toks)
         self.demote_restores += 1
+        self.mutations += 1
         return block
 
     def offer(self, prompt: Sequence[int], blocks: Sequence[int]) -> None:
@@ -400,6 +419,7 @@ class PrefixCache:
                 self._alloc.incref(block)
                 self._entries[key] = (block, toks)
                 self._chains[key] = tuple(chain)
+                self.mutations += 1
             self._entries.move_to_end(key)
 
     def install(self, chain_tokens: Sequence[int], block: int) -> bool:
@@ -420,6 +440,7 @@ class PrefixCache:
         self._entries[key] = (block, toks)
         self._chains[key] = tuple(int(t) for t in chain_tokens)
         self._entries.move_to_end(key)
+        self.mutations += 1
         return True
 
     def hottest_chains(
@@ -466,7 +487,14 @@ class PrefixCache:
         for key in list(self._entries):
             if freed >= need:
                 break
-            block, toks = self._entries[key]
+            # The demote branch's spill can re-enter _forget_handle (a
+            # tier capacity drop fires on_drop) and delete OTHER demoted
+            # entries mid-iteration, so keys from the snapshot above may
+            # be gone by the time the walk reaches them.
+            entry = self._entries.get(key)
+            if entry is None:
+                continue
+            block, toks = entry
             if block < 0:
                 continue  # already demoted: holds no device block
             if self._alloc.refcount(block) != 1:
@@ -479,12 +507,14 @@ class PrefixCache:
                     self._entries[key] = (DEMOTED, toks)
                     self._alloc.decref(block)
                     self.demotions += 1
+                    self.mutations += 1
                     freed += 1
                     continue
-            del self._entries[key]
+            self._entries.pop(key, None)
             self._chains.pop(key, None)
             self._alloc.decref(block)
             self.evictions += 1
+            self.mutations += 1
             freed += 1
         return freed
 
@@ -500,4 +530,5 @@ class PrefixCache:
             del self._entries[key]
             self._chains.pop(key, None)
             self.evictions += 1
+            self.mutations += 1
         return freed

@@ -17,7 +17,12 @@ import jax.numpy as jnp
 import pytest
 
 from polyaxon_tpu.models import TransformerConfig, decode, init_params
-from polyaxon_tpu.serving import HostKVTier, ServingEngine
+from polyaxon_tpu.serving import (
+    BlockAllocator,
+    HostKVTier,
+    PrefixCache,
+    ServingEngine,
+)
 
 CFG = TransformerConfig(
     vocab_size=64,
@@ -233,6 +238,98 @@ class TestPrefixDemotion:
             assert eng.submit(p, 4).wait(timeout=120) == ref
         finally:
             eng.stop()
+
+
+class TestTierReentrancy:
+    """Regressions for reentrant tier capacity drops: a demote's
+    ``tier.put`` can LRU-drop ANOTHER demoted entry, whose ``on_drop``
+    re-enters the cache's bookkeeping mid-operation.  Both paths run on
+    the scheduler thread with no exception guard — an escape here used
+    to kill the replica's scheduler and stop it serving."""
+
+    @staticmethod
+    def _spill_restore(tier):
+        def spill(block):
+            return tier.put({"blk": np.full((2,), block, np.int32)})
+
+        def restore(handle, block):
+            tier.pop(handle)
+
+        return spill, restore
+
+    def test_evict_survives_drop_of_key_still_in_snapshot(self):
+        """evict() demotes live entry B; the tier (capacity 1) drops
+        demoted entry A to make room, and on_drop forgets A while evict
+        is still iterating a snapshot that contains it.  The walk must
+        skip the vanished key, not KeyError."""
+        alloc = BlockAllocator(8)
+        pc = PrefixCache(alloc, 4)
+        tier = HostKVTier(capacity_blocks=1)
+        spill, restore = self._spill_restore(tier)
+        pc.attach_tier(tier, spill=spill, restore=restore, alloc=alloc.alloc)
+        pa, pb = list(range(4)), list(range(10, 14))
+        ba, bb = alloc.alloc(), alloc.alloc()
+        pc.offer(pa, [ba])
+        pc.offer(pb, [bb])
+        alloc.decref(ba)
+        alloc.decref(bb)  # the cache is each block's only holder
+        assert pc.evict(1) == 1  # A demotes: the tier is now full
+        assert pc.n_demoted == 1
+        # Pin the pool empty and miss-restore A: the failed restore's
+        # MRU bump leaves demoted A BEHIND live B in iteration order —
+        # exactly the order a hot-but-unrestorable prefix ends up in
+        # under pool pressure.
+        held = [alloc.alloc() for _ in range(alloc.n_free)]
+        assert pc.match(pa) == []
+        for b in held:
+            alloc.decref(b)
+        # Snapshot is [B, A]; demoting B drops A's payload mid-loop.
+        assert pc.evict(need=2) == 1
+        assert len(pc) == 1 and pc.n_demoted == 1  # only B remains
+        assert tier.dropped_total == 1
+        assert pc.match(pa) == []  # A degraded to a clean miss
+        restored = pc.match(pb)  # B restores intact
+        assert len(restored) == 1
+        assert alloc.refcount(restored[0]) == 2
+        assert len(tier) == 0
+
+    def test_restore_survives_tier_drop_of_its_own_handle(self):
+        """The evict-then-retry allocator inside a restore can demote a
+        colder entry, whose tier.put (capacity 1) drops the very handle
+        being restored.  The restore must notice its payload is gone and
+        degrade to a miss — without leaking the retry block."""
+        alloc = BlockAllocator(8)
+        pc = PrefixCache(alloc, 4)
+        tier = HostKVTier(capacity_blocks=1)
+        spill, restore = self._spill_restore(tier)
+
+        def alloc_retry():  # the engine's _alloc_block shape
+            block = alloc.alloc()
+            if block is None and pc.evict(1):
+                block = alloc.alloc()
+            return block
+
+        pc.attach_tier(tier, spill=spill, restore=restore, alloc=alloc_retry)
+        pa, pb = list(range(4)), list(range(10, 14))
+        ba, bb = alloc.alloc(), alloc.alloc()
+        pc.offer(pa, [ba])
+        pc.offer(pb, [bb])
+        alloc.decref(ba)
+        alloc.decref(bb)
+        assert pc.evict(1) == 1  # A demotes: its handle fills the tier
+        held = [alloc.alloc() for _ in range(alloc.n_free)]  # pool empty
+        # Restoring A must evict-demote B, which drops A's payload: the
+        # lookup is a miss, and the block the retry freed is released.
+        assert pc.match(pa) == []
+        assert alloc.n_used == len(held)
+        assert len(pc) == 1 and pc.n_demoted == 1  # only B, demoted
+        assert tier.dropped_total == 1
+        # B is still restorable once the pool has room.
+        alloc.decref(held.pop())
+        restored = pc.match(pb)
+        assert len(restored) == 1
+        assert alloc.refcount(restored[0]) == 2
+        assert len(tier) == 0
 
 
 class TestSpecDecodeParkComposition:

@@ -299,6 +299,112 @@ class TestWarmBoot:
             b.stop()
 
 
+class TestPersistFreshness:
+    def test_content_churn_at_constant_size_republishes(
+        self, params, tmp_path
+    ):
+        """The persist change-detector keys off the cache's mutation
+        counter, not len(): replacing every entry with a DIFFERENT
+        prefix at the same size must publish a new snapshot (a len()
+        check leaves scale-up replicas preloading stale prefixes), and
+        no churn at all must publish nothing."""
+        rng = np.random.default_rng(20)
+        p1 = list(rng.integers(0, 64, 8))  # 2 full blocks
+        store = tmp_path / "kv"
+        a = ServingEngine(
+            params, CFG, slots=2, max_len=48, block_size=4,
+            prefix_cache=True, kv_persist_dir=store, kv_persist_sig="w1",
+        ).start()
+        try:
+            a.submit(p1, 4).wait(timeout=120)
+        finally:
+            a.stop()  # final persist -> v1
+        assert kvstore.latest_complete_version(store) == 1
+        pc = a.prefix_cache
+        # Unchanged cache: a forced pass must not write v2.
+        a._maybe_persist(force=True)
+        assert kvstore.latest_complete_version(store) == 1
+        # Same size, different content (the len()-blind case).
+        assert pc.evict(need=2, demote=False) == 2
+        p2 = list(rng.integers(0, 64, 8))
+        blocks = [a.block_allocator.alloc() for _ in range(2)]
+        a.prefix_cache.offer(p2, blocks)
+        assert len(pc) == 2
+        a._maybe_persist(force=True)
+        assert kvstore.latest_complete_version(store) == 2
+
+
+class TestAutoSignature:
+    def test_unsigned_store_derives_weight_fingerprint(
+        self, params, tmp_path
+    ):
+        """kv_persist_dir without kv_persist_sig: the engine derives a
+        weight fingerprint instead of persisting unsigned, and a second
+        engine on the SAME weights derives the same sig — warm boot
+        still works without threading an explicit identity."""
+        store = tmp_path / "kv"
+        rng = np.random.default_rng(21)
+        p = list(rng.integers(0, 64, 8))  # 2 full blocks
+        a = ServingEngine(
+            params, CFG, slots=2, max_len=48, block_size=4,
+            prefix_cache=True, kv_persist_dir=store,
+        ).start()
+        try:
+            assert a.kv_persist_sig.startswith("auto:")
+            ref = a.submit(p, 4).wait(timeout=120)
+        finally:
+            a.stop()
+        assert a.stats()["kv_persisted_blocks"] == 2
+
+        b = ServingEngine(
+            params, CFG, slots=2, max_len=48, block_size=4,
+            prefix_cache=True, kv_persist_dir=store,
+        ).start()
+        try:
+            assert b.kv_persist_sig == a.kv_persist_sig
+            assert b.wait_ready(timeout=60)
+            assert b.stats()["kv_preloaded_blocks"] == 2
+            assert b.submit(p, 4).wait(timeout=120) == ref
+        finally:
+            b.stop()
+
+    def test_different_weights_never_share_an_unsigned_store(
+        self, params, tmp_path
+    ):
+        """The bug the auto-sig closes: two unsigned replicas serving
+        DIFFERENT weights used to produce identical fingerprints
+        (geometry + dtype can't tell checkpoints apart) and exchange KV
+        through a shared store.  Different weights must derive different
+        sigs and boot cold off each other's snapshots."""
+        store = tmp_path / "kv"
+        rng = np.random.default_rng(22)
+        p = list(rng.integers(0, 64, 8))
+        a = ServingEngine(
+            params, CFG, slots=2, max_len=48, block_size=4,
+            prefix_cache=True, kv_persist_dir=store,
+        ).start()
+        try:
+            a.submit(p, 4).wait(timeout=120)
+        finally:
+            a.stop()
+        assert a.stats()["kv_persisted_blocks"] == 2
+
+        other = init_params(jax.random.PRNGKey(5), CFG)
+        b = ServingEngine(
+            other, CFG, slots=2, max_len=48, block_size=4,
+            prefix_cache=True, kv_persist_dir=store,
+        ).start()
+        try:
+            assert b.kv_persist_sig != a.kv_persist_sig
+            assert b.wait_ready(timeout=60)
+            assert b.stats()["kv_preloaded_blocks"] == 0
+            assert len(b.prefix_cache) == 0
+            # Cold but correct under ITS OWN weights.
+            assert b.submit(p, 4).wait(timeout=120) == _ref(other, p, 4)
+        finally:
+            b.stop()
+
+
 class TestFleetThreading:
     def test_replica_specs_carry_warm_boot_config(self, tmp_path):
         """Every replica the fleet launches — including autoscaler

@@ -141,6 +141,27 @@ class TestPrefixCache:
         assert pc.match(p2) == []
         assert pc.match(p1) == [b1]
 
+    def test_mutation_counter_sees_churn_at_constant_size(self):
+        """len() is blind to evict+offer of DIFFERENT prefixes at the
+        same size; the mutation counter is what persistence freshness
+        keys off, so it must move on content changes and hold still on
+        pure hits."""
+        alloc, pc = self._cache()
+        p1, p2 = list(range(4)), list(range(10, 14))
+        b1 = alloc.alloc()
+        pc.offer(p1, [b1])
+        alloc.decref(b1)
+        m0 = pc.mutations
+        assert m0 >= 1
+        assert pc.evict(1) == 1
+        b2 = alloc.alloc()
+        pc.offer(p2, [b2])
+        assert len(pc) == 1  # same size, different content...
+        assert pc.mutations > m0  # ...and the counter knows
+        m1 = pc.mutations
+        pc.match(p2)  # a pure hit changes nothing persistable
+        assert pc.mutations == m1
+
 
 class TestPagedParity:
     def test_greedy_parity_with_sharing_and_chunking_zero_recompiles(
