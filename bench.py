@@ -2104,6 +2104,89 @@ def main() -> None:
                 base["train_images_per_s"] = train_images["images_per_s"]
         baseline_path.write_text(json.dumps(base))
 
+    # Control-plane saturation: the flight instruments under load.  A
+    # ~1000-run registry, 8 fake gangs streaming report lines, and a
+    # concurrent API hammer run simultaneously while one gang stalls
+    # mid-flight — gating on watcher ingest-lag p99 (is the tail keeping
+    # up with the writers), stall→alert fire latency beyond the
+    # configured threshold (does detection survive saturation), and API
+    # read p99 under full ingest.  The idle-tick measure is the
+    # instrumentation overhead floor, held to the same 5ms budget as
+    # alert_tick_us.
+    controlplane_saturation = None
+    cp_watcher_lag_p99_ok = None
+    cp_alert_fire_ok = None
+    cp_api_p99_ok = None
+    cp_idle_tick_us = None
+    cp_idle_tick_ok = None
+    try:
+        import sys
+        import tempfile
+
+        from polyaxon_tpu.monitor.cploadgen import (
+            measure_idle_tick_us,
+            run_saturation,
+        )
+
+        controlplane_saturation = run_saturation(
+            tempfile.mkdtemp(),
+            n_registry_runs=1000,
+            n_gangs=8,
+            procs_per_gang=2,
+            duration_s=6.0,
+            write_hz=20.0,
+            api_concurrency=4,
+            stall_after_s=0.75,
+            monitor_interval_s=0.05,
+        )
+        cp_lag_p99 = controlplane_saturation["watcher_ingest_lag_p99_s"]
+        cp_fire_s = controlplane_saturation["alert_fire_latency_s"]
+        cp_api_p99 = controlplane_saturation["api_p99_s"]
+        # Budgets: ingest lag tracks the write cadence (50ms monitor tick
+        # + 50ms writer period ≪ 1s), the stall alert must fire within 2s
+        # of first becoming fireable, and API reads must stay interactive
+        # while every gang's reports drain through the same process.
+        cp_watcher_lag_p99_ok = cp_lag_p99 is not None and cp_lag_p99 < 1.0
+        cp_alert_fire_ok = cp_fire_s is not None and cp_fire_s < 2.0
+        cp_api_p99_ok = cp_api_p99 is not None and cp_api_p99 < 0.25
+        if not cp_watcher_lag_p99_ok:
+            print(
+                f"bench: watcher_ingest_lag_p99_s={cp_lag_p99} over the 1s "
+                "budget — the watcher tail is not keeping up with ingest",
+                file=sys.stderr,
+            )
+        if not cp_alert_fire_ok:
+            print(
+                f"bench: cp alert_fire_latency_s={cp_fire_s} over the 2s "
+                "budget — stall detection degrades under saturation",
+                file=sys.stderr,
+            )
+        if not cp_api_p99_ok:
+            print(
+                f"bench: api_p99_s={cp_api_p99} over the 250ms budget — "
+                "API reads degrade under concurrent ingest",
+                file=sys.stderr,
+            )
+        if controlplane_saturation.get("api_errors"):
+            print(
+                f"bench: {controlplane_saturation['api_errors']} API errors "
+                "during the saturation hammer",
+                file=sys.stderr,
+            )
+        cp_idle_tick_us = measure_idle_tick_us(tempfile.mkdtemp(), iters=200)
+        cp_idle_tick_ok = cp_idle_tick_us < 5000.0
+        if not cp_idle_tick_ok:
+            print(
+                f"bench: cp_idle_tick_us={cp_idle_tick_us:.1f} over the 5ms "
+                "budget — tick instrumentation costs too much when idle",
+                file=sys.stderr,
+            )
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+
     # graft-lint full-package runtime: the static pass rides every CI
     # invocation (`make lint` is in the gate), so it gets a wall-clock
     # budget like every other tick path — a rule that grows a quadratic
@@ -2249,6 +2332,16 @@ def main() -> None:
                     if serving_ready_s is not None
                     else None
                 ),
+                "controlplane_saturation": controlplane_saturation,
+                "cp_watcher_lag_p99_ok": cp_watcher_lag_p99_ok,
+                "cp_alert_fire_ok": cp_alert_fire_ok,
+                "cp_api_p99_ok": cp_api_p99_ok,
+                "cp_idle_tick_us": (
+                    round(cp_idle_tick_us, 1)
+                    if cp_idle_tick_us is not None
+                    else None
+                ),
+                "cp_idle_tick_ok": cp_idle_tick_ok,
                 "analysis_runtime_s": (
                     round(analysis_runtime_s, 3)
                     if analysis_runtime_s is not None

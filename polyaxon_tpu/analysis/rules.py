@@ -20,6 +20,11 @@ Each rule guards a bug class this codebase actually shipped (and fixed)
 - **GL006 net-timeout** — network I/O anywhere without an explicit
   timeout (the webhook/CLI hang class PR 9 hardened the notifier
   against).
+- **GL007 metric-labels** — ``labeled_key`` label keys come from a
+  closed catalog and label values are never built by interpolation
+  (an unbounded identifier in a label mints one series per value —
+  the cardinality-explosion class the MemoryStats series cap only
+  *bounds*, never prevents).
 
 All rules are heuristic *and lexical* — they see one module at a time
 (GL004/GL005 add a project-wide index) and do not chase cross-module
@@ -706,6 +711,115 @@ class NetTimeoutRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# GL007 — metric label hygiene
+# ---------------------------------------------------------------------------
+
+#: The mechanism module — ``labeled_key`` itself and ``fold_labeled_key``
+#: (which legitimately re-emits arbitrary label-key sets via ``**``).
+_METRICS_MECHANISM_REL = "stats/metrics.py"
+
+#: The closed label-key vocabulary.  A new label key is a schema decision
+#: — every dashboard/alert joins on it — so adding one here should be a
+#: deliberate, reviewed act, with a bounded value vocabulary to match.
+_ALLOWED_LABEL_KEYS = {
+    # control-plane self-telemetry (registry ops, tick phases, API)
+    "op", "phase", "route", "method", "code",
+    # alert lifecycle
+    "rule", "run", "severity",
+    # remediation / notifier / autoscaler
+    "action", "outcome", "direction",
+    # serving fleet
+    "replica", "fleet",
+    # renderer-owned exposition labels
+    "le", "component", "process", "version", "kind",
+}
+
+
+def _is_stringy(node: ast.AST) -> bool:
+    return isinstance(node, ast.JoinedStr) or (
+        isinstance(node, ast.Constant) and isinstance(node.value, str)
+    )
+
+
+def _interpolation_kind(value: ast.AST) -> Optional[str]:
+    """How a label-value expression interpolates, or None if it doesn't.
+
+    Lexical: flags the construction *shapes* (f-string, ``.format``,
+    %-format, string concatenation) that splice an identifier into the
+    value at the call site.  A plain variable passes — the cardinality
+    cap is the runtime backstop for those.
+    """
+    if isinstance(value, ast.JoinedStr):
+        return "an f-string"
+    if (
+        isinstance(value, ast.Call)
+        and isinstance(value.func, ast.Attribute)
+        and value.func.attr == "format"
+    ):
+        return "a .format() call"
+    if isinstance(value, ast.BinOp):
+        if isinstance(value.op, ast.Mod) and _is_stringy(value.left):
+            return "%-formatting"
+        if isinstance(value.op, ast.Add) and (
+            _is_stringy(value.left) or _is_stringy(value.right)
+        ):
+            return "string concatenation"
+    return None
+
+
+class MetricLabelRule(Rule):
+    id = "GL007"
+    name = "metric-labels"
+    version = "1"
+    doc = (
+        "labeled_key() label keys must come from the allowed-label "
+        "catalog, and label values must not be built by interpolation "
+        "(f-string/.format/%-format/concatenation) — a spliced unbounded "
+        "identifier mints one series per value, growing /metrics and "
+        "every snapshot without limit"
+    )
+
+    def check_module(self, mod: ModuleInfo, project: Project):
+        if mod.rel.endswith(_METRICS_MECHANISM_REL):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name != "labeled_key" and not name.endswith(".labeled_key"):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    yield self.finding(
+                        mod,
+                        node,
+                        "labeled_key() called with a **kwargs label set — "
+                        "the label keys can't be reviewed against the "
+                        "allowed catalog; pass explicit keywords",
+                    )
+                    continue
+                if kw.arg not in _ALLOWED_LABEL_KEYS:
+                    yield self.finding(
+                        mod,
+                        kw.value,
+                        f"label key `{kw.arg}` is not in the allowed "
+                        "label-key catalog (analysis/rules.py:"
+                        "_ALLOWED_LABEL_KEYS) — new label keys are a "
+                        "metrics-schema decision; add it deliberately "
+                        "with a bounded value vocabulary",
+                    )
+                kind = _interpolation_kind(kw.value)
+                if kind is not None:
+                    yield self.finding(
+                        mod,
+                        kw.value,
+                        f"label value for `{kw.arg}` built via {kind} — "
+                        "interpolating an identifier mints one series per "
+                        "value; map it through a closed vocabulary first",
+                    )
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = [
     JitPurityRule,
@@ -714,6 +828,7 @@ ALL_RULES = [
     TickPathRule,
     KnobRegistryRule,
     NetTimeoutRule,
+    MetricLabelRule,
 ]
 
 

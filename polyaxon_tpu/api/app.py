@@ -14,9 +14,10 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from typing import Any, Dict, Optional
 
-from polyaxon_tpu.conf.knobs import knob_str
+from polyaxon_tpu.conf.knobs import knob_int, knob_str
 from polyaxon_tpu.db.registry import RemediationStatus, Run, RunRegistry
 from polyaxon_tpu.events import EventTypes
 from polyaxon_tpu.exceptions import PolyaxonTPUError
@@ -24,6 +25,7 @@ from polyaxon_tpu.monitor.watcher import anomaly_status, goodput_status
 from polyaxon_tpu.orchestrator import Orchestrator
 from polyaxon_tpu.stats.metrics import (
     PROMETHEUS_CONTENT_TYPE,
+    labeled_key,
     render_prometheus,
     render_standard_gauges,
 )
@@ -32,6 +34,10 @@ from polyaxon_tpu.tracking.trace import chrome_trace
 logger = logging.getLogger(__name__)
 
 API_PREFIX = "/api/v1"
+
+#: Status classes for the per-route request counter — a fixed vocabulary
+#: (never the raw code) keeps the label set bounded.
+_STATUS_CLASSES = {1: "1xx", 2: "2xx", 3: "3xx", 4: "4xx", 5: "5xx"}
 
 
 def run_to_dict(run: Run) -> Dict[str, Any]:
@@ -174,9 +180,14 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         if snapshot_fn is None:
             body = f"# stats backend {type(orch.stats).__name__} keeps no in-process registry\n"
         else:
-            body = render_prometheus(
-                snapshot_fn(), labels={"component": "control_plane"}
-            )
+            # The renderer only reads counters/gauges/histograms, so skip
+            # the raw timing-window copy — by far the largest lock-held
+            # cost of a scrape (up to 512 floats per key).
+            try:
+                snap = snapshot_fn(include_timings=False)
+            except TypeError:  # duck-typed stand-in without the kwarg
+                snap = snapshot_fn()
+            body = render_prometheus(snap, labels={"component": "control_plane"})
         # Exposition hygiene: standard process/build gauges render even
         # when the stats backend keeps no registry.
         body += render_standard_gauges(labels={"component": "control_plane"})
@@ -1124,13 +1135,24 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         )
 
     # -- live streaming (WS) --------------------------------------------------
+    ws_tails_active = [0]  # closure-shared gauge source across tail handlers
+
     async def _ws_tail(request, fetch, poll: float = 0.5, scoped: bool = True):
         """Generic WS tail loop: push new rows until the run is done.
 
         ``scoped=False`` is the cluster-feed variant (no run in the path):
         ``fetch`` gets None for the run id and the loop never sees a
-        terminal run, so it streams until the client hangs up."""
+        terminal run, so it streams until the client hangs up.
+
+        Fan-out is batch-capped (``POLYAXON_TPU_WS_TAIL_MAX_BATCH``): a
+        cold tail over a huge history drains in bounded bursts — the
+        cursor only advances over rows actually sent, so the remainder is
+        re-fetched immediately (no poll sleep while a backlog stands).
+        ``ws_tail_backlog_rows`` exports the standing depth; a client that
+        hangs up mid-drain counts its unsent rows as drops."""
         run = _run_or_404(request) if scoped else None
+        stats = orch.stats
+        max_batch = knob_int("POLYAXON_TPU_WS_TAIL_MAX_BATCH")
         # Select ONLY the fixed ``bearer`` name (browsers abort the
         # handshake if the server selects none of the offered protocols,
         # so the dashboard offers ['bearer', 'bearer.<token>']).  Echoing
@@ -1140,6 +1162,9 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         ws = web.WebSocketResponse(heartbeat=30, protocols=("bearer",))
         await ws.prepare(request)
         cursor = 0
+        backlog = 0
+        ws_tails_active[0] += 1
+        stats.gauge("ws_tail_active", float(ws_tails_active[0]))
         try:
             while not ws.closed:
                 # The run can be DELETEd out from under a live tail; close
@@ -1150,12 +1175,20 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 except PolyaxonTPUError:
                     await ws.send_json({"event": "deleted"})
                     break
+                backlog = max(0, len(rows) - max_batch) if max_batch > 0 else 0
+                if backlog:
+                    rows = rows[:max_batch]
+                stats.gauge("ws_tail_backlog_rows", float(backlog))
                 for row in rows:
                     cursor = max(cursor, row.get("id", cursor))
                     await ws.send_json(row)
+                if rows:
+                    stats.incr("ws_tail_rows_total", len(rows))
                 if current is not None and current.is_done and not rows:
                     await ws.send_json({"event": "done", "status": current.status})
                     break
+                if backlog:
+                    continue  # deferred rows re-fetch now, not after poll
                 try:
                     msg = await asyncio.wait_for(ws.receive(), timeout=poll)
                     if msg.type in (WSMsgType.CLOSE, WSMsgType.CLOSING, WSMsgType.ERROR):
@@ -1163,6 +1196,10 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 except asyncio.TimeoutError:
                     pass
         finally:
+            ws_tails_active[0] -= 1
+            stats.gauge("ws_tail_active", float(ws_tails_active[0]))
+            if backlog:
+                stats.incr("ws_tail_dropped_rows_total", backlog)
             await ws.close()
         return ws
 
@@ -1407,7 +1444,45 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             request["actor"], request["role"] = "anonymous", "admin"
         return await handler(request)
 
-    app = web.Application(middlewares=[auth_middleware])
+    @web.middleware
+    async def telemetry_middleware(request, handler):
+        # Per-endpoint API latency keyed by the ROUTE TEMPLATE
+        # (``/api/v1/runs/{run_id}``), never the resolved path — raw run
+        # ids in a label would grow one series per run.  WS upgrades are
+        # excluded from the latency histogram (a tail handler's "latency"
+        # is the session length, which would swamp the REST p99); they
+        # carry their own ws_tail_* series instead.
+        stats = orch.stats
+        if request.path.startswith("/ws/"):
+            return await handler(request)
+        t0 = time.perf_counter()
+        code = 500
+        try:
+            resp = await handler(request)
+            code = resp.status
+            return resp
+        except web.HTTPException as e:
+            code = e.status
+            raise
+        finally:
+            resource = getattr(request.match_info.route, "resource", None)
+            canonical = getattr(resource, "canonical", None)
+            route = canonical if canonical else "unmatched"
+            elapsed = time.perf_counter() - t0
+            stats.observe(
+                labeled_key("api_request_s", method=request.method, route=route),
+                elapsed,
+            )
+            stats.incr(
+                labeled_key(
+                    "api_request_total",
+                    code=_STATUS_CLASSES.get(code // 100, "other"),
+                    method=request.method,
+                    route=route,
+                )
+            )
+
+    app = web.Application(middlewares=[telemetry_middleware, auth_middleware])
     app.add_routes(routes)
     app["orchestrator"] = orch
     return app

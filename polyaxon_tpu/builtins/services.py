@@ -205,8 +205,12 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
                 if snapshot_fn is None:
                     text = "# engine stats backend keeps no in-process registry\n"
                 else:
+                    try:
+                        snap = snapshot_fn(include_timings=False)
+                    except TypeError:  # duck-typed stand-in without the kwarg
+                        snap = snapshot_fn()
                     text = render_prometheus(
-                        snapshot_fn(), labels={"component": "lm_server"}
+                        snap, labels={"component": "lm_server"}
                     )
                 text += render_standard_gauges(labels={"component": "lm_server"})
                 body = text.encode("utf-8")

@@ -283,6 +283,19 @@ _ALL: List[Knob] = [
     # -- worker / monitoring ------------------------------------------------
     Knob("POLYAXON_TPU_RESOURCE_INTERVAL", "float", 10.0,
          "host/device resource sampler cadence (s)", "worker"),
+    # -- control-plane self-telemetry --------------------------------------
+    Knob("POLYAXON_TPU_METRICS_MAX_SERIES", "int", 1024,
+         "per-metric cap on distinct label sets in MemoryStats; overflow "
+         "folds into one {...=\"other\"} series (+ one warning)",
+         "cp-telemetry"),
+    Knob("POLYAXON_TPU_RETENTION_SWEEP_ROWS", "int", 20000,
+         "per-tick row budget for the registry retention sweep (one "
+         "transaction per tick; leftovers age out on later ticks)",
+         "cp-telemetry"),
+    Knob("POLYAXON_TPU_WS_TAIL_MAX_BATCH", "int", 500,
+         "max rows a WS tail sends per poll; the remainder is deferred "
+         "to the next poll and exported as ws_tail_backlog_rows",
+         "cp-telemetry"),
     # -- control plane / CLI ------------------------------------------------
     Knob("POLYAXON_TPU_HOME", "str", "~/.polyaxon_tpu",
          "platform state dir for the local CLI and tooling state",

@@ -31,6 +31,7 @@ are ordinary rows so the streaming layer can tail them with a cursor.
 
 from __future__ import annotations
 
+import functools
 import json
 import sqlite3
 import threading
@@ -42,6 +43,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 from polyaxon_tpu.exceptions import PolyaxonTPUError
 from polyaxon_tpu.lifecycles import StatusOptions as S, lifecycle_for_kind
+from polyaxon_tpu.stats.metrics import labeled_key
 from polyaxon_tpu.schemas.specifications import (
     BaseSpecification,
     specification_for_kind,
@@ -525,6 +527,112 @@ def _row_to_run(row: sqlite3.Row) -> Run:
     )
 
 
+class _TimedLock:
+    """``threading.Lock`` wrapper observing wait + hold time on a stats
+    backend (``registry_lock_wait_s`` / ``registry_lock_hold_s``).
+
+    Assigned to ``RunRegistry._lock`` so the ~60 ``with self._lock``
+    write sites — and graft-lint GL003's lexical lock-discipline check —
+    keep working unchanged.  With no stats attached the wrapper costs one
+    attribute read per acquisition; ``_held_at`` is only touched by the
+    holding thread, so it needs no extra synchronization.
+    """
+
+    __slots__ = ("_lock", "_owner", "_held_at")
+
+    def __init__(self, owner: "RunRegistry") -> None:
+        self._lock = threading.Lock()
+        self._owner = owner
+        self._held_at = 0.0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        stats = self._owner._stats
+        if stats is None:
+            return self._lock.acquire(blocking, timeout)
+        t0 = time.perf_counter()
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            stats.observe("registry_lock_wait_s", time.perf_counter() - t0)
+            self._held_at = time.perf_counter()
+        return got
+
+    def release(self) -> None:
+        stats = self._owner._stats
+        if stats is not None and self._held_at:
+            stats.observe(
+                "registry_lock_hold_s", time.perf_counter() - self._held_at
+            )
+            self._held_at = 0.0
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "_TimedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+
+#: Operation families for ``registry_op_s{op=...}`` — a bounded label set
+#: (GL007 checks label values stay bounded; raw method names would be ~100
+#: series, these are 6).  Methods not named here classify by prefix.
+_INGEST_OPS = frozenset({
+    "add_metric", "add_log", "add_logs", "add_span", "add_utilization",
+    "add_anomaly", "upsert_progress", "ping_heartbeat", "set_report_offset",
+    "upsert_process", "upsert_capture", "record_activity",
+})
+_LIFECYCLE_OPS = frozenset({
+    "create_run", "set_status", "update_run", "merge_run_meta",
+    "archive_run", "restore_run", "delete_run",
+})
+_RETENTION_OPS = frozenset({
+    "clean_old_rows", "expire_commands", "expire_remediations",
+})
+_READ_PREFIXES = (
+    "get_", "list_", "last_", "count_", "has_", "project_", "free_",
+    "queued_", "zombie_", "stale_", "archived_", "usage_", "advance_",
+)
+
+
+def _op_family(name: str) -> str:
+    if name in _INGEST_OPS:
+        return "ingest"
+    if name in _LIFECYCLE_OPS:
+        return "lifecycle"
+    if name in _RETENTION_OPS:
+        return "retention"
+    if name in ("upsert_alert", "delete_alert"):
+        return "alerts"
+    if name.startswith(_READ_PREFIXES):
+        return "read"
+    return "write"
+
+
+def _timed_op(name: str, fn: Any) -> Any:
+    """Per-operation-family latency wrapper applied to every public
+    ``RunRegistry`` method: with a stats backend attached each call lands
+    in ``registry_op_s{op=<family>}``; without one the overhead is a
+    single attribute check."""
+    key = labeled_key("registry_op_s", op=_op_family(name))
+
+    @functools.wraps(fn)
+    def wrapper(self: "RunRegistry", *args: Any, **kwargs: Any) -> Any:
+        stats = self._stats
+        if stats is None:
+            return fn(self, *args, **kwargs)
+        t0 = time.perf_counter()
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            stats.observe(key, time.perf_counter() - t0)
+
+    wrapper.__wrapped_op__ = name
+    return wrapper
+
+
 class RunRegistry:
     """Sqlite-backed run registry, safe across threads and processes.
 
@@ -533,10 +641,14 @@ class RunRegistry:
     silently skips illegal writes after checking ``can_transition``.
     """
 
+    #: Self-telemetry backend (None = uninstrumented).  A class attribute
+    #: so the lock/op wrappers are safe during ``__init__`` too.
+    _stats: Optional[Any] = None
+
     def __init__(self, path: Union[str, Path] = ":memory:") -> None:
         self.path = str(path)
         self._local = threading.local()
-        self._lock = threading.Lock()
+        self._lock = _TimedLock(self)
         with self._conn() as conn:
             conn.executescript(_SCHEMA)
             # In-place migration for registries created before the durable
@@ -583,6 +695,16 @@ class RunRegistry:
         if conn is not None:
             conn.close()
             self._local.conn = None
+
+    # -- self-telemetry --------------------------------------------------------
+    def attach_stats(self, stats: Optional[Any]) -> None:
+        """Turn on registry self-telemetry: per-operation-family latency
+        (``registry_op_s{op=...}``) plus write-lock wait/hold histograms
+        (``registry_lock_wait_s`` / ``registry_lock_hold_s``) on ``stats``.
+        The orchestrator calls this once its stats backend exists (the
+        registry is constructed first — it *stores* the config the stats
+        backend choice reads from).  ``None`` detaches."""
+        self._stats = stats
 
     # -- runs ----------------------------------------------------------------
     def create_run(
@@ -2285,73 +2407,79 @@ class RunRegistry:
         ]
 
     # -- retention cleanup ----------------------------------------------------
-    def clean_old_rows(self, older_than_seconds: float, now: Optional[float] = None) -> Dict[str, int]:
+    #: Retention sweep targets: (result key, table, age column, scope to
+    #: finished runs?).  ``alerts``/``remediations`` key off ``updated_at``
+    #: — a row's last lifecycle edge, not its creation, decides when it
+    #: falls off the timeline (a long-lived firing alert must survive).
+    _SWEEP_TABLES: Sequence[Tuple[str, str, str, bool]] = (
+        ("activity", "activity", "created_at", False),
+        ("logs", "logs", "created_at", True),
+        ("spans", "spans", "created_at", True),
+        ("anomalies", "anomalies", "created_at", True),
+        ("utilization", "utilization", "created_at", True),
+        ("commands", "commands", "created_at", True),
+        ("captures", "captures", "created_at", True),
+        ("alerts", "alerts", "updated_at", True),
+        ("remediations", "remediations", "updated_at", True),
+    )
+
+    def clean_old_rows(
+        self,
+        older_than_seconds: float,
+        now: Optional[float] = None,
+        max_rows: Optional[int] = None,
+    ) -> Dict[str, int]:
         """Delete activity/log rows past the retention horizon for DONE runs.
 
         Parity: the reference's beat cleaners (``crons/tasks/cleaning.py``,
         activity-log & notification cleanup, archived deletion).
+
+        One transaction per call, bounded by a per-tick row budget
+        (``max_rows``, default ``POLYAXON_TPU_RETENTION_SWEEP_ROWS``): a
+        registry that accumulated months of backlog must not hold the
+        write lock for one giant sweep — leftovers age out on later
+        ticks.  The result carries per-table delete counts plus
+        ``truncated`` (1 when the budget ran out mid-sweep).
         """
+        if max_rows is None:
+            from polyaxon_tpu.conf.knobs import knob_int
+
+            max_rows = knob_int("POLYAXON_TPU_RETENTION_SWEEP_ROWS")
         now = now or time.time()
         cutoff = now - older_than_seconds
+        budget = int(max_rows) if max_rows and max_rows > 0 else None
+        counts: Dict[str, int] = {key: 0 for key, *_ in self._SWEEP_TABLES}
+        truncated = False
         with self._lock, self._conn() as conn:
-            act = conn.execute(
-                "DELETE FROM activity WHERE created_at < ?", (cutoff,)
-            ).rowcount
-            logs = conn.execute(
-                """DELETE FROM logs WHERE created_at < ? AND run_id IN
-                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
-                (cutoff, cutoff),
-            ).rowcount
-            spans = conn.execute(
-                """DELETE FROM spans WHERE created_at < ? AND run_id IN
-                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
-                (cutoff, cutoff),
-            ).rowcount
-            anomalies = conn.execute(
-                """DELETE FROM anomalies WHERE created_at < ? AND run_id IN
-                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
-                (cutoff, cutoff),
-            ).rowcount
-            utilization = conn.execute(
-                """DELETE FROM utilization WHERE created_at < ? AND run_id IN
-                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
-                (cutoff, cutoff),
-            ).rowcount
-            commands = conn.execute(
-                """DELETE FROM commands WHERE created_at < ? AND run_id IN
-                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
-                (cutoff, cutoff),
-            ).rowcount
-            captures = conn.execute(
-                """DELETE FROM captures WHERE created_at < ? AND run_id IN
-                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
-                (cutoff, cutoff),
-            ).rowcount
-            # Retention keys off updated_at: an alert row's created_at is its
-            # FIRST transition, and a long-lived firing alert must survive.
-            alerts = conn.execute(
-                """DELETE FROM alerts WHERE updated_at < ? AND run_id IN
-                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
-                (cutoff, cutoff),
-            ).rowcount
-            # updated_at like alerts: a row's last lifecycle edge, not its
-            # creation, decides when the action falls off the timeline.
-            remediations = conn.execute(
-                """DELETE FROM remediations WHERE updated_at < ? AND run_id IN
-                   (SELECT id FROM runs WHERE finished_at IS NOT NULL AND finished_at < ?)""",
-                (cutoff, cutoff),
-            ).rowcount
-        return {
-            "activity": act,
-            "logs": logs,
-            "spans": spans,
-            "anomalies": anomalies,
-            "utilization": utilization,
-            "commands": commands,
-            "captures": captures,
-            "alerts": alerts,
-            "remediations": remediations,
-        }
+            for key, table, age_col, scoped in self._SWEEP_TABLES:
+                if budget is not None and budget <= 0:
+                    truncated = True
+                    break
+                # DELETE ... LIMIT isn't guaranteed compiled into the
+                # stdlib's sqlite; the rowid-subselect form always works.
+                scope = (
+                    " AND run_id IN (SELECT id FROM runs WHERE"
+                    " finished_at IS NOT NULL AND finished_at < ?)"
+                    if scoped
+                    else ""
+                )
+                params: List[Any] = [cutoff] + ([cutoff] if scoped else [])
+                sql = (
+                    f"DELETE FROM {table} WHERE rowid IN"
+                    f" (SELECT rowid FROM {table} WHERE {age_col} < ?{scope}"
+                )
+                if budget is not None:
+                    sql += " LIMIT ?"
+                    params.append(budget)
+                sql += ")"
+                deleted = conn.execute(sql, params).rowcount
+                counts[key] = deleted
+                if budget is not None:
+                    budget -= deleted
+                    if budget <= 0 and deleted > 0:
+                        truncated = True
+        counts["truncated"] = int(truncated)
+        return counts
 
     # -- projects (entity metadata over runs.project) --------------------------
     def create_project(
@@ -2887,3 +3015,19 @@ class RunRegistry:
     def delete_option(self, key: str) -> None:
         with self._lock, self._conn() as conn:
             conn.execute("DELETE FROM options WHERE key = ?", (key,))
+
+
+# Instrument every public RunRegistry method with the op-family timer.
+# Done once at import — the per-call cost without an attached stats
+# backend is one attribute check inside the wrapper.
+import types as _types
+
+for _name, _fn in list(vars(RunRegistry).items()):
+    if (
+        _name.startswith("_")
+        or _name in ("attach_stats", "close")
+        or not isinstance(_fn, _types.FunctionType)
+    ):
+        continue
+    setattr(RunRegistry, _name, _timed_op(_name, _fn))
+del _name, _fn

@@ -23,6 +23,7 @@ from polyaxon_tpu.db.registry import RunRegistry
 from polyaxon_tpu.lifecycles import StatusOptions as S
 from polyaxon_tpu.lifecycles.registry import gang_status
 from polyaxon_tpu.spawner.local import GangHandle
+from polyaxon_tpu.stats.metrics import labeled_key
 from polyaxon_tpu.tracking.trace import get_tracer
 
 logger = logging.getLogger(__name__)
@@ -266,6 +267,13 @@ class GangWatcher:
     # -- report ingestion -----------------------------------------------------
     def ingest(self, handle: GangHandle) -> None:
         """Drain new report lines from every gang process into the registry."""
+        # Ingest-lag watermark: the newest report line's own wall time
+        # ("at" for progress beats, "ts" otherwise).  now - watermark is
+        # how far this gang's telemetry lags reality — the control plane's
+        # single best saturation signal (a healthy watcher keeps it near
+        # the workers' emit cadence; a saturated one falls behind even
+        # though every poll "succeeds").
+        newest = float(getattr(handle, "ingest_newest_at", 0.0) or 0.0)
         for process_id in range(handle.plan.num_hosts):
             path = handle.paths.report_file(process_id)
             if not path.exists():
@@ -328,6 +336,10 @@ class GangWatcher:
                         raw[:200],
                         exc_info=True,
                     )
+                else:
+                    at = event.get("at") or event.get("ts")
+                    if isinstance(at, (int, float)) and at > newest:
+                        newest = float(at)
             # Durable cursor: a restarted control plane reattaches and
             # resumes the tail here. Persisted AFTER the apply loop — a
             # crash in between replays these lines (status upserts are
@@ -336,6 +348,11 @@ class GangWatcher:
             self.registry.set_report_offset(
                 handle.run_id, process_id, offset + end + 1
             )
+        if newest:
+            try:
+                handle.ingest_newest_at = newest
+            except Exception:  # frozen test stand-ins: no lag tracking
+                pass
 
     def _apply(self, handle: GangHandle, process_id: int, event: dict) -> None:
         etype = event.get("type")
@@ -605,6 +622,43 @@ class GangWatcher:
         active = sum(1 for c in cmds if c["status"] in ("pending", "acked"))
         self.stats.gauge("profile_capture_active", float(active))
 
+    # -- ingest lag -------------------------------------------------------------
+    def _record_ingest_lag(
+        self, handle: GangHandle, *, terminal: bool, now: Optional[float] = None
+    ) -> None:
+        """Export how far this gang's report ingest lags the lines' own
+        wall times (watermark kept by :meth:`ingest`).
+
+        Per-run gauge ``watcher_ingest_lag_run_s{run=...}`` follows the
+        alarm-gauge discipline (recovers to 0 once the run goes terminal —
+        a finished run has nothing left to lag behind); the fleet-wide
+        ``watcher_ingest_lag_s`` histogram accumulates one sample per
+        live-run poll, so its p99 is the saturation-bench gate.
+        """
+        if self.stats is None:
+            return
+        key = labeled_key("watcher_ingest_lag_run_s", run=handle.run_id)
+        if terminal:
+            # Zero only the runs whose gauge was actually exported.
+            if getattr(handle, "ingest_lag_live", False):
+                self.stats.gauge(key, 0.0)
+                try:
+                    handle.ingest_lag_live = False
+                except Exception:
+                    pass
+            return
+        newest = float(getattr(handle, "ingest_newest_at", 0.0) or 0.0)
+        if not newest:
+            return  # no timestamped line ingested yet — nothing to lag
+        now = now if now is not None else time.time()
+        lag = max(0.0, now - newest)
+        self.stats.gauge(key, lag)
+        self.stats.observe("watcher_ingest_lag_s", lag)
+        try:
+            handle.ingest_lag_live = True
+        except Exception:  # frozen test stand-ins: export without recovery
+            pass
+
     def observe(self, handle: GangHandle) -> Optional[str]:
         """One poll: ingest reports, reconcile liveness, return gang roll-up."""
         tracer = get_tracer()
@@ -616,6 +670,7 @@ class GangWatcher:
             self.ingest(handle)
             statuses = self.reconcile(handle)
             rollup = gang_status(statuses)
+            self._record_ingest_lag(handle, terminal=rollup != S.RUNNING)
             if rollup == S.RUNNING:
                 # Only live gangs can stall; a finished gang's progress rows
                 # age out harmlessly.

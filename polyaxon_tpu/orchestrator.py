@@ -98,6 +98,10 @@ class Orchestrator:
             self.stats = NoOpStats()
         else:
             self.stats = MemoryStats()
+        # Registry self-telemetry (op-family latency + lock wait/hold)
+        # attaches after the fact: the registry must exist first because
+        # the stats-backend *choice* is read through it.
+        self.registry.attach_stats(self.stats)
         self.bus = TaskBus(time_scale=time_scale, stats=self.stats)
         self.auditor = Auditor(self.registry)
         self.executor = ExecutorHandlers(self.bus)

@@ -25,6 +25,7 @@ from polyaxon_tpu.exceptions import PolyaxonTPUError
 from polyaxon_tpu.lifecycles import StatusOptions as S
 from polyaxon_tpu.monitor import AlertEngine, GangWatcher, RemediationEngine
 from polyaxon_tpu.spawner import GangHandle, GangSpawner
+from polyaxon_tpu.stats.metrics import labeled_key
 from polyaxon_tpu.stores import StoreLayout, create_snapshot
 from polyaxon_tpu.workers import CronTasks, SchedulerTasks, TaskBus
 
@@ -125,6 +126,17 @@ def _record_done(
 def register_scheduler_tasks(ctx: SchedulerContext) -> None:
     bus = ctx.bus
     reg = ctx.registry
+    # Tick-phase self-telemetry rides the watcher's backend (None on
+    # minimal test stands → every phase probe is a no-op).
+    stats = getattr(ctx.watcher, "stats", None)
+    phase_keys = {
+        phase: labeled_key("tick_phase_s", phase=phase)
+        for phase in ("watcher", "alerts", "remediation", "retention")
+    }
+
+    def _observe_phase(phase: str, seconds: float) -> None:
+        if stats is not None:
+            stats.observe(phase_keys[phase], seconds)
 
     @bus.register(SchedulerTasks.EXPERIMENTS_BUILD)
     def experiments_build(run_id: int) -> None:
@@ -296,9 +308,28 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
 
     @bus.register(SchedulerTasks.EXPERIMENTS_MONITOR)
     def experiments_monitor(run_id: int) -> None:
+        t0 = time.perf_counter()
+        try:
+            _monitor_tick(run_id)
+        finally:
+            if stats is not None:
+                stats.observe("monitor_tick_s", time.perf_counter() - t0)
+
+    def _monitor_tick(run_id: int) -> None:
         handle = ctx.gangs.get(run_id)
         if handle is None:
             return
+        # Tick lag: how far past its scheduled cadence this poll fired —
+        # near-zero while the bus keeps up, climbing when monitor ticks
+        # queue behind other work (the first visible symptom of a
+        # saturated control plane).
+        now = time.monotonic()
+        last = getattr(handle, "last_monitor_at", None)
+        if stats is not None and last is not None:
+            expected = ctx.monitor_interval * ctx.bus.time_scale
+            stats.gauge("monitor_tick_lag_s", max(0.0, (now - last) - expected))
+        handle.last_monitor_at = now
+        phase_t0 = time.perf_counter()
         try:
             rollup = ctx.watcher.observe(handle)
             run = reg.get_run(run_id)
@@ -316,6 +347,8 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
                 return
             _reschedule_monitor(run_id)
             return
+        finally:
+            _observe_phase("watcher", time.perf_counter() - phase_t0)
         handle.monitor_failures = 0
         if run.is_done:
             # Stopped externally while we slept.
@@ -328,16 +361,20 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
                 # (interval_s) and counts rule errors instead of raising —
                 # but a registry-level failure here must not kill the poll.
                 transitions = []
+                phase_t0 = time.perf_counter()
                 try:
                     transitions = ctx.alerts.evaluate(handle) or []
                 except Exception:
                     logger.warning(
                         "Alert evaluation failed for run %s", run_id, exc_info=True
                     )
+                finally:
+                    _observe_phase("alerts", time.perf_counter() - phase_t0)
                 if ctx.remediation is not None:
                     # Detection→action: firing edges trigger typed actions
                     # (checkpoint-now, eviction); the tick advances
                     # multi-phase ones.  Never poll-fatal.
+                    phase_t0 = time.perf_counter()
                     try:
                         if transitions:
                             ctx.remediation.on_transitions(handle, transitions)
@@ -347,6 +384,10 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
                             "Remediation tick failed for run %s",
                             run_id,
                             exc_info=True,
+                        )
+                    finally:
+                        _observe_phase(
+                            "remediation", time.perf_counter() - phase_t0
                         )
         if rollup in (S.SUCCEEDED, S.FAILED, S.SKIPPED) and not handle.all_exited:
             # Gang is logically done but members are still alive — typically
@@ -547,7 +588,14 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
 
     @bus.register(CronTasks.CLEAN_ACTIVITY)
     def clean_activity(retention_seconds: float = 30 * 86400.0) -> None:
+        phase_t0 = time.perf_counter()
         removed = reg.clean_old_rows(retention_seconds)
+        _observe_phase("retention", time.perf_counter() - phase_t0)
+        if removed.get("truncated"):
+            logger.info(
+                "Retention sweep hit its per-tick row budget; the "
+                "remainder ages out on later ticks"
+            )
         if any(removed.values()):
             logger.info("Retention cleanup removed %s", removed)
 

@@ -40,8 +40,20 @@ from typing import Any, Dict, Optional
 
 from polyaxon_tpu.conf.knobs import knob_float, knob_int
 from polyaxon_tpu.serving.router import FleetRouter
+from polyaxon_tpu.stats.metrics import labeled_key
 
 __all__ = ["LocalServingFleet", "ServingFleet"]
+
+#: Shared phase key with the scheduler's monitor-tick breakdown — the
+#: autoscaler pump is one more control-plane phase on the same histogram.
+_AUTOSCALER_PHASE_KEY = labeled_key("tick_phase_s", phase="autoscaler")
+
+
+def _observe_autoscaler_phase(router: Any, seconds: float) -> None:
+    try:
+        router.metrics.observe(_AUTOSCALER_PHASE_KEY, seconds)
+    except Exception:  # pragma: no cover - stats must never raise
+        pass
 
 
 class LocalServingFleet:
@@ -256,7 +268,13 @@ class LocalServingFleet:
         if getattr(self.router, "_thread", None) is None:
             self.router.probe_all()
         if self.autoscaler is not None:
-            self.autoscaler.evaluate()
+            t0 = time.perf_counter()
+            try:
+                self.autoscaler.evaluate()
+            finally:
+                _observe_autoscaler_phase(
+                    self.router, time.perf_counter() - t0
+                )
 
 
 class ServingFleet:
@@ -418,7 +436,13 @@ class ServingFleet:
             elif op["phase"] == "replacing":
                 self._poll_replacing(run_id, op, now)
         if self.autoscaler is not None:
-            self.autoscaler.evaluate(now)
+            t0 = time.perf_counter()
+            try:
+                self.autoscaler.evaluate(now)
+            finally:
+                _observe_autoscaler_phase(
+                    self.router, time.perf_counter() - t0
+                )
 
     def _register_urls(self) -> None:
         for name, run_id in list(self._runs.items()):

@@ -753,8 +753,12 @@ def make_router_handler(router: FleetRouter, meta: Optional[dict] = None):
                 if snapshot_fn is None:
                     text = "# router stats backend keeps no registry\n"
                 else:
+                    try:
+                        snap = snapshot_fn(include_timings=False)
+                    except TypeError:  # duck-typed stand-in without the kwarg
+                        snap = snapshot_fn()
                     text = render_prometheus(
-                        snapshot_fn(), labels={"component": "router"}
+                        snap, labels={"component": "router"}
                     )
                 text += render_standard_gauges(labels={"component": "router"})
                 body = text.encode("utf-8")

@@ -8,14 +8,17 @@ with no dependency; the memory backend is for tests and the /status page.
 
 from __future__ import annotations
 
+import logging
 import socket
 import threading
 import time
 from collections import defaultdict, deque
 from contextlib import contextmanager
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
-from polyaxon_tpu.stats.metrics import Histogram
+from polyaxon_tpu.stats.metrics import Histogram, fold_labeled_key
+
+logger = logging.getLogger(__name__)
 
 
 class StatsBackend:
@@ -70,12 +73,27 @@ class MemoryStats(StatsBackend):
     and read by iteration (health checks, the /metrics renderer) — all
     access goes through one lock, and readers should use :meth:`snapshot`
     rather than iterating the live dicts.
+
+    Labeled series (``alert_state{rule=...,run=...}``) are capped per base
+    metric name at ``max_series`` distinct label sets
+    (``POLYAXON_TPU_METRICS_MAX_SERIES``); overflow folds into a single
+    ``{...="other"}`` series so a buggy caller interpolating an unbounded
+    identifier degrades the one metric instead of growing ``/metrics``
+    (and every snapshot) without limit.
     """
 
     TIMING_WINDOW = 512
 
-    def __init__(self) -> None:
+    def __init__(self, max_series: Optional[int] = None) -> None:
+        if max_series is None:
+            from polyaxon_tpu.conf.knobs import knob_int
+
+            max_series = knob_int("POLYAXON_TPU_METRICS_MAX_SERIES")
         self._lock = threading.Lock()
+        self._max_series = int(max_series)
+        #: base metric name -> admitted labeled keys (cap bookkeeping).
+        self._series: Dict[str, Set[str]] = defaultdict(set)
+        self._fold_warned: Set[str] = set()
         self.counters: Dict[str, int] = defaultdict(int)
         self.gauges: Dict[str, float] = {}
         self.timings: Dict[str, deque] = defaultdict(
@@ -83,23 +101,52 @@ class MemoryStats(StatsBackend):
         )
         self.histograms: Dict[str, Histogram] = {}
 
+    def _admit(self, key: str) -> str:
+        """Cardinality gate (caller holds the lock): the key itself, or its
+        ``other``-fold once the base metric is at ``max_series`` distinct
+        label sets.  Flat keys pass through untouched."""
+        if self._max_series <= 0 or "{" not in key:
+            return key
+        base = key.partition("{")[0]
+        seen = self._series[base]
+        if key in seen:
+            return key
+        if len(seen) < self._max_series:
+            seen.add(key)
+            return key
+        folded = fold_labeled_key(key)
+        if folded not in seen and len(seen) == self._max_series:
+            seen.add(folded)  # the fold series itself always fits
+        if base not in self._fold_warned:
+            self._fold_warned.add(base)
+            logger.warning(
+                "metric %r exceeded POLYAXON_TPU_METRICS_MAX_SERIES=%d "
+                "label sets; overflow folds into %r",
+                base,
+                self._max_series,
+                folded,
+            )
+        self.counters["metrics_series_folded"] += 1
+        return folded
+
     def incr(self, key: str, value: int = 1) -> None:
         with self._lock:
-            self.counters[key] += value
+            self.counters[self._admit(key)] += value
 
     def gauge(self, key: str, value: float) -> None:
         with self._lock:
-            self.gauges[key] = value
+            self.gauges[self._admit(key)] = value
 
     def timing(self, key: str, seconds: float) -> None:
         with self._lock:
+            key = self._admit(key)
             self.timings[key].append(seconds)
             self._histogram(key).observe(seconds)
 
     def observe(self, key: str, value: float) -> None:
         """Histogram-only sample (no raw-window copy kept)."""
         with self._lock:
-            self._histogram(key).observe(value)
+            self._histogram(self._admit(key)).observe(value)
 
     def _histogram(self, key: str) -> Histogram:
         hist = self.histograms.get(key)
@@ -107,18 +154,29 @@ class MemoryStats(StatsBackend):
             hist = self.histograms[key] = Histogram()
         return hist
 
-    def snapshot(self) -> Dict[str, Any]:
+    def snapshot(self, include_timings: bool = True) -> Dict[str, Any]:
         """Consistent copy of all state, safe to iterate/serialize.
 
         The shape is what ``render_prometheus`` consumes: ``counters`` /
         ``gauges`` as plain dicts, ``timings`` as lists, ``histograms`` as
         ``Histogram.state()`` dicts.
+
+        ``include_timings=False`` skips copying the bounded raw-sample
+        windows (up to 512 floats *per key*) — the exposition path: the
+        Prometheus renderer only reads counters/gauges/histograms, and the
+        timings copy is by far the largest lock-held cost of a scrape, so
+        skipping it keeps concurrent ``observe()`` callers off this lock's
+        wait queue while ``/metrics`` is being served.
         """
         with self._lock:
             return {
                 "counters": dict(self.counters),
                 "gauges": dict(self.gauges),
-                "timings": {k: list(v) for k, v in self.timings.items()},
+                "timings": (
+                    {k: list(v) for k, v in self.timings.items()}
+                    if include_timings
+                    else {}
+                ),
                 "histograms": {k: h.state() for k, h in self.histograms.items()},
             }
 

@@ -27,6 +27,7 @@ __all__ = [
     "default_buckets",
     "labeled_key",
     "split_labeled_key",
+    "fold_labeled_key",
     "render_prometheus",
     "render_standard_gauges",
     "PROMETHEUS_CONTENT_TYPE",
@@ -184,6 +185,19 @@ def _escape_label_value(value: Any) -> str:
     return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def fold_labeled_key(key: str) -> str:
+    """The overflow series a labeled key collapses into when a backend's
+    per-metric cardinality cap is hit: same base name and label *keys*,
+    every label *value* replaced by ``other``.  One fold series per
+    (name, label-key-set), so a runaway caller degrades to a bounded
+    aggregate instead of growing ``/metrics`` without limit.
+    """
+    base, labels = split_labeled_key(key)
+    if not labels:
+        return key
+    return labeled_key(base, **{k: "other" for k in labels})
+
+
 def _labels(pairs: Mapping[str, Any]) -> str:
     if not pairs:
         return ""
@@ -209,10 +223,11 @@ def render_prometheus(
 ) -> str:
     """Render a ``MemoryStats.snapshot()`` as Prometheus text exposition.
 
-    Counters are exported with a ``_total`` suffix, gauges verbatim, and
-    histograms as cumulative ``_bucket{le=...}`` series + ``_sum`` and
-    ``_count``.  ``labels`` (e.g. ``{"process": "lm_server"}``) are added
-    to every sample.
+    Counters are exported with a ``_total`` suffix (idempotently — a
+    stat key already named ``*_total`` is not doubled), gauges verbatim,
+    and histograms as cumulative ``_bucket{le=...}`` series + ``_sum``
+    and ``_count``.  ``labels`` (e.g. ``{"process": "lm_server"}``) are
+    added to every sample.
     """
     base_labels = dict(labels or {})
     lines: List[str] = []
@@ -223,7 +238,9 @@ def render_prometheus(
     for key in sorted(snapshot.get("counters", {})):
         value = snapshot["counters"][key]
         base, own = split_labeled_key(key)
-        name = _metric_name(base, prefix) + "_total"
+        name = _metric_name(base, prefix)
+        if not name.endswith("_total"):
+            name += "_total"
         if name != last_typed:
             lines.append(f"# TYPE {name} counter")
             last_typed = name
@@ -241,23 +258,32 @@ def render_prometheus(
         series = dict(base_labels, **own) if own else base_labels
         lines.append(f"{name}{_labels(series)} {_fmt(value)}")
 
+    # Labeled histogram keys (``registry_op_s{op="write"}``) split like
+    # counters/gauges: the label set rides every bucket/sum/count sample
+    # of that series, and same-name series share one TYPE line (sorted
+    # keys put them adjacent).
+    last_typed = ""
     for key in sorted(snapshot.get("histograms", {})):
         state = snapshot["histograms"][key]
-        name = _metric_name(key, prefix)
+        base, own = split_labeled_key(key)
+        name = _metric_name(base, prefix)
         edges: Sequence[float] = state["edges"]
         counts: Sequence[int] = state["counts"]
-        lines.append(f"# TYPE {name} histogram")
+        if name != last_typed:
+            lines.append(f"# TYPE {name} histogram")
+            last_typed = name
+        series = dict(base_labels, **own) if own else base_labels
         running = 0
         for edge, n in zip(edges, counts):
             running += n
-            bucket_labels = dict(base_labels)
+            bucket_labels = dict(series)
             bucket_labels["le"] = _fmt(edge)
             lines.append(f"{name}_bucket{_labels(bucket_labels)} {running}")
-        inf_labels = dict(base_labels)
+        inf_labels = dict(series)
         inf_labels["le"] = "+Inf"
         lines.append(f"{name}_bucket{_labels(inf_labels)} {state['count']}")
-        lines.append(f"{name}_sum{_labels(base_labels)} {_fmt(state['sum'])}")
-        lines.append(f"{name}_count{_labels(base_labels)} {state['count']}")
+        lines.append(f"{name}_sum{_labels(series)} {_fmt(state['sum'])}")
+        lines.append(f"{name}_count{_labels(series)} {state['count']}")
 
     return "\n".join(lines) + "\n"
 

@@ -101,3 +101,22 @@ PHANTOM = "POLYAXON_TPU_DOES_NOT_EXIST"
 
 def notify(url, payload):
     return urllib.request.urlopen(url, data=payload)
+
+
+# -- GL007: metric label hygiene ----------------------------------------------
+
+def labeled_key(name, **labels):  # stand-in for stats.metrics.labeled_key
+    return name
+
+
+def export_bad_labels(stats, run_id, replica_name):
+    # f-string label value: one series per run id.
+    stats.gauge(labeled_key("queue_depth_bad", run=f"run-{run_id}"), 1.0)
+    # .format() label value.
+    stats.incr(labeled_key("events_bad", rule="rule-{}".format(run_id)))
+    # string concatenation.
+    stats.gauge(labeled_key("state_bad", replica="rep-" + replica_name), 0.0)
+    # label key outside the allowed catalog.
+    stats.incr(labeled_key("orders_bad", customer_id="42"))
+    # **kwargs label set: unreviewable keys.
+    stats.incr(labeled_key("dyn_bad", **{"run": str(run_id)}))

@@ -86,3 +86,26 @@ WILDCARD_MENTION = "tune via POLYAXON_TPU_REMEDIATION_* knobs"
 
 def notify(url, payload):
     return urllib.request.urlopen(url, data=payload, timeout=5.0)
+
+
+# -- GL007: bounded metric labels ---------------------------------------------
+
+def labeled_key(name, **labels):  # stand-in for stats.metrics.labeled_key
+    return name
+
+
+_CODE_CLASSES = {2: "2xx", 4: "4xx", 5: "5xx"}
+
+
+def export_good_labels(stats, run_id, method, code):
+    # Plain variables and catalogued keys: the runtime series cap is the
+    # backstop for value cardinality; no interpolation at the call site.
+    stats.gauge(labeled_key("queue_depth_ok", run=run_id), 1.0)
+    stats.incr(
+        labeled_key(
+            "api_request_ok",
+            method=method,
+            code=_CODE_CLASSES.get(code // 100, "other"),
+        )
+    )
+    stats.incr(labeled_key("plain_counter_ok"))

@@ -14,6 +14,7 @@ from polyaxon_tpu.analysis.rules import (
     JitPurityRule,
     KnobRegistryRule,
     LockDisciplineRule,
+    MetricLabelRule,
     NetTimeoutRule,
     TickPathRule,
 )
@@ -88,6 +89,17 @@ def test_gl006_fires_on_unbounded_urlopen():
     assert len(findings) == 2
 
 
+def test_gl007_fires_on_interpolated_and_uncatalogued_labels():
+    findings = _bad([MetricLabelRule()])
+    messages = " | ".join(f.message for f in findings)
+    assert len(findings) == 5
+    assert "f-string" in messages
+    assert ".format()" in messages
+    assert "concatenation" in messages
+    assert "customer_id" in messages
+    assert "**kwargs" in messages
+
+
 # -- precision: the good fixture is silent -----------------------------------
 
 @pytest.mark.parametrize(
@@ -99,6 +111,7 @@ def test_gl006_fires_on_unbounded_urlopen():
         TickPathRule,
         KnobRegistryRule,
         NetTimeoutRule,
+        MetricLabelRule,
     ],
 )
 def test_good_fixture_is_clean(rule_cls):

@@ -1,0 +1,104 @@
+"""API self-telemetry: per-endpoint latency histograms keyed by the ROUTE
+TEMPLATE (never the raw run id — bounded cardinality by construction) and
+status-class counters, all rendered on /metrics.
+"""
+
+import asyncio
+
+import pytest
+
+from polyaxon_tpu.api.app import create_app
+from polyaxon_tpu.orchestrator import Orchestrator
+from polyaxon_tpu.stats.metrics import labeled_key, split_labeled_key
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "noop:main"},
+    "environment": {"topology": {"accelerator": "cpu", "num_devices": 2}},
+}
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(tmp_path / "plat", monitor_interval=0.05)
+    yield o
+    o.stop()
+
+
+def drive(orch, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        app = create_app(orch)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+class TestRequestTelemetry:
+    def test_route_template_not_run_id_in_labels(self, orch):
+        run = orch.registry.create_run(dict(SPEC))
+
+        async def go(client):
+            assert (await client.get(f"/api/v1/runs/{run.id}")).status == 200
+            assert (await client.get("/api/v1/runs")).status == 200
+
+        drive(orch, go)
+        snap = orch.stats.snapshot(include_timings=False)
+        detail_key = labeled_key(
+            "api_request_s", method="GET", route="/api/v1/runs/{run_id}"
+        )
+        list_key = labeled_key(
+            "api_request_s", method="GET", route="/api/v1/runs"
+        )
+        assert snap["histograms"][detail_key]["count"] == 1
+        assert snap["histograms"][list_key]["count"] == 1
+        # Bounded cardinality: no api series may carry the raw run path.
+        for key in list(snap["histograms"]) + list(snap["counters"]):
+            base, labels = split_labeled_key(key)
+            if base.startswith("api_request"):
+                assert f"/api/v1/runs/{run.id}" != labels.get("route"), key
+
+    def test_status_classes_counted(self, orch):
+        async def go(client):
+            assert (await client.get("/api/v1/runs")).status == 200
+            assert (await client.get("/api/v1/runs/99999")).status == 404
+            assert (await client.get("/no/such/route")).status == 404
+
+        drive(orch, go)
+        counters = orch.stats.snapshot(include_timings=False)["counters"]
+        ok = labeled_key(
+            "api_request_total",
+            code="2xx",
+            method="GET",
+            route="/api/v1/runs",
+        )
+        missing = labeled_key(
+            "api_request_total",
+            code="4xx",
+            method="GET",
+            route="/api/v1/runs/{run_id}",
+        )
+        unmatched = labeled_key(
+            "api_request_total", code="4xx", method="GET", route="unmatched"
+        )
+        assert counters[ok] == 1
+        assert counters[missing] == 1
+        assert counters[unmatched] == 1
+
+    def test_metrics_endpoint_renders_api_histograms(self, orch):
+        async def go(client):
+            await client.get("/api/v1/runs")
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            return await resp.text()
+
+        body = drive(orch, go)
+        assert 'component="control_plane"' in body
+        assert "polyaxon_tpu_api_request_s_bucket" in body
+        assert 'route="/api/v1/runs"' in body

@@ -1,0 +1,170 @@
+"""Control-plane self-telemetry: watcher ingest lag, monitor tick-phase
+histograms, and the saturation loadgen harness.
+
+The ingest-lag gauge is the control plane's airspeed indicator — these
+tests pin its three load-bearing behaviors: it RISES when the watcher
+falls behind the report files, RECOVERS to ~0 once the tail catches up,
+and resets to 0 when the gang goes terminal (a dead run must not pin a
+stale lag on /metrics forever).
+"""
+
+import json
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from polyaxon_tpu.db import RunRegistry
+from polyaxon_tpu.lifecycles import StatusOptions as S
+from polyaxon_tpu.monitor import GangWatcher
+from polyaxon_tpu.monitor.cploadgen import make_gang, run_saturation
+from polyaxon_tpu.stats import MemoryStats
+from polyaxon_tpu.stats.metrics import labeled_key
+from polyaxon_tpu.stores import StoreLayout
+
+
+@pytest.fixture()
+def rig(tmp_path):
+    reg = RunRegistry(tmp_path / "registry.db")
+    stats = MemoryStats()
+    reg.attach_stats(stats)
+    layout = StoreLayout(tmp_path / "store")
+    watcher = GangWatcher(reg, stats=stats)
+    return SimpleNamespace(
+        registry=reg, layout=layout, stats=stats, watcher=watcher
+    )
+
+
+def _write_lines(handle, lines, process_id=0):
+    with open(handle.paths.report_file(process_id), "a") as fh:
+        for line in lines:
+            fh.write(json.dumps(line) + "\n")
+
+
+class TestIngestLag:
+    def test_lag_rises_behind_backlog_and_recovers_after_catchup(self, rig):
+        handle = make_gang(rig, num_procs=1)
+        key = labeled_key("watcher_ingest_lag_run_s", run=handle.run_id)
+        now = time.time()
+        # A 30s backlog of progress beats, oldest first — the shape left
+        # behind by a watcher that stopped polling for half a minute.
+        _write_lines(
+            handle,
+            [
+                {"type": "progress", "step": i, "at": now - 30 + i * 0.6, "ts": now}
+                for i in range(50)
+            ],
+        )
+        # A tiny poll budget forces the bounded-read ingest to drain the
+        # backlog across many polls: the first observe only reaches the
+        # OLD lines, so the lag gauge must show the watcher is behind.
+        rig.watcher.max_poll_bytes = 256
+        rig.watcher.observe(handle)
+        assert rig.stats.gauges[key] > 5.0
+        # Catch-up: keep polling until the tail drains; lag recovers ~0.
+        for _ in range(100):
+            rig.watcher.observe(handle)
+        assert rig.stats.gauges[key] < 2.0
+        # The fleet histogram sampled once per live poll along the way.
+        summary = rig.stats.summaries()["watcher_ingest_lag_s"]
+        assert summary["count"] >= 2
+        assert summary["p99"] > 0.0
+
+    def test_lag_gauge_resets_to_zero_on_terminal(self, rig):
+        handle = make_gang(rig, num_procs=1)
+        key = labeled_key("watcher_ingest_lag_run_s", run=handle.run_id)
+        _write_lines(
+            handle,
+            [{"type": "progress", "step": 1, "at": time.time() - 7.0}],
+        )
+        rig.watcher.observe(handle)
+        assert rig.stats.gauges[key] > 5.0
+        assert handle.ingest_lag_live
+        # The lone process exits cleanly → roll-up goes terminal → the
+        # per-run gauge must recover to 0 instead of pinning stale lag.
+        handle.processes[0] = SimpleNamespace(poll=lambda: 0, pid=0)
+        rig.watcher.observe(handle)
+        assert rig.stats.gauges[key] == 0.0
+        assert not handle.ingest_lag_live
+
+    def test_no_gauge_without_ingested_wall_times(self, rig):
+        handle = make_gang(rig, num_procs=1)
+        key = labeled_key("watcher_ingest_lag_run_s", run=handle.run_id)
+        rig.watcher.observe(handle)  # nothing ingested yet
+        assert key not in rig.stats.gauges
+
+
+@pytest.mark.e2e
+class TestTickPhases:
+    def test_phase_histograms_sum_close_to_tick_wall(self, tmp_path):
+        from polyaxon_tpu.orchestrator import Orchestrator
+
+        orch = Orchestrator(
+            tmp_path / "plat",
+            monitor_interval=0.05,
+            heartbeat_interval=0.2,
+            heartbeat_ttl=30.0,
+        )
+        try:
+            # sleepy keeps the gang RUNNING across many monitor ticks so
+            # the alerts/remediation phases (RUNNING-only) get samples.
+            run = orch.submit(
+                {
+                    "kind": "experiment",
+                    "run": {
+                        "entrypoint": "polyaxon_tpu.builtins.trainers:sleepy"
+                    },
+                    "declarations": {"seconds": 1.0},
+                    "environment": {
+                        "topology": {
+                            "accelerator": "cpu-1",
+                            "num_devices": 1,
+                            "num_hosts": 1,
+                        }
+                    },
+                }
+            )
+            done = orch.wait(run.id, timeout=60)
+            assert done.status == S.SUCCEEDED
+            summaries = orch.stats.summaries()
+            tick = summaries["monitor_tick_s"]
+            assert tick["count"] >= 1
+            phase_sums = []
+            for phase in ("watcher", "alerts", "remediation"):
+                s = summaries[labeled_key("tick_phase_s", phase=phase)]
+                assert s["count"] >= 1, phase
+                phase_sums.append(s["sum"])
+            # The instrumented phases are the body of the tick: their sum
+            # must stay within the tick wall (small epsilon for clock
+            # granularity) and account for most of it.
+            assert sum(phase_sums) <= tick["sum"] * 1.05 + 1e-3
+            assert sum(phase_sums) >= tick["sum"] * 0.2
+        finally:
+            orch.stop()
+
+
+class TestSaturationLoadgen:
+    def test_smoke_run_lands_every_bench_metric(self, tmp_path):
+        out = run_saturation(
+            tmp_path / "plat",
+            n_registry_runs=20,
+            n_gangs=2,
+            procs_per_gang=1,
+            duration_s=1.5,
+            write_hz=20.0,
+            api_concurrency=2,
+            stall_after_s=0.4,
+            monitor_interval_s=0.05,
+        )
+        assert out["monitor_errors"] == 0
+        assert out["monitor_ticks"] > 0
+        assert out["api_requests"] > 0
+        assert out["api_errors"] == 0
+        assert out["api_p99_s"] is not None and out["api_p99_s"] > 0.0
+        assert out["watcher_ingest_lag_p99_s"] is not None
+        assert out["watcher_ingest_lag_samples"] > 0
+        assert out["report_bytes_ingested"] > 0
+        # The injected stall must fire the run_stalled alert while the
+        # hammer is still running (grace window covers the boundary).
+        assert out["alert_fire_latency_s"] is not None
+        assert out["alert_fire_latency_s"] >= 0.0

@@ -164,6 +164,13 @@ class TestRenderPrometheus:
             name = line.split("{")[0].split(" ")[0]
             assert re.match(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$", name), name
 
+    def test_total_suffix_not_doubled(self):
+        stats = MemoryStats()
+        stats.incr("api_request_total", 2)
+        text = render_prometheus(stats.snapshot(), prefix="p")
+        assert "p_api_request_total 2" in text
+        assert "total_total" not in text
+
     def test_value_formatting(self):
         stats = MemoryStats()
         stats.gauge("inf", float("inf"))
@@ -258,6 +265,94 @@ class TestHistogramReset:
         # Reusable: the rolling-window pattern.
         h.observe(1.5)
         assert h.counts == [0, 1, 0] and h.count == 1
+
+
+class TestCardinalityCap:
+    def test_overflow_folds_into_other_series(self):
+        from polyaxon_tpu.stats.metrics import fold_labeled_key, labeled_key
+
+        stats = MemoryStats(max_series=3)
+        for i in range(10):
+            stats.incr(labeled_key("api_request_total", route=f"/r{i}"))
+        snap = stats.snapshot()
+        series = [
+            k
+            for k in snap["counters"]
+            if k.startswith("api_request_total{")
+        ]
+        folded = fold_labeled_key(labeled_key("api_request_total", route="x"))
+        assert folded in series
+        # 3 admitted + the fold series; nothing beyond the cap leaks out.
+        assert len(series) == 4
+        assert snap["counters"][folded] == 7
+        assert snap["counters"]["metrics_series_folded"] == 7
+
+    def test_cap_is_per_base_metric(self):
+        from polyaxon_tpu.stats.metrics import labeled_key
+
+        stats = MemoryStats(max_series=2)
+        stats.incr(labeled_key("a_total", x="1"))
+        stats.incr(labeled_key("a_total", x="2"))
+        stats.gauge(labeled_key("b_gauge", y="1"), 1.0)
+        stats.gauge(labeled_key("b_gauge", y="2"), 2.0)
+        snap = stats.snapshot()
+        # Both metrics sit exactly at their own cap: no folds anywhere.
+        assert "metrics_series_folded" not in snap["counters"]
+
+    def test_histograms_and_gauges_fold_too(self):
+        from polyaxon_tpu.stats.metrics import fold_labeled_key, labeled_key
+
+        stats = MemoryStats(max_series=1)
+        stats.observe(labeled_key("lat_s", op="a"), 0.1)
+        stats.observe(labeled_key("lat_s", op="b"), 0.2)
+        stats.gauge(labeled_key("depth", q="a"), 1.0)
+        stats.gauge(labeled_key("depth", q="b"), 2.0)
+        snap = stats.snapshot()
+        assert fold_labeled_key(labeled_key("lat_s", op="x")) in snap["histograms"]
+        assert fold_labeled_key(labeled_key("depth", q="x")) in snap["gauges"]
+
+    def test_flat_keys_never_fold(self):
+        stats = MemoryStats(max_series=1)
+        for i in range(50):
+            stats.incr(f"flat_counter_{i}")
+        snap = stats.snapshot()
+        assert "metrics_series_folded" not in snap["counters"]
+        assert len(snap["counters"]) == 50
+
+    def test_fold_warns_once_per_metric(self, caplog):
+        import logging
+
+        from polyaxon_tpu.stats.metrics import labeled_key
+
+        stats = MemoryStats(max_series=1)
+        with caplog.at_level(logging.WARNING, logger="polyaxon_tpu.stats.backends"):
+            for i in range(5):
+                stats.incr(labeled_key("spam_total", id=str(i)))
+        warnings = [
+            r for r in caplog.records if "POLYAXON_TPU_METRICS_MAX_SERIES" in r.getMessage()
+        ]
+        assert len(warnings) == 1
+
+
+class TestLightSnapshot:
+    def test_include_timings_false_skips_raw_windows(self):
+        stats = MemoryStats()
+        stats.incr("n")
+        stats.gauge("g", 2.0)
+        stats.timing("t", 0.1)
+        light = stats.snapshot(include_timings=False)
+        assert light["timings"] == {}
+        # Everything the Prometheus renderer needs is still there.
+        assert light["counters"]["n"] == 1
+        assert light["gauges"]["g"] == 2.0
+        assert light["histograms"]["t"]["count"] == 1
+        text = render_prometheus(light)
+        assert "polyaxon_tpu_t_count 1" in text
+
+    def test_default_snapshot_keeps_timings(self):
+        stats = MemoryStats()
+        stats.timing("t", 0.1)
+        assert stats.snapshot()["timings"]["t"] == [0.1]
 
 
 class TestStandardGauges:
