@@ -1994,6 +1994,111 @@ def main() -> None:
 
         traceback.print_exc(file=sys.stderr)
 
+    # Request-tracing overhead gate + waterfall completeness: the same
+    # saturated engine drives an A/B with per-request distributed
+    # tracing on vs off (root span, phase spans, waterfall build,
+    # exemplar ring).  Throughput-based on purpose — a fixed-rate
+    # Poisson arm's wall clock is set by the schedule, which would hide
+    # the overhead being measured.  The traced arm also checks the
+    # waterfall contract: interval-based phases must sum to the
+    # client-observed request latency (within 10%), which hot-sampled
+    # per-step spans cannot break by construction.
+    serving_trace_overhead_pct = None
+    serving_trace_overhead_ok = None
+    serving_waterfall_err_pct = None
+    serving_waterfall_ok = None
+    try:
+        from polyaxon_tpu.serving import ServingEngine as _TrEngine
+        from polyaxon_tpu.tracking.trace import TraceContext, new_trace_id
+
+        tr_max_new = 16
+        tr_prompts = [
+            [int(x) for x in rng.integers(0, scfg.vocab_size, 24)]
+            for _ in range(16)
+        ]
+
+        def trace_run(traced):
+            eng = _TrEngine(
+                sparams, scfg, slots=4, max_len=scfg.max_seq,
+                prefix_cache=False,
+            ).start()
+            try:
+                eng.trace_requests = traced
+                eng.submit([1] * 24, 2).wait(timeout=600)  # warm buckets
+                t0 = time.perf_counter()
+                pending = []
+                for p in tr_prompts:
+                    pending.append(
+                        (
+                            eng.submit(
+                                p,
+                                tr_max_new,
+                                trace=(
+                                    TraceContext(new_trace_id())
+                                    if traced
+                                    else None
+                                ),
+                            ),
+                            time.perf_counter(),
+                        )
+                    )
+                errs = []
+                for r, ts in pending:
+                    r.wait(timeout=600)
+                    lat = time.perf_counter() - ts
+                    summary = r.trace_summary
+                    if summary is not None and lat > 0:
+                        phase_sum = sum(summary["waterfall"].values())
+                        errs.append(abs(phase_sum - lat) / lat * 100.0)
+                wall = time.perf_counter() - t0
+            finally:
+                eng.stop()
+            return wall, errs
+
+        # Interleaved reps; min-wall per arm shrugs off scheduler noise.
+        walls = {True: [], False: []}
+        wf_errs = []
+        for _ in range(2):
+            for traced in (False, True):
+                wall, errs = trace_run(traced)
+                walls[traced].append(wall)
+                if traced:
+                    wf_errs.extend(errs)
+        off, on = min(walls[False]), min(walls[True])
+        serving_trace_overhead_pct = max(0.0, (on - off) / off * 100.0)
+        serving_trace_budget_pct = 3.0 if on_tpu else 25.0
+        serving_trace_overhead_ok = (
+            serving_trace_overhead_pct < serving_trace_budget_pct
+        )
+        if not serving_trace_overhead_ok:
+            import sys
+
+            print(
+                f"bench: serving_trace_overhead_pct="
+                f"{serving_trace_overhead_pct:.2f} exceeds the "
+                f"{serving_trace_budget_pct}% budget — request tracing "
+                f"is taxing the serving engine",
+                file=sys.stderr,
+            )
+        if wf_errs:
+            serving_waterfall_err_pct = max(wf_errs)
+            serving_waterfall_ok = serving_waterfall_err_pct <= 10.0
+            if not serving_waterfall_ok:
+                import sys
+
+                print(
+                    f"bench: waterfall phases off by "
+                    f"{serving_waterfall_err_pct:.1f}% from "
+                    f"client-observed latency (> 10%) — the phase "
+                    f"intervals no longer partition the request",
+                    file=sys.stderr,
+                )
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+
     baseline_path = Path(__file__).parent / "BENCH_BASELINE.json"
     vs_baseline = 1.0
     longctx_vs_baseline = None
@@ -2265,6 +2370,18 @@ def main() -> None:
                     else None
                 ),
                 "trace_overhead_ok": trace_overhead_ok,
+                "serving_trace_overhead_pct": (
+                    round(serving_trace_overhead_pct, 2)
+                    if serving_trace_overhead_pct is not None
+                    else None
+                ),
+                "serving_trace_overhead_ok": serving_trace_overhead_ok,
+                "serving_waterfall_err_pct": (
+                    round(serving_waterfall_err_pct, 2)
+                    if serving_waterfall_err_pct is not None
+                    else None
+                ),
+                "serving_waterfall_ok": serving_waterfall_ok,
                 "stall_detect_s": (
                     round(stall_detect_s, 2)
                     if stall_detect_s is not None

@@ -820,6 +820,121 @@ class MetricLabelRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# GL008 — span-name hygiene
+# ---------------------------------------------------------------------------
+
+#: The tracing mechanism module — ``Tracer.record_span`` legitimately
+#: re-emits whatever name a ``_Span`` carried.
+_TRACE_MECHANISM_REL = "tracking/trace.py"
+
+#: Forwarding wrappers: the ``name`` parameter flows through verbatim,
+#: so the literal check applies at THEIR call sites, not inside them.
+_SPAN_FORWARDERS = {"_trace_span", "_trace_hot"}
+
+#: The closed span-name catalog.  A span name is a Perfetto track and a
+#: cross-process join key — interpolating per-request/per-task values
+#: into it mints one track per value; new names are a schema decision,
+#: added here deliberately (the GL007 label-key pattern, applied to
+#: trace spans).
+_SPAN_NAMES = {
+    # worker lifecycle
+    "worker.cmd", "worker.distributed_init", "worker.entrypoint",
+    # control plane
+    "gang.spawn", "task.execute", "watcher.observe",
+    # training + input pipeline
+    "train.aot_compile", "train.loop", "train.step",
+    "pipeline.drain", "pipeline.gather",
+    # serving engine lifecycle + request phases
+    "engine.compile", "serving.warmup", "serving.step", "serving.prefill",
+    "serving.request", "serving.generate", "serving.admit",
+    "serving.queue_wait", "serving.prefill.chunk", "serving.first_token",
+    "serving.prefix_cache.hit", "serving.decode.step",
+    "serving.spec.draft", "serving.spec.verify",
+    "serving.park", "serving.spill", "serving.restore", "serving.finish",
+    # fleet router
+    "router.request", "router.attempt",
+}
+
+#: Literal shape: lowercase dot-delimited segments, at least two deep —
+#: the convention every catalogued name follows.
+_SPAN_NAME_SHAPE = _re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+
+
+class SpanNameRule(Rule):
+    id = "GL008"
+    name = "span-names"
+    version = "1"
+    doc = (
+        "Tracer.span()/record_span() names must be literal dot-delimited "
+        "strings from the span-name catalog (analysis/rules.py:"
+        "_SPAN_NAMES) — an interpolated name mints one Perfetto track "
+        "per value and breaks cross-process trace merging; variable "
+        "parts belong in span attributes"
+    )
+
+    def check_module(self, mod: ModuleInfo, project: Project):
+        if mod.rel.endswith(_TRACE_MECHANISM_REL):
+            return
+        # Map every Call to its enclosing function, so the forwarding
+        # wrappers' own pass-through emission is exempt.
+        enclosing: Dict[ast.AST, str] = {}
+        for fn in ast.walk(mod.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Call):
+                        enclosing[sub] = fn.name
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = dotted_name(node.func).rsplit(".", 1)[-1]
+            if tail in ("span", "record_span"):
+                arg_idx = 0
+            elif tail in _SPAN_FORWARDERS:
+                arg_idx = 1  # (req, name, ...)
+            else:
+                continue
+            if len(node.args) <= arg_idx:
+                continue  # keyword-form or unrelated zero-arg .span()
+            arg = node.args[arg_idx]
+            if isinstance(arg, ast.Constant) and not isinstance(
+                arg.value, str
+            ):
+                continue  # e.g. re.Match.span(group)
+            if not (
+                isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+            ):
+                if enclosing.get(node) in _SPAN_FORWARDERS:
+                    continue  # the wrapper forwarding its name param
+                yield self.finding(
+                    mod,
+                    arg,
+                    f"span name passed to {tail}() is not a string "
+                    "literal — interpolated names mint one Perfetto "
+                    "track per value; put the variable part in a span "
+                    "attribute",
+                )
+                continue
+            value = arg.value
+            if not _SPAN_NAME_SHAPE.match(value):
+                yield self.finding(
+                    mod,
+                    arg,
+                    f"span name {value!r} is not dot-delimited "
+                    "(`component.phase`) — names are cross-process "
+                    "join keys and follow one convention",
+                )
+            elif value not in _SPAN_NAMES:
+                yield self.finding(
+                    mod,
+                    arg,
+                    f"span name {value!r} is not in the span-name "
+                    "catalog (analysis/rules.py:_SPAN_NAMES) — new "
+                    "span names are a tracing-schema decision; add it "
+                    "deliberately",
+                )
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = [
     JitPurityRule,
@@ -829,6 +944,7 @@ ALL_RULES = [
     KnobRegistryRule,
     NetTimeoutRule,
     MetricLabelRule,
+    SpanNameRule,
 ]
 
 

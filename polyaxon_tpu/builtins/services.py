@@ -163,6 +163,12 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
     from http.server import BaseHTTPRequestHandler
 
     from polyaxon_tpu.serving.engine import EngineDrainingError
+    from polyaxon_tpu.tracking.trace import (
+        TraceContext,
+        extract,
+        get_tracer,
+        new_trace_id,
+    )
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # route into run logs, not stderr
@@ -194,6 +200,17 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
                 if latency:
                     payload["latency"] = latency
                 return self._json(200, payload)
+            if self.path.startswith("/v1/trace/"):
+                # Raw spans for one trace from this process's ring
+                # buffer — the router merges these fleet-wide.  An empty
+                # list is a valid answer (expired or never sampled).
+                trace_id = self.path[len("/v1/trace/"):]
+                spans = [
+                    s
+                    for s in get_tracer().spans()
+                    if s.get("trace_id") == trace_id
+                ]
+                return self._json(200, {"trace_id": trace_id, "spans": spans})
             if self.path == "/metrics":
                 from polyaxon_tpu.stats.metrics import (
                     PROMETHEUS_CONTENT_TYPE,
@@ -267,12 +284,42 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
                 temperature = float(req.get("temperature", 0.0))
                 if not prompts or not isinstance(prompts[0], list):
                     raise ValueError("prompts must be a list of id lists")
+            except (KeyError, ValueError, TypeError) as e:
+                return self._error(400, "bad_request", str(e))
+            # Join the caller's trace (router hop) or mint a fresh one
+            # for direct clients; a malformed traceparent extracts to
+            # None and degrades to a fresh trace, never an error.
+            tctx = extract(self.headers)
+            if tctx is None and getattr(engine, "trace_requests", False):
+                tctx = TraceContext(new_trace_id())
+            if tctx is not None and not tctx.sampled:
+                tctx = None
+            if tctx is None:
+                return self._generate(prompts, max_new, temperature, None)
+            with get_tracer().span(
+                "serving.generate",
+                sample=1.0,
+                trace_id=tctx.trace_id,
+                parent_id=tctx.span_id or None,
+                prompts=len(prompts),
+            ) as sp:
+                return self._generate(
+                    prompts, max_new, temperature, tctx.child(sp.span_id)
+                )
+
+        def _generate(self, prompts, max_new, temperature, tctx):
+            try:
                 # Mixed lengths are fine now — each prompt is its own
                 # request; the engine batches them at the decode-step
                 # level.  Validation happens in submit() per prompt.
                 t0 = time.time()
+                # The trace kwarg rides only when a context exists, so
+                # duck-typed engine stand-ins keep working untraced.
                 reqs = [
-                    engine.submit(p, max_new, temperature) for p in prompts
+                    engine.submit(p, max_new, temperature, trace=tctx)
+                    if tctx is not None
+                    else engine.submit(p, max_new, temperature)
+                    for p in prompts
                 ]
             except EngineDrainingError as e:
                 retry_after = str(int(meta.get("retry_after_s", 1)))
@@ -313,14 +360,24 @@ def _make_lm_handler(engine, cfg, meta: dict, log=lambda line: None):
                 else None
                 for r in reqs
             ]
-            self._json(
-                200,
-                {
-                    "tokens": tokens,
-                    "decode_tokens_per_s": round(total / max(dt, 1e-9), 1),
-                    "ttft_s": ttfts,
-                },
-            )
+            payload = {
+                "tokens": tokens,
+                "decode_tokens_per_s": round(total / max(dt, 1e-9), 1),
+                "ttft_s": ttfts,
+            }
+            if tctx is not None:
+                # Per-request latency waterfalls ride the response so
+                # clients (loadgen) see where the time went without a
+                # second round-trip.
+                payload["trace"] = {
+                    "trace_id": tctx.trace_id,
+                    "waterfalls": [
+                        r.trace_summary
+                        for r in reqs
+                        if r.trace_summary is not None
+                    ],
+                }
+            self._json(200, payload)
 
     return Handler
 
@@ -468,6 +525,18 @@ def lm_server(ctx: Context) -> None:
 
     port = _service_port(ctx)
     host = str(ctx.get_param("host", "0.0.0.0"))
+
+    # Label this process's request spans so a fleet's merged trace puts
+    # every replica on its own named track (the worker entrypoint set
+    # sink/process_id already; the label rides on top).
+    from polyaxon_tpu.tracking.trace import get_tracer
+
+    get_tracer().configure(
+        process=(
+            f"lm_server-{ctx.run_uuid[:8]}" if ctx.run_uuid
+            else f"lm_server-{port}"
+        )
+    )
     eos_id = ctx.get_param("eos_id")
     kv_blocks = ctx.get_param("kv_blocks")
     prefill_chunk = int(ctx.get_param("prefill_chunk", 0) or 0)

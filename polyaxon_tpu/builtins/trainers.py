@@ -356,7 +356,7 @@ def _train_image_classifier(
         run_stats.timing("train.data_wait_s", dwait)
         led.account("data_wait_s", dwait)
         data_wait_accounted += dwait
-        with tracer.span("train:aot_compile"):
+        with tracer.span("train.aot_compile"):
             step_fn, aot_s = aot_compile(
                 ts.step, params, opt_state, warm_batch, key
             )
@@ -375,13 +375,13 @@ def _train_image_classifier(
     clock.start()
     led.mark_loop_start()
     try:
-        with tracer.span("train:loop", steps=steps - start_step):
+        with tracer.span("train.loop", steps=steps - start_step):
             for i in range(start_step, steps):
                 profiler.on_step(i)
                 capture.on_step(i)
                 if inject is not None:
                     inject(i)
-                with tracer.span("train:step", sample=tracer.hot_sample, step=i):
+                with tracer.span("train.step", sample=tracer.hot_sample, step=i):
                     if warm_batch is not None:
                         batch, warm_batch = warm_batch, None
                     else:
@@ -814,7 +814,7 @@ def lm_train(ctx: Context) -> None:
     # from disk instead of compiling — aot_s IS the cold-start cost.
     # step_fn is the compiled executable — calling the jitted ts.step
     # afterwards would compile a second time.
-    with tracer.span("train:aot_compile"):
+    with tracer.span("train.aot_compile"):
         step_fn, aot_s = aot_compile(ts.step, params, opt_state, batch, key)
     if step_fn is not ts.step:
         capture.register_executable("train_step", step_fn)
@@ -832,13 +832,13 @@ def lm_train(ctx: Context) -> None:
     clock.start()
     led.mark_loop_start()
     try:
-        with tracer.span("train:loop", steps=steps - start_step):
+        with tracer.span("train.loop", steps=steps - start_step):
             for i in range(start_step, steps):
                 profiler.on_step(i)
                 capture.on_step(i)
                 if inject is not None:
                     inject(i)
-                with tracer.span("train:step", sample=tracer.hot_sample, step=i):
+                with tracer.span("train.step", sample=tracer.hot_sample, step=i):
                     params, opt_state, metrics = step_fn(
                         params, opt_state, batch, key
                     )

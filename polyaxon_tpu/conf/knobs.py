@@ -125,6 +125,15 @@ _ALL: List[Knob] = [
          "span sampling rate for hot-path spans", "tracing"),
     Knob("POLYAXON_TPU_LEDGER_INTERVAL_S", "float", 30.0,
          "min spacing of cumulative utilization-ledger rows", "tracing"),
+    Knob("POLYAXON_TPU_TRACE_REQUESTS", "bool", True,
+         "request-scoped distributed tracing across router → replica → "
+         "engine (waterfalls, /v1/trace exports, exemplars)", "tracing"),
+    Knob("POLYAXON_TPU_TRACE_EXEMPLARS", "int", 5,
+         "slowest fully-traced requests kept per exemplar window "
+         "(0 = exemplars off)", "tracing"),
+    Knob("POLYAXON_TPU_TRACE_EXEMPLAR_WINDOW_S", "float", 300.0,
+         "sliding window for the slow-request exemplar ring (s)",
+         "tracing"),
     # -- stall watchdog (worker side) --------------------------------------
     Knob("POLYAXON_TPU_WATCHDOG_K", "float", 8.0,
          "stall deadline = k x rolling median step dt", "watchdog"),

@@ -298,11 +298,21 @@ def _check_serving_ttft_p99(ctx: RuleContext) -> Optional[Dict[str, Any]]:
     p99 = ctx.histogram_quantile("serving.ttft_s", 0.99)
     if p99 is None or p99 <= threshold:
         return None
-    return {
+    out = {
         "value": float(p99),
         "message": f"serving TTFT p99 {p99:.3f}s above SLO {threshold:.3f}s",
         "threshold_s": threshold,
     }
+    # Slow-request exemplars: the engine keeps fully-traced waterfalls
+    # for the slowest requests of the window (see ServingEngine stats
+    # "trace_exemplars"); the control plane lands them as a "ttft_slow"
+    # anomaly whose dump_artifact points at the written exemplar file —
+    # the alert carries WHICH requests blew the SLO, not just that p99
+    # did.
+    artifact = ctx.dump_artifact("ttft_slow")
+    if artifact:
+        out["exemplar_artifact"] = artifact
+    return out
 
 
 def _check_steady_state_compiles(ctx: RuleContext) -> Optional[Dict[str, Any]]:

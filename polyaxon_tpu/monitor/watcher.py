@@ -665,7 +665,7 @@ class GangWatcher:
         # Polls are frequent (per-run monitor interval) — sample like a
         # hot-path span; control-plane spans stay in the ring buffer.
         with tracer.span(
-            "watcher:observe", sample=tracer.hot_sample, run_id=handle.run_id
+            "watcher.observe", sample=tracer.hot_sample, run_id=handle.run_id
         ):
             self.ingest(handle)
             statuses = self.reconcile(handle)

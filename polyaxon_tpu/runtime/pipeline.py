@@ -117,7 +117,7 @@ class HostPrefetcher:
         tracer = get_tracer()
 
         def run() -> Any:
-            with tracer.span("pipeline:gather", sample=tracer.hot_sample):
+            with tracer.span("pipeline.gather", sample=tracer.hot_sample):
                 return task()
 
         return run
@@ -298,7 +298,7 @@ class MetricsDrain:
             step, values = got
             try:
                 tracer = get_tracer()
-                with tracer.span("pipeline:drain", sample=tracer.hot_sample):
+                with tracer.span("pipeline.drain", sample=tracer.hot_sample):
                     host = {k: float(np.asarray(v)) for k, v in values.items()}
                     self._emit(step, host)
                 self.last, self.last_step = host, step

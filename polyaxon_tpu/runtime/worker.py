@@ -191,7 +191,7 @@ def main() -> int:
             # Shell command path: the distributed bootstrap belongs to the
             # command itself (it can read the same env contract).
             reporter.status("running")
-            with tracer.span("worker:cmd"):
+            with tracer.span("worker.cmd"):
                 rc = _run_cmd(
                     run_cfg.cmd,
                     env=dict(os.environ),
@@ -205,7 +205,7 @@ def main() -> int:
             return 1
 
         # Python entrypoint path: managed distributed world + mesh.
-        with tracer.span("worker:distributed_init", hosts=info.num_processes):
+        with tracer.span("worker.distributed_init", hosts=info.num_processes):
             distributed = _init_distributed(info)
         sampler.start()
 
@@ -245,7 +245,7 @@ def main() -> int:
         fn = getattr(module, fn_name)
 
         reporter.status("running")
-        with tracer.span("worker:entrypoint", entrypoint=run_cfg.entrypoint):
+        with tracer.span("worker.entrypoint", entrypoint=run_cfg.entrypoint):
             fn(ctx)
 
         if distributed:

@@ -268,7 +268,7 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             from polyaxon_tpu.tracking.trace import get_tracer
 
             with get_tracer().span(
-                "gang:spawn", run_id=run_id, hosts=plan.num_hosts
+                "gang.spawn", run_id=run_id, hosts=plan.num_hosts
             ):
                 handle = ctx.spawner.start(run, plan)
         except Exception as e:  # disk-full/permission OSErrors included —

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import itertools
 import queue
+import random
 import threading
 import time
 from collections import deque
@@ -64,7 +65,7 @@ from polyaxon_tpu.serving.paging import (
 )
 from polyaxon_tpu.stats import MemoryStats
 from polyaxon_tpu.tracking.flightrec import get_progress
-from polyaxon_tpu.tracking.trace import get_tracer
+from polyaxon_tpu.tracking.trace import TraceContext, get_tracer
 
 
 class EngineDrainingError(RuntimeError):
@@ -133,6 +134,71 @@ class NgramDrafter:
         return t[end : end + k]
 
 
+class _RequestTrace:
+    """Per-request distributed-trace state.
+
+    ``ctx`` is the propagated :class:`TraceContext` (one trace id across
+    router → replica → engine); ``root_id`` is the engine-side request
+    span every phase span parents to.  ``park_s`` accumulates wall time
+    spent parked so the waterfall can split decode wall-clock into
+    device time vs capacity stalls.  Phase accounting is *interval*
+    based (queue_wait / prefill / decode / parked partition the
+    request's wall clock), so the waterfall always sums to the server-
+    side total regardless of how many sub-spans were hot-sampled away.
+    """
+
+    __slots__ = ("ctx", "root_id", "parked_at", "park_s", "ttft_s")
+
+    def __init__(self, ctx: TraceContext, root_id: str) -> None:
+        self.ctx = ctx
+        self.root_id = root_id
+        self.parked_at: Optional[float] = None
+        self.park_s = 0.0
+        self.ttft_s: Optional[float] = None
+
+
+class _SlowExemplars:
+    """Bounded ring of the N slowest fully-traced requests per window.
+
+    ``offer`` keeps the slowest ``n`` finished-request trace summaries
+    whose finish time falls inside the sliding window; ``snapshot``
+    returns them slowest-first.  Exposed on ``/v1/stats`` and attached
+    as the artifact when the ``serving_ttft_p99`` alert fires, so every
+    SLO breach ships its own explanation.
+    """
+
+    def __init__(self, n: int, window_s: float) -> None:
+        self.n = int(n)
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._entries: List[Dict[str, Any]] = []
+
+    def offer(self, summary: Dict[str, Any]) -> None:
+        if self.n <= 0:
+            return
+        now = time.time()
+        with self._lock:
+            self._entries = [
+                e
+                for e in self._entries
+                if now - e.get("finished_at", now) <= self.window_s
+            ]
+            self._entries.append(summary)
+            self._entries.sort(
+                key=lambda e: e.get("total_s", 0.0), reverse=True
+            )
+            del self._entries[self.n :]
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        now = time.time()
+        with self._lock:
+            return [
+                dict(e)
+                for e in self._entries
+                if now - e.get("finished_at", now) <= self.window_s
+            ]
+
+
 class GenerationRequest:
     """One queued generation: its prompt, its budget, and its results.
 
@@ -165,6 +231,10 @@ class GenerationRequest:
         self.started_at: Optional[float] = None
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        #: Distributed-trace state (None = untraced request).
+        self.trace: Optional[_RequestTrace] = None
+        #: Waterfall summary, filled once when the request finishes.
+        self.trace_summary: Optional[Dict[str, Any]] = None
 
     def wait(self, timeout: Optional[float] = None) -> List[int]:
         """Block until done; raise on engine-side failure."""
@@ -593,6 +663,14 @@ class ServingEngine:
         self._backlog_chunks = 0
         self._prefill_jobs = 0
         self._window: "deque[tuple]" = deque()  # (t, n_tokens)
+        # Request-scoped distributed tracing: master switch plus the
+        # slow-request exemplar ring (`/v1/stats` + the serving_ttft_p99
+        # alert's attached artifact).
+        self.trace_requests = knob_bool("POLYAXON_TPU_TRACE_REQUESTS")
+        self._exemplars = _SlowExemplars(
+            knob_int("POLYAXON_TPU_TRACE_EXEMPLARS"),
+            knob_float("POLYAXON_TPU_TRACE_EXEMPLAR_WINDOW_S"),
+        )
         # Decode-side utilization ledger (armed in start()): device-busy
         # seconds (prefill + decode dispatch/sync) and occupancy-weighted
         # busy time — the serving analogue of train-side goodput/MFU.
@@ -885,7 +963,7 @@ class ServingEngine:
 
         try:
             if self._warmup:
-                with tracer.span("serving:warmup", buckets=len(buckets)):
+                with tracer.span("serving.warmup", buckets=len(buckets)):
                     self._key, sub = jax.random.split(self._key)
                     tables = np.where(
                         self._tables >= 0, self._tables, 0
@@ -1085,6 +1163,7 @@ class ServingEngine:
             if not req.done.is_set():
                 req.error = "engine stopped"
                 req.error_kind = "stopped"
+                self._finalize_trace(req, "stopped")
                 req.stream.put(None)
                 req.done.set()
 
@@ -1106,8 +1185,15 @@ class ServingEngine:
         prompt: List[int],
         max_new_tokens: int,
         temperature: float = 0.0,
+        trace: Optional[TraceContext] = None,
     ) -> GenerationRequest:
-        """Validate and enqueue; returns immediately with the request."""
+        """Validate and enqueue; returns immediately with the request.
+
+        ``trace`` opts the request into distributed tracing: its
+        lifecycle phases are recorded as spans under the propagated
+        trace id (remote parent = the caller's span), and the finished
+        request carries a latency-waterfall ``trace_summary``.
+        """
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
@@ -1128,6 +1214,8 @@ class ServingEngine:
                 f"{usable}; raise num_blocks or shorten the request"
             )
         req = GenerationRequest(prompt, max_new_tokens, temperature)
+        if trace is not None and self.trace_requests and trace.sampled:
+            req.trace = _RequestTrace(trace, get_tracer().next_span_id())
         with self._cv:
             if self._stop.is_set():
                 raise RuntimeError("engine is stopped")
@@ -1158,6 +1246,7 @@ class ServingEngine:
                         self._n_cancelled += 1
                     req.error = "request cancelled"
                     req.error_kind = "cancelled"
+                    self._finalize_trace(req, "cancelled")
                     req.stream.put(None)
                     req.done.set()
                     return True
@@ -1322,6 +1411,7 @@ class ServingEngine:
                 "decode_steps": self._n_steps,
                 "tokens_per_s": round(tps, 1),
                 "max_len": self.max_len,
+                "trace_exemplars": self._exemplars.snapshot(),
                 **paging,
                 **spec,
                 **util,
@@ -1526,7 +1616,7 @@ class ServingEngine:
                     # Per-iteration span at the hot sample rate, like the
                     # decode step below: prefill runs per CHUNK.
                     with tracer.span(
-                        "serving:prefill",
+                        "serving.prefill",
                         sample=tracer.hot_sample,
                         request_id=job.req.id,
                     ):
@@ -1544,7 +1634,7 @@ class ServingEngine:
                     break
             if self._active.any():
                 try:
-                    with tracer.span("serving:step", sample=tracer.hot_sample):
+                    with tracer.span("serving.step", sample=tracer.hot_sample):
                         self._step_once()
                 except Exception as e:  # fail in-flight, keep serving
                     for slot in np.nonzero(self._active)[0]:
@@ -1582,6 +1672,13 @@ class ServingEngine:
             self.stats_registry.timing(
                 "serving.queue_wait_s", req.started_at - req.submitted_at
             )
+            self._trace_span(
+                req,
+                "serving.queue_wait",
+                req.submitted_at,
+                req.started_at - req.submitted_at,
+            )
+            self._trace_span(req, "serving.admit", req.started_at, 0.0, slot=slot)
             self._slot_req[slot] = req
             # Speculative path selection is typed per request at
             # admission: greedy requests get a drafter (its suffix index
@@ -1609,6 +1706,15 @@ class ServingEngine:
                 for i, block in enumerate(matched):
                     self._tables[slot, i] = block
                 m = len(matched) * self.block_size
+                if matched:
+                    self._trace_span(
+                        req,
+                        "serving.prefix_cache.hit",
+                        time.time(),
+                        0.0,
+                        blocks=len(matched),
+                        tokens=m,
+                    )
                 if m and m == len(req.prompt):
                     # Every prompt block hit.  The last token's LOGITS
                     # still must be recomputed, and its KV row lands in
@@ -1682,6 +1788,16 @@ class ServingEngine:
         )
         job.next_pos += n
         done = job.next_pos >= t
+        if req.trace is not None:
+            t1 = time.perf_counter()
+            self._trace_span(
+                req,
+                "serving.prefill.chunk",
+                time.time() - (t1 - t0),
+                t1 - t0,
+                tokens=n,
+                pos=job.next_pos,
+            )
         # Chunk compute is device-busy time serving one request; only the
         # final chunk emits a token.
         self._ledger_account(
@@ -1708,9 +1824,13 @@ class ServingEngine:
             )
         first = self._pick_first(logits, req.temperature)
         # Time-to-first-token: prefill produced it, the client can read it.
-        self.stats_registry.timing(
-            "serving.ttft_s", time.time() - req.submitted_at
-        )
+        ttft = time.time() - req.submitted_at
+        self.stats_registry.timing("serving.ttft_s", ttft)
+        if req.trace is not None:
+            req.trace.ttft_s = ttft
+            self._trace_span(
+                req, "serving.first_token", time.time(), 0.0, ttft_s=round(ttft, 6)
+            )
         self._emit(slot, req, first)
         if not req.done.is_set():
             self._tok[slot] = first
@@ -1740,6 +1860,9 @@ class ServingEngine:
         latency for sheds."""
         self._active[slot] = False
         self._parked.append(slot)
+        req = self._slot_req[slot]
+        if req is not None and req.trace is not None:
+            req.trace.parked_at = time.time()
         with self._stats_lock:
             self._n_parks += 1
         if self._host_tier is not None:
@@ -1763,6 +1886,11 @@ class ServingEngine:
             handles[bi] = self._host_tier.put(data, pinned=True)
             alloc.decref(int(self._tables[slot, bi]))
             self._tables[slot, bi] = -1
+        req = self._slot_req[slot]
+        if req is not None:
+            self._trace_span(
+                req, "serving.spill", time.time(), 0.0, blocks=len(spill_bi)
+            )
         with self._stats_lock:
             self._n_spilled_blocks += len(spill_bi)
 
@@ -1789,11 +1917,23 @@ class ServingEngine:
             self.prefix_cache.evict(need - alloc.n_free)
         if alloc.n_free < need:
             return False, False
+        n_restore = len(handles)
+        t0 = time.perf_counter()
         for bi in sorted(handles):
             fresh = self._alloc_block()
             self._import_block(fresh, self._host_tier.pop(handles.pop(bi)))
             self._tables[slot, bi] = fresh
         self._spilled.pop(slot, None)
+        req = self._slot_req[slot]
+        if req is not None:
+            dt = time.perf_counter() - t0
+            self._trace_span(
+                req,
+                "serving.restore",
+                time.time() - dt,
+                dt,
+                blocks=n_restore,
+            )
         return True, True
 
     def _resume_parked(self) -> bool:
@@ -1826,6 +1966,15 @@ class ServingEngine:
         map; retire/fail genuinely abandon theirs)."""
         if slot in self._parked:
             self._parked.remove(slot)
+            req = self._slot_req[slot]
+            rt = req.trace if req is not None else None
+            if rt is not None and rt.parked_at is not None:
+                parked_s = time.time() - rt.parked_at
+                rt.park_s += parked_s
+                rt.parked_at = None
+                self._trace_span(
+                    req, "serving.park", time.time() - parked_s, parked_s
+                )
         handles = self._spilled.pop(slot, None)
         if handles and self._host_tier is not None:
             for handle in handles.values():
@@ -1916,6 +2065,12 @@ class ServingEngine:
         if not self._active.any():
             return
         drafts = self._collect_drafts() if self.spec_decode else {}
+        participants = [
+            self._slot_req[int(s)]
+            for s in np.nonzero(self._active)[0]
+            if self._slot_req[int(s)] is not None
+            and self._slot_req[int(s)].trace is not None
+        ]
         t0 = time.perf_counter()
         self._key, sub = jax.random.split(self._key)
         tables = np.where(self._tables >= 0, self._tables, 0).astype(np.int32)
@@ -1953,6 +2108,17 @@ class ServingEngine:
         step_dt = time.perf_counter() - t0
         self.stats_registry.timing("serving.decode_step_s", step_dt)
         self.stats_registry.observe("serving.batch_occupancy", float(n_live))
+        # Per-request decode-step spans ride at the hot-sample rate; the
+        # waterfall's decode phase is interval-based, so these are pure
+        # detail and sampling them away loses nothing but zoom.
+        for req in participants:
+            self._trace_hot(
+                req,
+                "serving.decode.step",
+                time.time() - step_dt,
+                step_dt,
+                batch=n_live,
+            )
         self._ledger_account(step_dt, n_live / self.slots, tokens=emitted)
         self._record_gauges()
         if self._ready.is_set():
@@ -1993,6 +2159,10 @@ class ServingEngine:
                     self._tables[slot, bi] = fresh
             if prop:
                 drafts[slot] = prop
+                self._trace_hot(
+                    req, "serving.spec.draft", time.time(), 0.0,
+                    proposed=len(prop),
+                )
         return drafts
 
     def _verify_once(
@@ -2038,6 +2208,14 @@ class ServingEngine:
                 n_accepted += e - 1
                 if observe is not None:
                     observe("serving.spec_accept_len", float(e - 1))
+                self._trace_hot(
+                    req,
+                    "serving.spec.verify",
+                    time.time(),
+                    0.0,
+                    proposed=len(prop),
+                    accepted=e - 1,
+                )
             self._pos[slot] += e
             self._tok[slot] = int(out[slot, e - 1])
             # Rollback: rows past the accept run are garbage; blocks
@@ -2109,6 +2287,113 @@ class ServingEngine:
                 round(accepted / proposed, 6) if proposed else 0.0,
             )
 
+    # -- request-scoped tracing ------------------------------------------------
+
+    def _trace_span(
+        self,
+        req: GenerationRequest,
+        name: str,
+        start: float,
+        duration: float,
+        **attrs: Any,
+    ) -> None:
+        """Record one phase span under the request's trace (no-op for
+        untraced requests)."""
+        rt = req.trace
+        if rt is None:
+            return
+        get_tracer().record_span(
+            name,
+            start=start,
+            duration=duration,
+            trace_id=rt.ctx.trace_id,
+            parent_id=rt.root_id,
+            request_id=req.id,
+            **attrs,
+        )
+
+    def _trace_hot(
+        self,
+        req: GenerationRequest,
+        name: str,
+        start: float,
+        duration: float,
+        **attrs: Any,
+    ) -> None:
+        """Hot-path phase span (per decode step / spec verify): recorded
+        at the tracer's hot-sample rate.  Waterfall phase accounting is
+        interval-based and never depends on these, so sampling them away
+        cannot break the waterfall sums."""
+        rt = req.trace
+        if rt is None:
+            return
+        rate = get_tracer().hot_sample
+        if rate < 1.0 and (rate <= 0.0 or random.random() >= rate):
+            return
+        self._trace_span(req, name, start, duration, **attrs)
+
+    def _finalize_trace(self, req: GenerationRequest, outcome: str) -> None:
+        """Close the request's trace: emit the root span, build the
+        latency waterfall, and offer it to the slow-request exemplars.
+
+        Runs for every terminal path — finish, shed, cancel, engine
+        stop, deadlock shed — so a traced request can never leak an
+        open span."""
+        rt = req.trace
+        if rt is None or req.trace_summary is not None:
+            return
+        now = req.finished_at if req.finished_at is not None else time.time()
+        req.finished_at = now
+        if rt.parked_at is not None:  # failed while parked
+            rt.park_s += now - rt.parked_at
+            rt.parked_at = None
+        total = max(0.0, now - req.submitted_at)
+        started = req.started_at
+        first = req.first_token_at
+        waterfall: Dict[str, float] = {
+            "queue_wait_s": max(
+                0.0, (started if started is not None else now) - req.submitted_at
+            ),
+        }
+        if started is not None:
+            prefill_end = first if first is not None else now
+            waterfall["prefill_s"] = max(0.0, prefill_end - started)
+        if first is not None:
+            waterfall["decode_s"] = max(0.0, now - first - rt.park_s)
+        if rt.park_s > 0:
+            waterfall["parked_s"] = rt.park_s
+        # The request root span: its id is what every phase span parents
+        # to; its own parent is the remote caller's span (router attempt
+        # or lm_server handler), stitching the cross-process timeline.
+        get_tracer().record_span(
+            "serving.request",
+            start=req.submitted_at,
+            duration=total,
+            trace_id=rt.ctx.trace_id,
+            span_id=rt.root_id,
+            parent_id=rt.ctx.span_id or None,
+            request_id=req.id,
+            outcome=outcome,
+            tokens=len(req.tokens),
+        )
+        self._trace_span(
+            req, "serving.finish", now, 0.0, outcome=outcome
+        )
+        req.trace_summary = {
+            "trace_id": rt.ctx.trace_id,
+            "span_id": rt.root_id,
+            "request_id": req.id,
+            "outcome": outcome,
+            "total_s": round(total, 6),
+            "ttft_s": (
+                round(rt.ttft_s, 6) if rt.ttft_s is not None else None
+            ),
+            "tokens": len(req.tokens),
+            "finished_at": now,
+            "waterfall": {k: round(v, 6) for k, v in waterfall.items()},
+        }
+        self._exemplars.offer(req.trace_summary)
+
     def _emit(self, slot: int, req: GenerationRequest, tok: int) -> None:
         """Record one generated token; retire the slot when done."""
         if req.first_token_at is None:
@@ -2136,10 +2421,11 @@ class ServingEngine:
 
     def _retire(self, slot: int, req: GenerationRequest) -> None:
         req.finished_at = time.time()
-        req.stream.put(None)
-        req.done.set()
         self._active[slot] = False
         self._unpark(slot)
+        self._finalize_trace(req, "completed")
+        req.stream.put(None)
+        req.done.set()
         self._release_slot_blocks(slot)
         self._slot_req[slot] = None
         self._drafters[slot] = None
@@ -2165,5 +2451,6 @@ class ServingEngine:
         if req is not None and not req.done.is_set():
             req.error = msg
             req.error_kind = kind
+            self._finalize_trace(req, kind or "error")
             req.stream.put(None)
             req.done.set()

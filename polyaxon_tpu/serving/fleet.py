@@ -39,7 +39,7 @@ from pathlib import Path
 from typing import Any, Dict, Optional
 
 from polyaxon_tpu.conf.knobs import knob_float, knob_int
-from polyaxon_tpu.serving.router import FleetRouter
+from polyaxon_tpu.serving.router import FleetRouter, _http_json
 from polyaxon_tpu.stats.metrics import labeled_key
 
 __all__ = ["LocalServingFleet", "ServingFleet"]
@@ -137,6 +137,7 @@ class LocalServingFleet:
         name = name or f"r{next(self._counter)}"
         port = _free_port()
         spec = {
+            "name": name,
             "host": self.host,
             "port": port,
             "seed": self.seed,
@@ -332,6 +333,10 @@ class ServingFleet:
         self._runs: Dict[str, int] = {}
         #: old run id → in-flight drain/replace operation state.
         self._ops: Dict[int, Dict[str, Any]] = {}
+        #: replica name → ``finished_at`` of the newest slow-request
+        #: exemplar already landed as a ``ttft_slow`` anomaly row.
+        self._exemplar_seen: Dict[str, float] = {}
+        self._exemplar_harvest_at = 0.0
         self._counter = itertools.count()
         fleets = getattr(orch, "fleets", None)
         if fleets is not None:
@@ -423,12 +428,17 @@ class ServingFleet:
         self.router.drain(name, deadline_s=self.drain_deadline_s)
         return True
 
+    #: Seconds between exemplar-harvest sweeps — a /v1/stats fetch per
+    #: replica, so it must not ride every 50 ms pump tick.
+    EXEMPLAR_HARVEST_INTERVAL_S = 2.0
+
     # -- pump ------------------------------------------------------------------
     def poll(self) -> None:
         self._register_urls()
         if getattr(self.router, "_thread", None) is None:
             self.router.probe_all()
         now = time.time()
+        self._harvest_exemplars(now)
         for run_id in list(self._ops):
             op = self._ops[run_id]
             if op["phase"] == "draining":
@@ -454,6 +464,73 @@ class ServingFleet:
                 continue
             if run.service_url:
                 self.router.add_replica(name, run.service_url)
+
+    def _harvest_exemplars(self, now: float) -> None:
+        """Land each replica's slow-request exemplars as ``ttft_slow``
+        anomaly rows + a run-artifact JSON dump.
+
+        The engine keeps a bounded ring of the slowest fully-traced
+        requests per window (``trace_exemplars`` on ``/v1/stats``); the
+        control plane copies anything newer than the last sweep into the
+        replica run's ``reports/`` dir and records the run-relative key
+        on the anomaly row — exactly the flight-recorder ``stall``
+        contract, so a firing ``serving_ttft_p99`` alert attaches it via
+        ``RuleContext.dump_artifact("ttft_slow")``.
+        """
+        if now - self._exemplar_harvest_at < self.EXEMPLAR_HARVEST_INTERVAL_S:
+            return
+        self._exemplar_harvest_at = now
+        registry = getattr(self.orch, "registry", None)
+        layout = getattr(self.orch, "layout", None)
+        if registry is None or layout is None:
+            return
+        for name, run_id in list(self._runs.items()):
+            rep = self.router.replica(name)
+            if rep is None or rep.state not in ("ready", "draining"):
+                continue
+            try:
+                code, body = _http_json(
+                    rep.base_url + "/v1/stats",
+                    timeout=self.router.probe_timeout_s,
+                )
+            except Exception:
+                continue
+            if code != 200:
+                continue
+            exemplars = body.get("trace_exemplars") or []
+            newest = max(
+                (float(e.get("finished_at") or 0.0) for e in exemplars),
+                default=0.0,
+            )
+            if not exemplars or newest <= self._exemplar_seen.get(name, 0.0):
+                continue
+            try:
+                run = self.orch.get_run(run_id)
+                paths = layout.run_paths(run.uuid)
+                paths.reports.mkdir(parents=True, exist_ok=True)
+                fname = f"ttft_exemplars_{int(newest * 1000)}.json"
+                (paths.reports / fname).write_text(
+                    json.dumps(
+                        {"replica": name, "exemplars": exemplars}, indent=2
+                    )
+                )
+                registry.add_anomaly(
+                    run_id,
+                    "ttft_slow",
+                    message=(
+                        f"{len(exemplars)} slow-request exemplar(s) "
+                        f"from {name}"
+                    ),
+                    attrs={
+                        "dump_artifact": f"reports/{fname}",
+                        "trace_ids": [
+                            e.get("trace_id") for e in exemplars
+                        ],
+                    },
+                )
+            except Exception:
+                continue
+            self._exemplar_seen[name] = newest
 
     def _poll_draining(
         self, run_id: int, op: Dict[str, Any], now: float

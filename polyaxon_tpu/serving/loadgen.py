@@ -221,12 +221,15 @@ def _fire_one(
     temperature: float,
     timeout_s: float,
     t_submit: float,
-) -> "tuple[str, Optional[float], int]":
-    """One ``/generate`` round-trip → (typed outcome, ttft, n_tokens).
+) -> "tuple[str, Optional[float], int, Optional[Dict[str, Any]]]":
+    """One ``/generate`` round-trip → (typed outcome, ttft, n_tokens,
+    trace block).
 
     The typed-outcome contract shared by every HTTP load harness:
     ``completed`` / ``shed`` (429) / ``error:<kind>`` /
-    ``failure:<ExcType>`` — exactly one outcome per request.
+    ``failure:<ExcType>`` — exactly one outcome per request.  The trace
+    block is the server's ``{"trace_id", "waterfalls"}`` response key
+    (None when tracing is off or the request failed).
     """
     import json as json_mod
     import urllib.error
@@ -257,16 +260,19 @@ def _fire_one(
             min(server_ttfts) if server_ttfts
             else time.perf_counter() - t_submit
         )
-        return "completed", ttft, n_tok
+        trace = body.get("trace")
+        return "completed", ttft, n_tok, (
+            trace if isinstance(trace, dict) else None
+        )
     except urllib.error.HTTPError as e:
         try:
             err = (json_mod.loads(e.read() or b"{}").get("error")) or {}
         except ValueError:
             err = {}
         kind = str(err.get("kind") or f"http_{e.code}")
-        return ("shed" if e.code == 429 else f"error:{kind}"), None, 0
+        return ("shed" if e.code == 429 else f"error:{kind}"), None, 0, None
     except Exception as e:
-        return f"failure:{type(e).__name__}", None, 0
+        return f"failure:{type(e).__name__}", None, 0, None
 
 
 def http_poisson_load(
@@ -310,14 +316,16 @@ def http_poisson_load(
     ttfts_by_idx: List[Optional[float]] = [None] * len(prompts)
     latencies: List[Optional[float]] = [None] * len(prompts)
     tokens_out = [0] * len(prompts)
+    traces: List[Optional[Dict[str, Any]]] = [None] * len(prompts)
 
     def fire(i: int, prompt: Sequence[int], t_submit: float) -> None:
-        outcome, ttft, n_tok = _fire_one(
+        outcome, ttft, n_tok, trace = _fire_one(
             base, prompt, max_new_tokens, temperature, timeout_s, t_submit
         )
         tokens_out[i] = n_tok
         ttfts_by_idx[i] = ttft
         outcomes[i] = outcome
+        traces[i] = trace
         latencies[i] = time.perf_counter() - t_submit
 
     # Fault schedule: one timer thread per event, armed relative to load
@@ -380,7 +388,37 @@ def http_poisson_load(
             round(t, 6) if t is not None else None for t in ttfts_by_idx
         ],
         "outcomes": list(outcomes),
+        "trace_ids": [
+            t.get("trace_id") if t is not None else None for t in traces
+        ],
+        "slow_requests": _slowest_traced(traces, latencies, n=5),
     }
+
+
+def _slowest_traced(
+    traces: "List[Optional[Dict[str, Any]]]",
+    latencies: "List[Optional[float]]",
+    *,
+    n: int,
+) -> List[Dict[str, Any]]:
+    """The ``n`` slowest traced requests (by client-observed latency)
+    with their server waterfalls — the load summary's "where did the
+    tail go" exhibit.  Empty when the server traced nothing."""
+    slow = []
+    for trace, latency in zip(traces, latencies):
+        if trace is None or latency is None:
+            continue
+        waterfalls = trace.get("waterfalls") or [None]
+        slow.append(
+            {
+                "trace_id": trace.get("trace_id"),
+                "request_id": (waterfalls[0] or {}).get("request_id"),
+                "latency_s": round(latency, 6),
+                "waterfall": (waterfalls[0] or {}).get("waterfall"),
+            }
+        )
+    slow.sort(key=lambda e: e["latency_s"], reverse=True)
+    return slow[:n]
 
 
 class ChaosEvent:
@@ -505,15 +543,19 @@ def chaos_poisson_load(
     outcomes: List[Optional[str]] = [None] * n
     ttfts_by_idx: List[Optional[float]] = [None] * n
     tokens_out = [0] * n
+    traces: List[Optional[Dict[str, Any]]] = [None] * n
+    latencies: List[Optional[float]] = [None] * n
     phase_of = [idx for _, idx in arrivals]
 
     def fire(i: int, prompt: Sequence[int], t_submit: float) -> None:
-        outcome, ttft, n_tok = _fire_one(
+        outcome, ttft, n_tok, trace = _fire_one(
             base, prompt, max_new_tokens, temperature, timeout_s, t_submit
         )
         tokens_out[i] = n_tok
         ttfts_by_idx[i] = ttft
         outcomes[i] = outcome
+        traces[i] = trace
+        latencies[i] = time.perf_counter() - t_submit
 
     def apply_event(ev: ChaosEvent) -> None:
         if fleet is None or ev.action == "burst":
@@ -635,4 +677,8 @@ def chaos_poisson_load(
         "ttft_p99_s": round(_pct(ttfts, 99), 6),
         "by_phase": by_phase,
         "outcomes": list(outcomes),
+        "trace_ids": [
+            t.get("trace_id") if t is not None else None for t in traces
+        ],
+        "slow_requests": _slowest_traced(traces, latencies, n=5),
     }

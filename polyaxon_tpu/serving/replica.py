@@ -35,11 +35,20 @@ import sys
 def serve(spec: dict) -> None:
     # Heavy imports stay inside serve() so `--help`-style failures and
     # spec parse errors don't pay for jax.
+    import os
+
     import jax
 
     from polyaxon_tpu.builtins.services import _make_lm_handler
     from polyaxon_tpu.models import TransformerConfig, init_params
     from polyaxon_tpu.serving import ServingEngine
+    from polyaxon_tpu.tracking.trace import get_tracer
+
+    # Label this process's spans with the replica name: span ids become
+    # globally unique across the fleet and the router's merged trace
+    # export gives each replica its own named Perfetto track.
+    name = str(spec.get("name") or f"replica-{spec.get('port', 0)}")
+    get_tracer().configure(process=name, process_id=os.getpid())
 
     model = {k: int(v) for k, v in (spec.get("model") or {}).items()}
     seq = int(spec.get("seq", 128))

@@ -58,9 +58,17 @@ import urllib.request
 from http.client import HTTPException
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from polyaxon_tpu.conf.knobs import knob_float, knob_int
+from polyaxon_tpu.conf.knobs import knob_bool, knob_float, knob_int
 from polyaxon_tpu.stats import MemoryStats
 from polyaxon_tpu.stats.metrics import labeled_key
+from polyaxon_tpu.tracking.trace import (
+    TraceContext,
+    chrome_trace,
+    extract,
+    get_tracer,
+    inject,
+    new_trace_id,
+)
 
 __all__ = ["FleetRouter", "Replica", "RouterError", "make_router_handler"]
 
@@ -150,15 +158,16 @@ def _http_json(
     payload: Optional[Dict[str, Any]] = None,
     *,
     timeout: float,
+    headers: Optional[Dict[str, str]] = None,
 ) -> "tuple[int, Dict[str, Any]]":
     """One JSON round-trip; HTTP error statuses return (code, body),
     connection-level failures raise OSError/HTTPException."""
     data = None
-    headers = {}
+    all_headers = dict(headers or {})
     if payload is not None:
         data = json.dumps(payload).encode()
-        headers["Content-Type"] = "application/json"
-    req = urllib.request.Request(url, data=data, headers=headers)
+        all_headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=all_headers)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, json.loads(resp.read() or b"{}")
@@ -258,6 +267,10 @@ class FleetRouter:
             else knob_float("POLYAXON_TPU_ROUTER_AFFINITY_HIT_SLACK")
         )
         self.on_drained = on_drained
+        #: Request tracing: when on, every proxied /generate gets a root
+        #: span + per-attempt child spans, and the traceparent rides the
+        #: upstream hop so replica/engine spans join the same trace.
+        self.trace_requests = knob_bool("POLYAXON_TPU_TRACE_REQUESTS")
         self._replicas: Dict[str, Replica] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -574,6 +587,7 @@ class FleetRouter:
         max_new_tokens: Optional[int] = None,
         temperature: float = 0.0,
         timeout_s: Optional[float] = None,
+        trace: Optional[TraceContext] = None,
     ) -> Dict[str, Any]:
         """Proxy one ``/generate`` call with bounded failover.
 
@@ -582,6 +596,13 @@ class FleetRouter:
         different replica up to ``retry_limit`` times; replica HTTP
         errors come back as typed :class:`RouterError`.  The response
         dict gains ``replica`` and ``retries`` keys.
+
+        When tracing is on, ``trace`` (an inbound client context) or a
+        fresh trace id covers the WHOLE call: one ``router.request``
+        root span, one ``router.attempt`` child per upstream try — so a
+        failover shows every attempt on the same timeline — and the
+        traceparent is injected on each hop so replica-side spans join
+        the trace.  The response's ``trace`` block gains the trace id.
         """
         timeout = timeout_s if timeout_s is not None else self.request_timeout_s
         payload: Dict[str, Any] = {
@@ -592,6 +613,43 @@ class FleetRouter:
             payload["max_new_tokens"] = max_new_tokens
         self.counters["requests"] += 1
         self._incr("router_requests_total")
+        ctx: Optional[TraceContext] = None
+        if self.trace_requests:
+            ctx = trace if trace is not None else TraceContext(new_trace_id())
+            if not ctx.sampled:
+                ctx = None
+        if ctx is None:
+            return self._attempt_loop(prompts, payload, timeout, None)
+        with get_tracer().span(
+            "router.request",
+            sample=1.0,
+            trace_id=ctx.trace_id,
+            parent_id=ctx.span_id or None,
+            process="router",
+            prompts=len(prompts),
+        ) as root:
+            body = self._attempt_loop(
+                prompts, payload, timeout, ctx.child(root.span_id)
+            )
+        trace_block = body.setdefault("trace", {})
+        trace_block["trace_id"] = ctx.trace_id
+        return body
+
+    def _attempt_loop(
+        self,
+        prompts: Sequence[Sequence[int]],
+        payload: Dict[str, Any],
+        timeout: float,
+        ctx: Optional[TraceContext],
+    ) -> Dict[str, Any]:
+        """The bounded-failover loop behind :meth:`generate`.
+
+        ``ctx``, when given, is parented to the ``router.request`` root
+        span; each try wraps its upstream hop in a ``router.attempt``
+        span and injects a context parented to THAT span, so the merged
+        timeline nests client → router → attempt → replica.
+        """
+        tracer = get_tracer()
         tried: set = set()
         last_error = "no attempt made"
         for attempt in range(self.retry_limit + 1):
@@ -610,9 +668,29 @@ class FleetRouter:
                     )
                 raise
             try:
-                code, body = _http_json(
-                    rep.base_url + "/generate", payload, timeout=timeout
-                )
+                headers: Dict[str, str] = {}
+                if ctx is not None:
+                    with tracer.span(
+                        "router.attempt",
+                        sample=1.0,
+                        trace_id=ctx.trace_id,
+                        parent_id=ctx.span_id or None,
+                        process="router",
+                        replica=rep.name,
+                        attempt=attempt,
+                    ) as asp:
+                        inject(ctx.child(asp.span_id), headers)
+                        code, body = _http_json(
+                            rep.base_url + "/generate",
+                            payload,
+                            timeout=timeout,
+                            headers=headers,
+                        )
+                        asp.set(status=code)
+                else:
+                    code, body = _http_json(
+                        rep.base_url + "/generate", payload, timeout=timeout
+                    )
             except socket.timeout:
                 # The replica is alive but slow — retrying elsewhere
                 # would double the load that made it slow.
@@ -689,6 +767,42 @@ class FleetRouter:
             "shed_occupancy": self.shed_occupancy,
         }
 
+    def merged_trace(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """All spans of one trace, fleet-wide, as a Perfetto-loadable dict.
+
+        Merges the router's own ring buffer with each replica's
+        ``GET /v1/trace/<trace_id>`` response; the chrome-trace export
+        keys process rows by span ``process`` label, so router and every
+        replica land on distinct named tracks of one timeline.  Returns
+        None when no process holds any span for the id (expired from
+        the ring buffers, or never sampled).
+        """
+        spans = [
+            s
+            for s in get_tracer().spans()
+            if s.get("trace_id") == trace_id
+        ]
+        with self._lock:
+            urls = [r.base_url for r in self._replicas.values()]
+        for base_url in urls:
+            try:
+                code, body = _http_json(
+                    base_url + "/v1/trace/" + trace_id,
+                    timeout=self.probe_timeout_s,
+                )
+            except (OSError, HTTPException, ValueError):
+                continue  # a dead replica must not break the merge
+            if code == 200 and isinstance(body.get("spans"), list):
+                spans.extend(body["spans"])
+        if not spans:
+            return None
+        spans.sort(key=lambda s: s.get("start", 0.0))
+        return {
+            "trace_id": trace_id,
+            "spans": spans,
+            "chrome_trace": chrome_trace(spans),
+        }
+
     # -- stats plumbing --------------------------------------------------------
     def _incr(self, key: str) -> None:
         try:
@@ -742,6 +856,20 @@ def make_router_handler(router: FleetRouter, meta: Optional[dict] = None):
         def do_GET(self):
             if self.path == "/v1/stats":
                 return self._json(200, router.stats())
+            if self.path.startswith("/v1/trace/"):
+                trace_id = self.path[len("/v1/trace/"):]
+                merged = router.merged_trace(trace_id) if trace_id else None
+                if merged is None:
+                    return self._json(
+                        404,
+                        {
+                            "error": {
+                                "kind": "not_found",
+                                "message": f"no spans for trace {trace_id!r}",
+                            }
+                        },
+                    )
+                return self._json(200, merged)
             if self.path == "/metrics":
                 from polyaxon_tpu.stats.metrics import (
                     PROMETHEUS_CONTENT_TYPE,
@@ -809,6 +937,9 @@ def make_router_handler(router: FleetRouter, meta: Optional[dict] = None):
                     prompts,
                     int(max_new) if max_new is not None else None,
                     temperature,
+                    # Malformed/missing traceparent → None → fresh trace;
+                    # a client header must never turn into a 500.
+                    trace=extract(self.headers),
                 )
             except RouterError as e:
                 return self._router_error(e)

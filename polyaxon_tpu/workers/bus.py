@@ -116,7 +116,10 @@ class TaskBus:
         try:
             # Control-plane spans stay in the tracer's ring buffer (no
             # sink) — a cheap flight recorder of recent task executions.
-            with get_tracer().span(f"task:{name}"):
+            # The task name rides as an attribute, not in the span name:
+            # interpolated names would mint one Perfetto track per task
+            # (graft-lint GL008).
+            with get_tracer().span("task.execute", task=name):
                 fn(**kwargs)
         except Retry as r:
             outcome = "retry"

@@ -120,3 +120,16 @@ def export_bad_labels(stats, run_id, replica_name):
     stats.incr(labeled_key("orders_bad", customer_id="42"))
     # **kwargs label set: unreviewable keys.
     stats.incr(labeled_key("dyn_bad", **{"run": str(run_id)}))
+
+
+# -- GL008: span-name hygiene -------------------------------------------------
+
+def trace_bad_spans(tracer, task_name):
+    # f-string span name: one Perfetto track per task.
+    with tracer.span(f"task:{task_name}"):
+        pass
+    # Literal but not dot-delimited.
+    with tracer.span("NotDotted"):
+        pass
+    # Dot-delimited but not in the catalog.
+    tracer.record_span("serving.bogus_phase", start=0.0, duration=0.0)

@@ -109,3 +109,32 @@ def export_good_labels(stats, run_id, method, code):
         )
     )
     stats.incr(labeled_key("plain_counter_ok"))
+
+
+# -- GL008: span-name hygiene -------------------------------------------------
+
+def trace_good_spans(tracer, match, step):
+    # Catalogued literal name; the variable part rides as an attribute.
+    with tracer.span("train.step", step=step):
+        pass
+    # re.Match.span() / .span(group) — not tracer calls, must not flag.
+    match.span()
+    match.span(1)
+
+
+class EngineLikeForwarders:
+    """The serving engine's forwarding-wrapper shape: the name parameter
+    passes through verbatim, so the literal check applies at call sites."""
+
+    def __init__(self, tracer):
+        self._tracer = tracer
+
+    def _trace_span(self, req, name, start, duration, **attrs):
+        self._tracer.record_span(name, start=start, duration=duration, **attrs)
+
+    def _trace_hot(self, req, name, start, duration, **attrs):
+        self._trace_span(req, name, start, duration, **attrs)
+
+    def prefill(self, req, t0, dt):
+        self._trace_span(req, "serving.prefill", t0, dt)
+        self._trace_hot(req, "serving.decode.step", t0, dt)

@@ -16,6 +16,7 @@ from polyaxon_tpu.analysis.rules import (
     LockDisciplineRule,
     MetricLabelRule,
     NetTimeoutRule,
+    SpanNameRule,
     TickPathRule,
 )
 
@@ -100,6 +101,15 @@ def test_gl007_fires_on_interpolated_and_uncatalogued_labels():
     assert "**kwargs" in messages
 
 
+def test_gl008_fires_on_interpolated_and_uncatalogued_span_names():
+    findings = _bad([SpanNameRule()])
+    messages = " | ".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "not a string literal" in messages
+    assert "'NotDotted'" in messages
+    assert "'serving.bogus_phase'" in messages
+
+
 # -- precision: the good fixture is silent -----------------------------------
 
 @pytest.mark.parametrize(
@@ -112,6 +122,7 @@ def test_gl007_fires_on_interpolated_and_uncatalogued_labels():
         KnobRegistryRule,
         NetTimeoutRule,
         MetricLabelRule,
+        SpanNameRule,
     ],
 )
 def test_good_fixture_is_clean(rule_cls):

@@ -168,7 +168,7 @@ class TestTimelineFormats:
             orch.registry.add_span(
                 run["id"],
                 {
-                    "name": "worker:entrypoint",
+                    "name": "worker.entrypoint",
                     "start": 10.0,
                     "duration": 2.0,
                     "process_id": 0,
@@ -181,7 +181,7 @@ class TestTimelineFormats:
             explicit = await (await client.get(f"{base}?format=chrome")).json()
             assert explicit == chrome
             raw = await (await client.get(f"{base}?format=spans")).json()
-            assert [r["name"] for r in raw["results"]] == ["worker:entrypoint"]
+            assert [r["name"] for r in raw["results"]] == ["worker.entrypoint"]
             bad = await client.get(f"{base}?format=flamegraph")
             assert bad.status == 400
             assert "flamegraph" in (await bad.json())["error"]

@@ -118,7 +118,7 @@ class TestTimelineEndpoint:
         async def body(client):
             run = await (await client.post("/api/v1/runs", json={"spec": SPEC})).json()
             for pid, (name, start) in enumerate(
-                [("worker:entrypoint", 10.0), ("worker:entrypoint", 10.5)]
+                [("worker.entrypoint", 10.0), ("worker.entrypoint", 10.5)]
             ):
                 orch.registry.add_span(
                     run["id"],
@@ -166,9 +166,9 @@ class TestTimelineEndpoint:
             ).json()
             xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
             names = {e["name"] for e in xs}
-            assert "worker:entrypoint" in names, names
+            assert "worker.entrypoint" in names, names
             # Spans from the worker carry the run uuid as trace id.
-            entry = next(e for e in xs if e["name"] == "worker:entrypoint")
+            entry = next(e for e in xs if e["name"] == "worker.entrypoint")
             assert entry["args"]["trace_id"] == run["uuid"]
             assert entry["dur"] > 0
             return True

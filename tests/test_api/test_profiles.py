@@ -146,7 +146,7 @@ class TestProfileManifest:
         # One span inside the capture window, one far outside it.
         orch.registry.add_span(
             run_id,
-            {"name": "train:step", "start": 105.0, "duration": 0.5, "process_id": 0},
+            {"name": "train.step", "start": 105.0, "duration": 0.5, "process_id": 0},
         )
         orch.registry.add_span(
             run_id,
@@ -176,7 +176,7 @@ class TestProfileManifest:
                 for e in doc["trace"]["traceEvents"]
                 if e.get("ph") == "X"
             ]
-            assert names == ["train:step"]
+            assert names == ["train.step"]
             # ?format=chrome serves the raw trace document.
             chrome = await (
                 await client.get(

@@ -412,6 +412,40 @@ class TestBuiltinCatalog:
         assert out["value"] > 0.5
         assert "p99" in out["message"]
 
+    def test_serving_ttft_p99_attaches_exemplar_artifact(
+        self, reg, run, monkeypatch
+    ):
+        """A firing TTFT alert links the slow-request exemplar dump the
+        fleet harvested (the flight-recorder ``stall`` contract, applied
+        to serving): newest ``ttft_slow`` anomaly row wins."""
+        monkeypatch.setenv(
+            "POLYAXON_TPU_ALERT_SERVING_TTFT_P99_THRESHOLD_S", "0.5"
+        )
+        rule = self._rules()["serving_ttft_p99"]
+        stats = MemoryStats()
+        for _ in range(100):
+            stats.observe("serving.ttft_s", 2.0)
+        # Firing but no harvest yet: the alert still fires, no artifact.
+        out = rule.check(self._ctx(reg, run, stats=stats))
+        assert out is not None and "exemplar_artifact" not in out
+        reg.add_anomaly(
+            run.id,
+            "ttft_slow",
+            message="1 slow-request exemplar(s) from r0",
+            attrs={
+                "dump_artifact": "reports/ttft_exemplars_100.json",
+                "trace_ids": ["ab" * 16],
+            },
+        )
+        reg.add_anomaly(
+            run.id,
+            "ttft_slow",
+            message="2 slow-request exemplar(s) from r0",
+            attrs={"dump_artifact": "reports/ttft_exemplars_200.json"},
+        )
+        out = rule.check(self._ctx(reg, run, stats=stats))
+        assert out["exemplar_artifact"] == "reports/ttft_exemplars_200.json"
+
     def test_steady_state_compiles(self, reg, run):
         rule = self._rules()["steady_state_compiles"]
         stats = MemoryStats()

@@ -18,6 +18,7 @@ import pytest
 from polyaxon_tpu.serving.fleet import LocalServingFleet
 from polyaxon_tpu.serving.loadgen import http_poisson_load, shared_prefix_prompts
 from polyaxon_tpu.serving.router import FleetRouter, RouterError
+from polyaxon_tpu.tracking.trace import get_tracer
 
 MODEL = {
     "vocab_size": 64,
@@ -76,6 +77,50 @@ class TestFleetServing:
         assert len(out["tokens"][0]) == 8
         assert out["replica"] in st["replicas"]
         assert out["ttft_s"][0] is not None
+
+    def test_traced_generate_yields_merged_waterfall(self, fleet):
+        """One /generate, fully traced across processes: the response
+        carries a waterfall that explains the client-observed latency,
+        and the router's merged export puts router and replica spans on
+        distinct labeled tracks under a single trace id."""
+        # Long enough that decode dominates the two localhost HTTP hops;
+        # best-of-3 shields the completeness bound from one-core
+        # scheduling jitter (the bench arm holds it under real load).
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fleet.router.generate([[5, 3, 2, 6]], max_new_tokens=56)
+            client_s = time.perf_counter() - t0
+            (wf,) = out["trace"]["waterfalls"]
+            assert wf["outcome"] == "completed"
+            err = abs(sum(wf["waterfall"].values()) - client_s) / client_s
+            if best is None or err < best[0]:
+                best = (err, out, client_s)
+        err, out, client_s = best
+        tid = out["trace"]["trace_id"]
+        assert len(tid) == 32
+        assert err < 0.10, (
+            f"waterfall does not explain client-observed "
+            f"{client_s:.3f}s (err {err:.1%})"
+        )
+        merged = fleet.router.merged_trace(tid)
+        assert merged is not None
+        assert {s["trace_id"] for s in merged["spans"]} == {tid}
+        names = {s["name"] for s in merged["spans"]}
+        assert {
+            "router.request",
+            "router.attempt",
+            "serving.generate",
+            "serving.request",
+            "serving.queue_wait",
+        } <= names
+        tracks = {
+            e["args"]["name"]
+            for e in merged["chrome_trace"]["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"
+        }
+        assert "router" in tracks
+        assert out["replica"] in tracks  # replica spans on their own row
 
     def test_shared_prefix_traffic_is_sticky(self, fleet):
         # The shared prefix must cover the router's affinity window —
@@ -209,6 +254,27 @@ class TestFleetServing:
         assert ("ok" in outcome) ^ ("err" in outcome)
         if "err" in outcome:
             assert outcome["err"].kind in ("upstream_error", "no_replicas")
+        else:
+            # The whole ride — including any failover — was ONE trace:
+            # one router.attempt span per upstream try, and the merge
+            # still works with the killed replica unreachable.
+            out = outcome["ok"]
+            tid = out["trace"]["trace_id"]
+            attempts = [
+                s
+                for s in get_tracer().spans()
+                if s.get("trace_id") == tid and s["name"] == "router.attempt"
+            ]
+            assert len(attempts) == out["retries"] + 1
+            merged = fleet.router.merged_trace(tid)
+            assert merged is not None
+            if out["retries"]:
+                # The winning attempt ran on the survivor, so its engine
+                # spans are still reachable; the dead replica's are gone
+                # with the process and must not break the merge.
+                assert "serving.request" in {
+                    s["name"] for s in merged["spans"]
+                }
 
     def test_dead_replica_ejects_and_traffic_continues(self, fleet):
         router = fleet.router

@@ -1,0 +1,656 @@
+"""End-to-end request tracing: engine waterfalls, handler propagation,
+router failover spans, and the slow-request exemplar harvest.
+
+Three layers, cheapest faults first: the engine's interval-based
+waterfall accounting (every terminal path must close its trace), the
+production lm_server handler's traceparent handling (malformed headers
+must degrade to fresh traces, never 500), and the router's one-trace-
+per-failover guarantee against scriptable fake replicas (no jax on
+that path).  The subprocess-fleet merge test lives in
+test_fleet_local.py with the other LocalServingFleet integration tests.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from polyaxon_tpu.builtins.services import _make_lm_handler
+from polyaxon_tpu.models import TransformerConfig, init_params
+from polyaxon_tpu.serving import ServingEngine
+from polyaxon_tpu.serving.fleet import ServingFleet
+from polyaxon_tpu.serving.router import FleetRouter, make_router_handler
+from polyaxon_tpu.tracking.trace import (
+    TRACEPARENT_HEADER,
+    TraceContext,
+    extract,
+    get_tracer,
+    new_trace_id,
+)
+
+CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    head_dim=8,
+    d_ff=64,
+    max_seq=64,
+    dtype=jnp.float32,
+)
+
+
+def _trace_spans(trace_id):
+    return [
+        s for s in get_tracer().spans() if s.get("trace_id") == trace_id
+    ]
+
+
+def _wait_span(trace_id, name, timeout=5.0):
+    """Poll for a span: the handler flushes the HTTP response INSIDE its
+    ``serving.generate`` span, so the record lands a beat after the
+    client has the body."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        spans = [s for s in _trace_spans(trace_id) if s["name"] == name]
+        if spans:
+            return spans
+        time.sleep(0.02)
+    raise AssertionError(f"span {name} never recorded for {trace_id}")
+
+
+def _waterfall_sum(summary):
+    return sum(summary["waterfall"].values())
+
+
+# -- engine layer -------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    eng = ServingEngine(params, CFG, slots=2, max_len=CFG.max_seq).start()
+    yield eng
+    eng.stop()
+
+
+class TestEngineTracing:
+    def test_waterfall_partitions_wall_clock(self, engine):
+        ctx = TraceContext(new_trace_id(), "client.0.1")
+        t0 = time.perf_counter()
+        req = engine.submit([1, 2, 3], 12, trace=ctx)
+        req.wait(timeout=120)
+        client_s = time.perf_counter() - t0
+        s = req.trace_summary
+        assert s is not None
+        assert s["trace_id"] == ctx.trace_id
+        assert s["outcome"] == "completed"
+        assert s["tokens"] == 12
+        assert s["ttft_s"] is not None and 0 < s["ttft_s"] <= s["total_s"]
+        # Interval accounting: the phases partition the server wall
+        # clock, and the server wall clock tracks what the client saw.
+        assert _waterfall_sum(s) == pytest.approx(s["total_s"], rel=0.02)
+        assert abs(_waterfall_sum(s) - client_s) / client_s < 0.10
+        root = [
+            sp
+            for sp in _trace_spans(ctx.trace_id)
+            if sp["name"] == "serving.request"
+        ]
+        assert len(root) == 1
+        assert root[0]["span_id"] == s["span_id"]
+        assert root[0]["parent_id"] == "client.0.1"  # the remote caller
+        # Phase spans parent to the request root, not to each other.
+        phases = [
+            sp
+            for sp in _trace_spans(ctx.trace_id)
+            if sp["name"] in ("serving.queue_wait", "serving.first_token")
+        ]
+        assert phases and all(
+            sp["parent_id"] == s["span_id"] for sp in phases
+        )
+
+    def test_untraced_submit_records_nothing(self, engine):
+        req = engine.submit([4, 5], 4)
+        req.wait(timeout=120)
+        assert req.trace_summary is None
+
+    def test_trace_requests_flag_gates_tracing(self, engine, monkeypatch):
+        monkeypatch.setattr(engine, "trace_requests", False)
+        req = engine.submit([6, 7], 4, trace=TraceContext(new_trace_id()))
+        req.wait(timeout=120)
+        assert req.trace_summary is None
+
+    def test_unsampled_context_is_not_traced(self, engine):
+        ctx = TraceContext(new_trace_id(), sampled=False)
+        req = engine.submit([8, 9], 4, trace=ctx)
+        req.wait(timeout=120)
+        assert req.trace_summary is None
+        assert _trace_spans(ctx.trace_id) == []
+
+    def test_hot_sampling_never_breaks_waterfall(self, engine, monkeypatch):
+        """Decode-step spans are cosmetic: fully sampled or fully
+        dropped, the interval waterfall still sums to the total."""
+        tracer = get_tracer()
+        summaries = {}
+        for rate in (1.0, 0.0):
+            monkeypatch.setattr(tracer, "hot_sample", rate)
+            ctx = TraceContext(new_trace_id())
+            req = engine.submit([10, 11, 12], 10, trace=ctx)
+            req.wait(timeout=120)
+            summaries[rate] = req.trace_summary
+            hot = [
+                sp
+                for sp in _trace_spans(ctx.trace_id)
+                if sp["name"] == "serving.decode.step"
+            ]
+            if rate == 1.0:
+                assert hot, "fully-sampled request has no decode spans"
+            else:
+                assert hot == []
+        for s in summaries.values():
+            assert _waterfall_sum(s) == pytest.approx(
+                s["total_s"], rel=0.02
+            )
+
+    def test_exemplars_ride_stats_slowest_first(self, engine):
+        for n in (4, 14):
+            engine.submit([13, 14], n, trace=TraceContext(new_trace_id())).wait(
+                timeout=120
+            )
+        ex = engine.stats()["trace_exemplars"]
+        assert ex, "no exemplars after traced requests"
+        totals = [e["total_s"] for e in ex]
+        assert totals == sorted(totals, reverse=True)
+        assert {"trace_id", "request_id", "waterfall", "outcome"} <= set(ex[0])
+
+
+class TestEngineTracingTerminalPaths:
+    """Cancelled / stopped requests must still close their trace — an
+    SLO postmortem that loses exactly the failed requests is useless."""
+
+    @pytest.fixture()
+    def own_engine(self):
+        params = init_params(jax.random.PRNGKey(1), CFG)
+        eng = ServingEngine(params, CFG, slots=1, max_len=CFG.max_seq).start()
+        yield eng
+        eng.stop()
+
+    def test_cancelled_request_closes_trace(self, own_engine):
+        blocker = own_engine.submit([1, 2, 3], 40)
+        ctx = TraceContext(new_trace_id())
+        queued = own_engine.submit([4, 5, 6], 4, trace=ctx)
+        assert own_engine.cancel(queued.id)
+        with pytest.raises(RuntimeError, match="cancelled"):
+            queued.wait(timeout=60)
+        s = queued.trace_summary
+        assert s is not None and s["outcome"] == "cancelled"
+        assert _waterfall_sum(s) == pytest.approx(s["total_s"], rel=0.02)
+        roots = [
+            sp
+            for sp in _trace_spans(ctx.trace_id)
+            if sp["name"] == "serving.request"
+        ]
+        assert len(roots) == 1
+        assert roots[0]["attrs"]["outcome"] == "cancelled"
+        blocker.wait(timeout=120)
+
+    def test_engine_stop_closes_inflight_traces(self, own_engine):
+        ctx = TraceContext(new_trace_id())
+        req = own_engine.submit([1, 2, 3], 40, trace=ctx)
+        time.sleep(0.2)  # let it reach prefill/decode
+        own_engine.stop()
+        assert req.done.is_set()
+        s = req.trace_summary
+        assert s is not None
+        # "stopped" when the stop beat completion; "completed" only in
+        # the (tiny-model) race where all 40 tokens landed first.
+        assert s["outcome"] in ("stopped", "completed")
+        assert any(
+            sp["name"] == "serving.request"
+            for sp in _trace_spans(ctx.trace_id)
+        )
+
+
+# -- lm_server handler layer --------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    params = init_params(jax.random.PRNGKey(2), CFG)
+    engine = ServingEngine(params, CFG, slots=3, max_len=CFG.max_seq).start()
+    handler = _make_lm_handler(
+        engine, CFG, {"checkpoint_step": None, "default_max_new": 8}
+    )
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+    engine.stop()
+
+
+def _post(base, payload, headers=None, timeout=120):
+    req = urllib.request.Request(
+        base + "/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestHandlerTracing:
+    def test_direct_client_gets_fresh_trace_and_waterfalls(self, server):
+        t0 = time.perf_counter()
+        status, body = _post(
+            server, {"prompts": [[1, 2, 3]], "max_new_tokens": 16}
+        )
+        client_s = time.perf_counter() - t0
+        assert status == 200
+        trace = body["trace"]
+        assert len(trace["trace_id"]) == 32
+        int(trace["trace_id"], 16)  # raises if the server minted garbage
+        (wf,) = trace["waterfalls"]
+        assert wf["outcome"] == "completed"
+        # Completeness against what the CLIENT observed: phases must
+        # explain the latency, not just the engine's own wall clock.
+        assert abs(_waterfall_sum(wf) - client_s) / client_s < 0.10
+
+    def test_malformed_traceparent_degrades_to_fresh_trace(self, server):
+        """The propagation edge the ISSUE pins: garbage headers are a
+        fresh trace, never a 500."""
+        tid = new_trace_id()
+        seen = set()
+        for raw in (
+            "garbage",
+            "00-%s-abc" % tid,  # wrong field count
+            "00-%s-0000000000000000-01" % ("z" * 32),  # non-hex trace id
+            "00-%s-0000000000000000-zz" % tid,  # non-hex flags
+        ):
+            status, body = _post(
+                server,
+                {"prompts": [[7, 8]], "max_new_tokens": 2},
+                headers={TRACEPARENT_HEADER: raw},
+            )
+            assert status == 200, (raw, body)
+            assert body["trace"]["trace_id"] != tid
+            seen.add(body["trace"]["trace_id"])
+        assert len(seen) == 4  # each degraded request minted its own
+
+    def test_valid_traceparent_joins_client_trace(self, server):
+        ctx = TraceContext(new_trace_id(), "client.0.9")
+        status, body = _post(
+            server,
+            {"prompts": [[3, 4, 5], [6]], "max_new_tokens": 6},
+            headers={TRACEPARENT_HEADER: ctx.header()},
+        )
+        assert status == 200
+        assert body["trace"]["trace_id"] == ctx.trace_id
+        assert len(body["trace"]["waterfalls"]) == 2
+        # handler span parents to the client, engine roots to the handler
+        (gen,) = _wait_span(ctx.trace_id, "serving.generate")
+        assert gen["parent_id"] == "client.0.9"
+        roots = [
+            sp
+            for sp in _trace_spans(ctx.trace_id)
+            if sp["name"] == "serving.request"
+        ]
+        assert len(roots) == 2
+        assert all(sp["parent_id"] == gen["span_id"] for sp in roots)
+
+    def test_unsampled_traceparent_disables_tracing(self, server):
+        ctx = TraceContext(new_trace_id(), sampled=False)
+        status, body = _post(
+            server,
+            {"prompts": [[9]], "max_new_tokens": 2},
+            headers={TRACEPARENT_HEADER: ctx.header()},
+        )
+        assert status == 200
+        assert "trace" not in body
+        assert _trace_spans(ctx.trace_id) == []
+
+    def test_trace_endpoint_serves_spans(self, server):
+        ctx = TraceContext(new_trace_id())
+        _post(
+            server,
+            {"prompts": [[2, 3]], "max_new_tokens": 4},
+            headers={TRACEPARENT_HEADER: ctx.header()},
+        )
+        _wait_span(ctx.trace_id, "serving.generate")
+        status, body = _get(server, "/v1/trace/" + ctx.trace_id)
+        assert status == 200
+        names = {sp["name"] for sp in body["spans"]}
+        assert {"serving.generate", "serving.request"} <= names
+        # Unknown id: an empty list is a valid answer, not an error.
+        status, body = _get(server, "/v1/trace/" + "f" * 32)
+        assert status == 200 and body["spans"] == []
+
+
+# -- router layer (fake replicas, no jax) -------------------------------------
+
+
+class FakeTracedReplica:
+    """Scriptable lm_server stand-in that records each /generate call's
+    traceparent header and serves canned spans on /v1/trace/<id>."""
+
+    def __init__(self, label):
+        self.label = label
+        self.state = "ready"
+        self.stats = {"slots": 4, "slots_active": 0, "queue_depth": 0}
+        self.generate_response = (200, {"tokens": [[1, 2]], "ttft_s": [0.01]})
+        #: [(traceparent header value or None, request body), ...]
+        self.requests = []
+        #: trace_id -> canned span list for GET /v1/trace/<trace_id>.
+        self.trace_spans = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/v1/stats":
+                    return self._json(200, dict(outer.stats))
+                if self.path.startswith("/v1/trace/"):
+                    tid = self.path[len("/v1/trace/"):]
+                    return self._json(
+                        200,
+                        {
+                            "trace_id": tid,
+                            "spans": outer.trace_spans.get(tid, []),
+                        },
+                    )
+                return self._json(200, {"ok": True, "state": outer.state})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                outer.requests.append(
+                    (
+                        self.headers.get(TRACEPARENT_HEADER),
+                        json.loads(self.rfile.read(n)),
+                    )
+                )
+                resp = outer.generate_response
+                if resp == "close":
+                    self.connection.close()
+                    return
+                return self._json(*resp)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def fakes():
+    reps = [FakeTracedReplica("fake-a"), FakeTracedReplica("fake-b")]
+    yield reps
+    for rep in reps:
+        rep.close()
+
+
+@pytest.fixture()
+def router(fakes):
+    r = FleetRouter(
+        probe_interval_s=60.0,  # probed explicitly; no thread
+        probe_timeout_s=1.0,
+        request_timeout_s=5.0,
+        retry_limit=2,
+        eject_failures=5,
+        affinity_tokens=0,  # selection by load only — deterministic
+    )
+    r.add_replica("a", fakes[0].url)
+    r.add_replica("b", fakes[1].url)
+    # Busier "b" makes "a" the deterministic first pick.
+    fakes[1].stats["slots_active"] = 1
+    r.probe_all()
+    yield r
+    r.stop()
+
+
+class TestRouterTracing:
+    def test_failover_attempts_share_one_trace(self, router, fakes):
+        fakes[0].generate_response = "close"  # first pick dies mid-request
+        out = router.generate([[1, 2, 3]], max_new_tokens=4)
+        assert out["retries"] == 1 and out["replica"] == "b"
+        tid = out["trace"]["trace_id"]
+        spans = _trace_spans(tid)
+        roots = [s for s in spans if s["name"] == "router.request"]
+        attempts = [s for s in spans if s["name"] == "router.attempt"]
+        assert len(roots) == 1
+        assert len(attempts) == 2, "one span per failover attempt"
+        assert all(s["parent_id"] == roots[0]["span_id"] for s in attempts)
+        assert all(s.get("process") == "router" for s in roots + attempts)
+        by_attempt = {s["attrs"]["attempt"]: s for s in attempts}
+        assert by_attempt[0]["attrs"]["replica"] == "a"
+        assert "error" in by_attempt[0]["attrs"]  # the dead hop is marked
+        assert by_attempt[1]["attrs"]["replica"] == "b"
+        assert by_attempt[1]["attrs"]["status"] == 200
+        # Both upstream hops carried the SAME trace id, each parented
+        # to its own attempt span.
+        hop_ctxs = [
+            extract({TRACEPARENT_HEADER: header})
+            for rep in fakes
+            for (header, _body) in rep.requests
+        ]
+        assert len(hop_ctxs) == 2
+        assert {c.trace_id for c in hop_ctxs} == {tid}
+        assert {c.span_id for c in hop_ctxs} == {
+            by_attempt[0]["span_id"],
+            by_attempt[1]["span_id"],
+        }
+
+    def test_client_context_parents_router_root(self, router):
+        ctx = TraceContext(new_trace_id(), "cli.0.3")
+        out = router.generate([[1]], max_new_tokens=2, trace=ctx)
+        assert out["trace"]["trace_id"] == ctx.trace_id
+        (root,) = [
+            s
+            for s in _trace_spans(ctx.trace_id)
+            if s["name"] == "router.request"
+        ]
+        assert root["parent_id"] == "cli.0.3"
+
+    def test_trace_requests_off_adds_no_trace_block(self, router, fakes):
+        router.trace_requests = False
+        out = router.generate([[1, 2]], max_new_tokens=2)
+        assert "trace" not in out
+        header, _ = fakes[0].requests[-1]
+        assert header is None  # no traceparent on the upstream hop
+
+    def test_merged_trace_spans_fleet_tracks(self, router, fakes):
+        out = router.generate([[5, 6]], max_new_tokens=2)
+        tid = out["trace"]["trace_id"]
+        # Script the serving-side spans the replica would hold.
+        fakes[0].trace_spans[tid] = [
+            {
+                "name": "serving.request",
+                "trace_id": tid,
+                "span_id": "fake-a.0.1",
+                "parent_id": None,
+                "start": time.time(),
+                "duration": 0.01,
+                "process": "fake-a",
+                "process_id": 0,
+                "thread": "main",
+            }
+        ]
+        merged = router.merged_trace(tid)
+        assert merged is not None and merged["trace_id"] == tid
+        names = {s["name"] for s in merged["spans"]}
+        assert {"router.request", "router.attempt", "serving.request"} <= names
+        tracks = {
+            e["args"]["name"]
+            for e in merged["chrome_trace"]["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"
+        }
+        assert {"router", "fake-a"} <= tracks  # distinct labeled rows
+        assert router.merged_trace("e" * 32) is None
+
+    def test_handler_routes_trace_requests(self, router, fakes):
+        handler = make_router_handler(router)
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            ctx = TraceContext(new_trace_id(), "cli.0.7")
+            status, body = _post(
+                base,
+                {"prompts": [[1, 2]], "max_new_tokens": 2},
+                headers={TRACEPARENT_HEADER: ctx.header()},
+            )
+            assert status == 200
+            assert body["trace"]["trace_id"] == ctx.trace_id
+            status, merged = _get(base, "/v1/trace/" + ctx.trace_id)
+            assert status == 200
+            assert {"spans", "chrome_trace"} <= set(merged)
+            # Unknown trace: typed 404, not an empty 200.
+            req = urllib.request.Request(base + "/v1/trace/" + "d" * 32)
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    status = resp.status
+            except urllib.error.HTTPError as e:
+                status = e.code
+                assert json.loads(e.read())["error"]["kind"] == "not_found"
+            assert status == 404
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+# -- exemplar harvest (control-plane fleet) -----------------------------------
+
+
+class _FakeOrch:
+    """The minimal orchestrator surface ``_harvest_exemplars`` needs:
+    a real registry + store layout and run lookup."""
+
+    def __init__(self, base):
+        from polyaxon_tpu.db.registry import RunRegistry
+        from polyaxon_tpu.stores import StoreLayout
+
+        self.registry = RunRegistry(base / "reg.db")
+        self.layout = StoreLayout(base / "store")
+        self.fleets = []
+
+    def get_run(self, run_id):
+        return self.registry.get_run(run_id)
+
+    def close(self):
+        self.registry.close()
+
+
+class TestExemplarHarvest:
+    SPEC = {
+        "kind": "service",
+        "run": {"entrypoint": "noop:main"},
+        "environment": {"topology": {"accelerator": "cpu", "num_devices": 1}},
+    }
+
+    @pytest.fixture()
+    def orch(self, tmp_path):
+        o = _FakeOrch(tmp_path)
+        yield o
+        o.close()
+
+    def _exemplar(self, finished_at, total_s=2.5):
+        return {
+            "trace_id": new_trace_id(),
+            "span_id": "r0.0.1",
+            "request_id": 1,
+            "outcome": "completed",
+            "total_s": total_s,
+            "ttft_s": 2.0,
+            "tokens": 8,
+            "finished_at": finished_at,
+            "waterfall": {"queue_wait_s": 0.5, "prefill_s": 1.5,
+                          "decode_s": 0.5},
+        }
+
+    def test_harvest_lands_artifact_and_anomaly_once(self, orch):
+        run = orch.registry.create_run(dict(self.SPEC))
+        rep = FakeTracedReplica("r0")
+        router = FleetRouter(probe_interval_s=60.0, probe_timeout_s=1.0)
+        try:
+            fleet = ServingFleet(orch, router=router, replicas=1)
+            fleet._runs = {"r0": run.id}
+            router.add_replica("r0", rep.url)
+            router.replica("r0").state = "ready"
+            first = self._exemplar(finished_at=time.time())
+            rep.stats["trace_exemplars"] = [first]
+
+            now = time.time()
+            fleet._harvest_exemplars(now)
+            rows = orch.registry.get_anomalies(run.id, kind="ttft_slow")
+            assert len(rows) == 1
+            attrs = rows[0]["attrs"]
+            assert attrs["trace_ids"] == [first["trace_id"]]
+            key = attrs["dump_artifact"]
+            assert key.startswith("reports/ttft_exemplars_")
+            dump_path = (
+                orch.layout.run_paths(orch.get_run(run.id).uuid).root / key
+            )
+            dump = json.loads(dump_path.read_text())
+            assert dump["replica"] == "r0"
+            assert dump["exemplars"][0]["trace_id"] == first["trace_id"]
+
+            # Same snapshot on the next sweep: nothing newer, no new row.
+            fleet._harvest_exemplars(
+                now + fleet.EXEMPLAR_HARVEST_INTERVAL_S + 1
+            )
+            assert len(
+                orch.registry.get_anomalies(run.id, kind="ttft_slow")
+            ) == 1
+
+            # A newer slow request lands a second row.
+            rep.stats["trace_exemplars"] = [
+                first, self._exemplar(finished_at=time.time() + 5.0)
+            ]
+            fleet._harvest_exemplars(
+                now + 2 * (fleet.EXEMPLAR_HARVEST_INTERVAL_S + 1)
+            )
+            assert len(
+                orch.registry.get_anomalies(run.id, kind="ttft_slow")
+            ) == 2
+        finally:
+            rep.close()
+            router.stop()
+
+    def test_dead_replica_does_not_break_harvest(self, orch):
+        run = orch.registry.create_run(dict(self.SPEC))
+        router = FleetRouter(probe_interval_s=60.0, probe_timeout_s=0.2)
+        try:
+            fleet = ServingFleet(orch, router=router, replicas=1)
+            fleet._runs = {"r0": run.id}
+            router.add_replica("r0", "http://127.0.0.1:9")  # nothing listens
+            router.replica("r0").state = "ready"
+            fleet._harvest_exemplars(time.time())  # must not raise
+            assert orch.registry.get_anomalies(run.id, kind="ttft_slow") == []
+        finally:
+            router.stop()

@@ -143,7 +143,7 @@ class TestSpanEvent:
     def test_span_line_shape(self, tmp_path):
         r = Reporter(tmp_path / "p0.jsonl", process_id=2)
         record = {
-            "name": "worker:entrypoint",
+            "name": "worker.entrypoint",
             "trace_id": "abc",
             "span_id": "2.1",
             "parent_id": None,

@@ -7,7 +7,16 @@ reconfigured by tests.
 
 import threading
 
-from polyaxon_tpu.tracking.trace import Tracer, chrome_trace, get_tracer
+from polyaxon_tpu.tracking.trace import (
+    TRACEPARENT_HEADER,
+    TraceContext,
+    Tracer,
+    chrome_trace,
+    extract,
+    get_tracer,
+    inject,
+    new_trace_id,
+)
 
 
 def _spans_by_name(tracer):
@@ -187,11 +196,13 @@ class TestChromeTrace:
         assert doc["displayTimeUnit"] == "ms"
         metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
         xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
-        assert len(metas) == 1 and metas[0]["name"] == "thread_name"
+        assert [m["name"] for m in metas] == ["process_name", "thread_name"]
+        assert metas[0]["args"]["name"] == "process 1"
+        threads = [m for m in metas if m["name"] == "thread_name"]
         assert len(xs) == 1
         x = xs[0]
         assert x["name"] == "step" and x["pid"] == 1
-        assert x["tid"] == metas[0]["tid"]
+        assert x["tid"] == threads[0]["tid"]
         assert x["ts"] > 1e15  # epoch µs
         assert x["args"]["step"] == 3 and "span_id" in x["args"]
 
@@ -215,5 +226,146 @@ class TestChromeTrace:
         doc = chrome_trace(t.spans())
         xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
         assert xs[0]["tid"] == xs[1]["tid"]  # same thread, one row
-        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
-        assert len(metas) == 1
+        threads = [
+            e
+            for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"
+        ]
+        assert len(threads) == 1
+
+    def test_process_labels_get_distinct_tracks(self):
+        """Router and replicas all default to process_id=0 — the process
+        LABEL is what keeps a merged fleet trace on distinct rows."""
+        spans = []
+        for label in ("router", "replica-a"):
+            t = Tracer(process=label)  # both process_id=0
+            with t.span("router.request"):
+                pass
+            spans.extend(t.spans())
+        t = Tracer(process_id=0)  # unlabeled gang span keeps its raw pid
+        with t.span("train.step"):
+            pass
+        spans.extend(t.spans())
+        doc = chrome_trace(spans)
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len({e["pid"] for e in xs}) == 3
+        proc_names = {
+            e["args"]["name"]: e["pid"]
+            for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"
+        }
+        assert {"router", "replica-a"} <= set(proc_names)
+        # Labeled rows live in the synthetic-pid range, clear of raw pids.
+        assert proc_names["router"] >= 10_000
+        unlabeled = [e for e in xs if e["pid"] == 0]
+        assert len(unlabeled) == 1
+
+
+class TestTraceContext:
+    def test_inject_extract_round_trip(self):
+        tid = new_trace_id()
+        ctx = TraceContext(tid, "router.0.2a")
+        headers = inject(ctx, {})
+        assert headers[TRACEPARENT_HEADER] == f"00-{tid}-router.0.2a-01"
+        got = extract(headers)
+        assert got is not None
+        assert got.trace_id == tid
+        assert got.span_id == "router.0.2a"
+        assert got.sampled is True
+
+    def test_unsampled_flag_round_trips(self):
+        ctx = TraceContext(new_trace_id(), sampled=False)
+        got = extract(inject(ctx, {}))
+        assert got is not None and got.sampled is False
+
+    def test_empty_span_id_serializes_as_zeros(self):
+        tid = new_trace_id()
+        header = TraceContext(tid).header()
+        assert header == f"00-{tid}-{'0' * 16}-01"
+        got = extract({TRACEPARENT_HEADER: header})
+        assert got.span_id == ""  # all-zero parent = no remote parent
+
+    def test_child_keeps_trace_id_and_flags(self):
+        ctx = TraceContext(new_trace_id(), "a.1", sampled=False)
+        kid = ctx.child("b.2")
+        assert kid.trace_id == ctx.trace_id
+        assert kid.span_id == "b.2"
+        assert kid.sampled is False
+
+    def test_inject_none_is_noop(self):
+        assert inject(None, {}) == {}
+
+
+class TestExtract:
+    def test_missing_header_is_none(self):
+        assert extract(None) is None
+        assert extract({}) is None
+        assert extract({"content-type": "application/json"}) is None
+
+    def test_title_case_header_accepted(self):
+        tid = new_trace_id()
+        got = extract({"Traceparent": f"00-{tid}-{'0' * 16}-01"})
+        assert got is not None and got.trace_id == tid
+
+    def test_malformed_headers_degrade_to_none(self):
+        """Every malformed shape extracts to None — the receiving hop
+        mints a fresh trace instead of erroring (never a 500)."""
+        tid = new_trace_id()
+        for raw in (
+            "garbage",
+            "",
+            "00-%s-abc" % tid,  # 3 parts
+            "00-%s-abc-01-xx" % tid,  # 5 parts
+            "00-short-abc-01",  # trace id not 32 chars
+            "00-%s-abc-01" % ("z" * 32),  # non-hex trace id
+            "00-%s-abc-01" % ("0" * 32),  # all-zero trace id
+            "00-%s-abc-zz" % tid,  # non-hex flags
+            "0-%s-abc-01" % tid,  # bad version width
+            12345,  # non-string value
+        ):
+            assert extract({TRACEPARENT_HEADER: raw}) is None, raw
+
+
+class TestRecordSpan:
+    def test_explicit_ids_and_process_label(self):
+        t = Tracer(process="router")
+        rec = t.record_span(
+            "router.request",
+            start=1000.0,
+            duration=0.25,
+            trace_id="ab" * 16,
+            span_id="router.0.7",
+            parent_id="cli.0.1",
+            status=200,
+        )
+        assert rec["trace_id"] == "ab" * 16
+        assert rec["span_id"] == "router.0.7"
+        assert rec["parent_id"] == "cli.0.1"
+        assert rec["process"] == "router"
+        assert rec["attrs"] == {"status": 200}
+        assert t.spans()[-1] is not rec or t.spans()[-1] == rec
+
+    def test_process_attr_overrides_tracer_label(self):
+        """The control-plane router shares a process with other
+        components — per-span ``process=`` labels its track without
+        reconfiguring the global tracer."""
+        t = Tracer()
+        rec = t.record_span(
+            "router.attempt", start=0.0, duration=0.0, process="router"
+        )
+        assert rec["process"] == "router"
+
+    def test_span_ctx_manager_with_trace_overrides(self):
+        t = Tracer(process="router")
+        with t.span(
+            "router.request",
+            sample=1.0,
+            trace_id="cd" * 16,
+            parent_id="client.0.1",
+        ) as sp:
+            pass
+        rec = t.spans()[-1]
+        assert rec["trace_id"] == "cd" * 16
+        assert rec["parent_id"] == "client.0.1"
+        assert rec["span_id"] == sp.span_id
+        assert rec["span_id"].startswith("router.")

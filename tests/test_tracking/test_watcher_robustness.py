@@ -181,7 +181,7 @@ class TestProgressAndAnomalyIngestion:
                     {
                         "type": "span",
                         "ts": 10.0,
-                        "name": "train:step",
+                        "name": "train.step",
                         "trace_id": "t1",
                         "span_id": "0.1",
                         "parent_id": None,

@@ -65,12 +65,12 @@ class TestSpanIngestion:
         registry, watcher, handle = rig
         _append(handle.paths, 0, [
             {"type": "status", "ts": 1.0, "status": "running", "message": None},
-            _span_event("train:step", 0, 10.0, attrs={"step": 1}),
+            _span_event("train.step", 0, 10.0, attrs={"step": 1}),
             {"type": "metric", "ts": 2.0, "values": {"loss": 0.5}, "step": 1},
-            _span_event("train:step", 0, 12.0, attrs={"step": 2}),
+            _span_event("train.step", 0, 12.0, attrs={"step": 2}),
         ])
         _append(handle.paths, 1, [
-            _span_event("worker:entrypoint", 1, 9.0),
+            _span_event("worker.entrypoint", 1, 9.0),
             {"type": "metric", "ts": 2.5, "values": {"loss": 0.6}, "step": 1},
         ])
         watcher.ingest(handle)
@@ -80,7 +80,7 @@ class TestSpanIngestion:
         # Timeline order = wall-clock start, across processes.
         assert [s["start"] for s in spans] == [9.0, 10.0, 12.0]
         assert {s["process_id"] for s in spans} == {0, 1}
-        assert spans[0]["name"] == "worker:entrypoint"
+        assert spans[0]["name"] == "worker.entrypoint"
         assert spans[1]["attrs"] == {"step": 1}
         # Metrics ingested alongside, not displaced by the span lines.
         metrics = registry.get_metrics(handle.run_id)
@@ -98,7 +98,7 @@ class TestSpanIngestion:
 
     def test_unknown_keys_fold_into_attrs(self, rig):
         registry, watcher, handle = rig
-        event = _span_event("gang:spawn", 0, 1.0, hosts=4)
+        event = _span_event("gang.spawn", 0, 1.0, hosts=4)
         _append(handle.paths, 0, [event])
         watcher.ingest(handle)
         (span,) = registry.get_spans(handle.run_id)
@@ -118,7 +118,7 @@ class TestSpanIngestion:
         reporter = Reporter(handle.paths.report_file(0), process_id=0)
         reporter.span(
             {
-                "name": "worker:cmd",
+                "name": "worker.cmd",
                 "trace_id": handle.run_uuid,
                 "span_id": "0.1",
                 "parent_id": None,
@@ -131,7 +131,7 @@ class TestSpanIngestion:
         reporter.close()
         watcher.ingest(handle)
         (span,) = registry.get_spans(handle.run_id)
-        assert span["name"] == "worker:cmd"
+        assert span["name"] == "worker.cmd"
         assert span["trace_id"] == handle.run_uuid
         assert span["duration"] == 1.5
         doc = chrome_trace([span])
