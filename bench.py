@@ -2292,6 +2292,58 @@ def main() -> None:
 
         traceback.print_exc(file=sys.stderr)
 
+    # Metric-history scrape overhead: the scrape phase rides the monitor
+    # tick, so it gets a share-of-tick budget (amortised at the
+    # production scrape:tick cadence ratio) — and the query API must
+    # stay interactive against a populated registry while scrapes and
+    # report ingest run concurrently.
+    metrics_scrape_overhead = None
+    scrape_share_ok = None
+    metrics_query_p99_ok = None
+    try:
+        import sys
+        import tempfile
+
+        from polyaxon_tpu.monitor.cploadgen import run_scrape_overhead
+
+        metrics_scrape_overhead = run_scrape_overhead(
+            tempfile.mkdtemp(),
+            n_registry_runs=1000,
+            n_replicas=16,
+            n_gangs=4,
+            duration_s=4.0,
+            monitor_interval_s=0.05,
+            api_duration_s=2.0,
+            api_concurrency=2,
+        )
+        scrape_share = metrics_scrape_overhead["scrape_share"]
+        query_p99 = metrics_scrape_overhead["query_p99_s"]
+        scrape_share_ok = scrape_share is not None and scrape_share < 0.10
+        metrics_query_p99_ok = query_p99 is not None and query_p99 < 0.1
+        if not scrape_share_ok:
+            print(
+                f"bench: scrape_share={scrape_share} over the 10% budget — "
+                "the metric scrape phase is taxing the monitor tick",
+                file=sys.stderr,
+            )
+        if not metrics_query_p99_ok:
+            print(
+                f"bench: metrics query_p99_s={query_p99} over the 100ms "
+                "budget on a 1000-run registry",
+                file=sys.stderr,
+            )
+        if metrics_scrape_overhead.get("query_errors"):
+            print(
+                f"bench: {metrics_scrape_overhead['query_errors']} metric "
+                "query errors during the overhead hammer",
+                file=sys.stderr,
+            )
+    except Exception:
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+
     # graft-lint full-package runtime: the static pass rides every CI
     # invocation (`make lint` is in the gate), so it gets a wall-clock
     # budget like every other tick path — a rule that grows a quadratic
@@ -2459,6 +2511,9 @@ def main() -> None:
                     else None
                 ),
                 "cp_idle_tick_ok": cp_idle_tick_ok,
+                "metrics_scrape_overhead": metrics_scrape_overhead,
+                "scrape_share_ok": scrape_share_ok,
+                "metrics_query_p99_ok": metrics_query_p99_ok,
                 "analysis_runtime_s": (
                     round(analysis_runtime_s, 3)
                     if analysis_runtime_s is not None

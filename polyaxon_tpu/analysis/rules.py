@@ -730,6 +730,9 @@ _ALLOWED_LABEL_KEYS = {
     "action", "outcome", "direction",
     # serving fleet
     "replica", "fleet",
+    # metric history / burn-rate SLOs (slo_burn_* / slo_budget_remaining
+    # gauges — value bounded by the per-run declared SLO names)
+    "slo",
     # renderer-owned exposition labels
     "le", "component", "process", "version", "kind",
 }

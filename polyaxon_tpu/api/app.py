@@ -21,6 +21,7 @@ from polyaxon_tpu.conf.knobs import knob_int, knob_str
 from polyaxon_tpu.db.registry import RemediationStatus, Run, RunRegistry
 from polyaxon_tpu.events import EventTypes
 from polyaxon_tpu.exceptions import PolyaxonTPUError
+from polyaxon_tpu.monitor.alerts import RuleContext, run_slo_status
 from polyaxon_tpu.monitor.watcher import anomaly_status, goodput_status
 from polyaxon_tpu.orchestrator import Orchestrator
 from polyaxon_tpu.stats.metrics import (
@@ -28,6 +29,7 @@ from polyaxon_tpu.stats.metrics import (
     labeled_key,
     render_prometheus,
     render_standard_gauges,
+    split_labeled_key,
 )
 from polyaxon_tpu.tracking.trace import chrome_trace
 
@@ -84,6 +86,20 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
         except ValueError:
             raise web.HTTPBadRequest(
                 text=json.dumps({"error": f"query param {name!r} must be an integer"}),
+                content_type="application/json",
+            )
+
+    def _float_param(
+        request, name: str, default: Optional[float] = None
+    ) -> Optional[float]:
+        raw = request.rel_url.query.get(name)
+        if raw is None:
+            return default
+        try:
+            return float(raw)
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": f"query param {name!r} must be a number"}),
                 content_type="application/json",
             )
 
@@ -301,6 +317,20 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             "resolved": sum(1 for r in alert_rows if r["state"] == "resolved"),
             "results": alert_rows,
         }
+        # SLO roll-up: the run's declared error budget with both burn
+        # windows and budget remaining — None unless the run declares
+        # ``alert.slo_burn_rate.target`` and the metric store is live.
+        metrics_store = getattr(orch, "metrics", None)
+        if metrics_store is not None:
+            try:
+                payload["slo"] = run_slo_status(
+                    RuleContext(reg, run, stats=orch.stats, metrics=metrics_store)
+                )
+            except Exception:
+                logger.warning("SLO roll-up failed for run %d", run.id, exc_info=True)
+                payload["slo"] = None
+        else:
+            payload["slo"] = None
         # Remediation roll-up: what the control plane DID about trouble
         # (checkpoint-now, resume-from-step, eviction) — the action half
         # of the alerts block above.
@@ -563,6 +593,118 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
                 "engine": engine.status() if engine is not None else None,
             }
         )
+
+    # -- metric history (registry TSDB: scrape → rollup → query) --------------
+    #: Query params with reserved meaning on /metrics/query — everything
+    #: else is treated as a label matcher (?fleet=prod&run=12).
+    _QUERY_RESERVED = {"series", "name", "since", "until", "step", "agg", "limit"}
+
+    def _metric_store(request):
+        store = getattr(orch, "metrics", None)
+        if store is None:
+            raise _json_error(
+                web.HTTPServiceUnavailable,
+                "metric history disabled (POLYAXON_TPU_TSDB_ENABLED=false)",
+            )
+        return store
+
+    def _require_metric_access(request, store, base: str, matchers) -> None:
+        """Project ACL for the in-memory series: a run-labeled query is
+        gated by that run's project; aggregating run-labeled series
+        *across* runs (no ``run`` matcher) is admin-only, because the
+        result would blend projects the caller may not see.  Cluster
+        series (router/control-plane) are visible to any authed caller."""
+        run_label = matchers.get("run")
+        if run_label is not None:
+            try:
+                target = reg.get_run(int(run_label))
+            except (ValueError, PolyaxonTPUError):
+                raise _json_error(web.HTTPNotFound, f"run {run_label!r} not found")
+            _require_project(request, target.project)
+            return
+        if request.get("role") == "admin":
+            return
+        for key in store.series_keys(base):
+            _sbase, labels = split_labeled_key(key)
+            if "run" in labels:
+                raise _json_error(
+                    web.HTTPForbidden,
+                    f"series {base!r} is run-labeled: pass ?run=<id> "
+                    "(cross-run aggregation is admin-only)",
+                )
+
+    @routes.get(f"{API_PREFIX}/metrics/query")
+    async def metrics_query(request):
+        store = _metric_store(request)
+        name = request.query.get("series") or request.query.get("name")
+        if not name:
+            raise _json_error(
+                web.HTTPBadRequest, "query param 'series' is required"
+            )
+        base, inline = split_labeled_key(name)
+        if not store.has_series(base):
+            raise _json_error(web.HTTPBadRequest, f"unknown series {base!r}")
+        matchers = {
+            k: v for k, v in request.query.items() if k not in _QUERY_RESERVED
+        }
+        _require_metric_access(request, store, base, {**inline, **matchers})
+        max_points = knob_int("POLYAXON_TPU_TSDB_QUERY_MAX_POINTS")
+        limit = _int_param(request, "limit", max_points)
+        limit = max(1, min(limit, max_points))
+        agg = request.query.get("agg", "avg")
+        step = _float_param(request, "step")
+        try:
+            points = store.query(
+                name,
+                matchers=matchers,
+                since=_float_param(request, "since"),
+                until=_float_param(request, "until"),
+                step=step,
+                agg=agg,
+                limit=limit,
+            )
+        except ValueError as exc:
+            raise _json_error(web.HTTPBadRequest, str(exc))
+        return web.json_response(
+            {
+                "series": name,
+                "matchers": matchers,
+                "agg": agg,
+                "step": step,
+                "points": points,
+            }
+        )
+
+    @routes.get(f"{API_PREFIX}/metrics/series")
+    async def metrics_series(request):
+        store = _metric_store(request)
+        return web.json_response(
+            {"results": store.series_names(), "store": store.status()}
+        )
+
+    @routes.get(f"{API_PREFIX}/metrics/baselines")
+    async def metrics_baselines(request):
+        project = request.query.get("project", "default")
+        _require_project(request, project)
+        rows = reg.get_metric_baselines(project, kind=request.query.get("kind"))
+        return web.json_response({"results": rows})
+
+    @routes.get(f"{API_PREFIX}/runs/{{run_id}}/metrics/history")
+    async def run_metric_history(request):
+        # Persisted per-run samples (raw + rollups) — survives control-plane
+        # restarts, unlike the in-memory query window above.
+        run = _run_or_404(request)
+        agg = request.query.get("agg", "raw")
+        rows = reg.get_metric_samples(
+            run_id=run.id,
+            name=request.query.get("series") or request.query.get("name"),
+            agg=None if agg == "all" else agg,
+            since=_float_param(request, "since"),
+            until=_float_param(request, "until"),
+            since_id=_int_param(request, "since_id", 0),
+            limit=_int_param(request, "limit"),
+        )
+        return web.json_response({"results": rows})
 
     # -- on-demand device profiling (run command bus) -------------------------
     @routes.post(f"{API_PREFIX}/runs/{{run_id}}/profile")
@@ -1227,6 +1369,45 @@ def create_app(orch: Orchestrator, auth_token: Optional[str] = None):
             lambda _rid, cur: _visible_alert_rows(
                 request,
                 reg.get_alerts(since_id=cur, state=state, severity=severity),
+            ),
+            scoped=False,
+        )
+
+    @routes.get("/ws/v1/metrics")
+    async def ws_cluster_metrics(request):
+        # Cluster-wide live metric tail over the persisted sample feed:
+        # every flushed scrape row is a fresh id, so the generic cursor
+        # loop streams raw samples as the write-behind lands them.
+        # Row visibility mirrors the alert feed: run-labeled samples are
+        # project-gated, cluster samples (run_id NULL) are open to any
+        # authed caller.
+        name = request.query.get("series") or request.query.get("name")
+        agg = request.query.get("agg", "raw")
+        decided: Dict[int, bool] = {}
+
+        def _visible_metric_rows(rows):
+            out = []
+            for row in rows:
+                rid = row.get("run_id")
+                if rid is None:
+                    out.append(row)
+                    continue
+                if rid not in decided:
+                    try:
+                        target = reg.get_run(rid)
+                        decided[rid] = not _project_denied(request, target.project)
+                    except PolyaxonTPUError:
+                        decided[rid] = False
+                if decided[rid]:
+                    out.append(row)
+            return out
+
+        return await _ws_tail(
+            request,
+            lambda _rid, cur: _visible_metric_rows(
+                reg.get_metric_samples(
+                    since_id=cur, name=name, agg=None if agg == "all" else agg
+                )
             ),
             scoped=False,
         )

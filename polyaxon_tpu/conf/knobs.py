@@ -198,6 +198,10 @@ _ALL: List[Knob] = [
     Knob("POLYAXON_TPU_SERVING_SPEC_MIN_NGRAM", "int", 2,
          "n-gram length the prompt-lookup drafter matches against the "
          "request's own context", "serving"),
+    Knob("POLYAXON_TPU_SERVING_STATS_WINDOW_S", "float", 60.0,
+         "trailing window (s) for the *_window variants of /v1/stats "
+         "lifetime ratios (prefix_cache_hit_rate_window, "
+         "spec_accept_rate_window)", "serving"),
     # -- hierarchical KV (host offload tier + persistent prefix store) -----
     Knob("POLYAXON_TPU_KV_OFFLOAD", "bool", False,
          "host-memory KV tier: parked sequences spill their private "
@@ -305,6 +309,36 @@ _ALL: List[Knob] = [
          "max rows a WS tail sends per poll; the remainder is deferred "
          "to the next poll and exported as ws_tail_backlog_rows",
          "cp-telemetry"),
+    # -- metric history (in-process TSDB + scrape phase) -------------------
+    Knob("POLYAXON_TPU_TSDB_ENABLED", "bool", True,
+         "metric-history master switch: the monitor tick's scrape phase, "
+         "the registry metric_samples write-behind, and the query API",
+         "tsdb"),
+    Knob("POLYAXON_TPU_TSDB_SCRAPE_INTERVAL_S", "float", 5.0,
+         "scrape cadence (s) — the phase runs every monitor tick but "
+         "only samples when due, so tick rate doesn't multiply cost",
+         "tsdb"),
+    Knob("POLYAXON_TPU_TSDB_RAW_POINTS", "int", 720,
+         "raw ring length per series (at the default 5s cadence: 1h)",
+         "tsdb"),
+    Knob("POLYAXON_TPU_TSDB_ROLLUP_POINTS", "int", 360,
+         "rollup ring length per series per stage (10s stage: 1h; "
+         "1m stage: 6h of min/max/sum/count buckets)", "tsdb"),
+    Knob("POLYAXON_TPU_TSDB_MAX_SERIES", "int", 2048,
+         "per-base-name cap on distinct label sets in the MetricStore; "
+         "overflow folds into one {...=\"other\"} series", "tsdb"),
+    Knob("POLYAXON_TPU_TSDB_FLUSH_ROWS", "int", 512,
+         "max metric_samples rows flushed to the registry per scrape "
+         "(write-behind batch size)", "tsdb"),
+    Knob("POLYAXON_TPU_TSDB_PENDING_MAX", "int", 8192,
+         "bound on samples queued for the registry flush; overflow "
+         "drops the oldest (in-memory history is unaffected)", "tsdb"),
+    Knob("POLYAXON_TPU_TSDB_QUERY_MAX_POINTS", "int", 2000,
+         "max points one /api/v1/metrics/query response returns "
+         "(the newest win)", "tsdb"),
+    Knob("POLYAXON_TPU_BASELINE_ALPHA", "float", 0.3,
+         "EWMA weight for folding a completed run's summary series into "
+         "its (project, kind) regression baseline", "tsdb"),
     # -- control plane / CLI ------------------------------------------------
     Knob("POLYAXON_TPU_HOME", "str", "~/.polyaxon_tpu",
          "platform state dir for the local CLI and tooling state",

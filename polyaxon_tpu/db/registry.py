@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import functools
 import json
+import math
 import sqlite3
 import threading
 import time
@@ -43,7 +44,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 from polyaxon_tpu.exceptions import PolyaxonTPUError
 from polyaxon_tpu.lifecycles import StatusOptions as S, lifecycle_for_kind
-from polyaxon_tpu.stats.metrics import labeled_key
+from polyaxon_tpu.stats.metrics import labeled_key, split_labeled_key
 from polyaxon_tpu.schemas.specifications import (
     BaseSpecification,
     specification_for_kind,
@@ -360,6 +361,35 @@ CREATE TABLE IF NOT EXISTS remediations (
     updated_at REAL NOT NULL
 );
 CREATE INDEX IF NOT EXISTS ix_remediations_run ON remediations (run_id);
+
+CREATE TABLE IF NOT EXISTS metric_samples (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT NOT NULL,
+    run_id INTEGER,
+    at REAL NOT NULL,
+    value REAL NOT NULL,
+    agg TEXT NOT NULL DEFAULT 'raw',
+    vmin REAL,
+    vmax REAL,
+    vsum REAL,
+    vcount INTEGER,
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_metric_samples_name ON metric_samples (name, at);
+CREATE INDEX IF NOT EXISTS ix_metric_samples_run ON metric_samples (run_id);
+
+CREATE TABLE IF NOT EXISTS metric_baselines (
+    project TEXT NOT NULL,
+    kind TEXT NOT NULL,
+    series TEXT NOT NULL,
+    ewma REAL NOT NULL,
+    ewvar REAL NOT NULL DEFAULT 0,
+    count INTEGER NOT NULL DEFAULT 0,
+    last_value REAL,
+    last_run_id INTEGER,
+    updated_at REAL NOT NULL,
+    PRIMARY KEY (project, kind, series)
+);
 """
 
 
@@ -583,6 +613,7 @@ _INGEST_OPS = frozenset({
     "add_metric", "add_log", "add_logs", "add_span", "add_utilization",
     "add_anomaly", "upsert_progress", "ping_heartbeat", "set_report_offset",
     "upsert_process", "upsert_capture", "record_activity",
+    "add_metric_samples", "fold_metric_baseline",
 })
 _LIFECYCLE_OPS = frozenset({
     "create_run", "set_status", "update_run", "merge_run_meta",
@@ -986,6 +1017,7 @@ class RunRegistry:
                 ("progress", "run_id"),
                 ("anomalies", "run_id"),
                 ("utilization", "run_id"),
+                ("metric_samples", "run_id"),
                 ("commands", "run_id"),
                 ("captures", "run_id"),
                 ("alerts", "run_id"),
@@ -1347,6 +1379,187 @@ class RunRegistry:
             rec["buckets"] = json.loads(rec["buckets"]) if rec["buckets"] else {}
             rec["attrs"] = json.loads(rec["attrs"]) if rec["attrs"] else {}
             rec["final"] = bool(rec["final"])
+            out.append(rec)
+        return out
+
+    # -- metric history (TSDB write-behind) ------------------------------------
+    def add_metric_samples(self, rows: Sequence[Dict[str, Any]]) -> int:
+        """Batched ingest for the scrape phase's write-behind: one
+        executemany per flush, not one transaction per sample.  A
+        ``run="<id>"`` label on the series name is denormalized into the
+        ``run_id`` column so delete_run's cascade and the per-run history
+        API stay indexed."""
+        if not rows:
+            return 0
+        now = time.time()
+        params: List[Tuple[Any, ...]] = []
+        for row in rows:
+            name = row.get("name")
+            if not name:
+                continue
+            run_id: Optional[int] = row.get("run_id")
+            if run_id is None and 'run="' in name:
+                _base, labels = split_labeled_key(name)
+                raw = labels.get("run")
+                if raw is not None:
+                    try:
+                        run_id = int(raw)
+                    except ValueError:
+                        run_id = None
+            at = row.get("at")
+            params.append((
+                str(name),
+                run_id,
+                float(at) if at is not None else now,
+                float(row.get("value") or 0.0),
+                str(row.get("agg") or "raw"),
+                row.get("vmin"),
+                row.get("vmax"),
+                row.get("vsum"),
+                row.get("vcount"),
+                now,
+            ))
+        if not params:
+            return 0
+        with self._lock, self._conn() as conn:
+            conn.executemany(
+                """INSERT INTO metric_samples
+                   (name, run_id, at, value, agg, vmin, vmax, vsum, vcount,
+                    created_at)
+                   VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)""",
+                params,
+            )
+        return len(params)
+
+    def get_metric_samples(
+        self,
+        *,
+        name: Optional[str] = None,
+        run_id: Optional[int] = None,
+        agg: Optional[str] = "raw",
+        since: Optional[float] = None,
+        until: Optional[float] = None,
+        since_id: int = 0,
+        limit: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        """Persisted samples in id order (``since_id`` makes it a WS-tail
+        cursor).  ``name`` matches the full labeled key exactly, or every
+        label set of a base name when given without braces."""
+        sql = (
+            "SELECT id, name, run_id, at, value, agg, vmin, vmax, vsum,"
+            " vcount, created_at FROM metric_samples WHERE id > ?"
+        )
+        params: List[Any] = [since_id]
+        if name is not None:
+            if "{" in name:
+                sql += " AND name = ?"
+                params.append(name)
+            else:
+                sql += " AND (name = ? OR name LIKE ?)"
+                params.extend([name, name + "{%"])
+        if run_id is not None:
+            sql += " AND run_id = ?"
+            params.append(run_id)
+        if agg is not None:
+            sql += " AND agg = ?"
+            params.append(agg)
+        if since is not None:
+            sql += " AND at >= ?"
+            params.append(float(since))
+        if until is not None:
+            sql += " AND at <= ?"
+            params.append(float(until))
+        sql += " ORDER BY id"
+        if limit is not None:
+            sql += f" LIMIT {int(limit)}"
+        rows = self._conn().execute(sql, params).fetchall()
+        return [dict(r) for r in rows]
+
+    def fold_metric_baseline(
+        self,
+        project: str,
+        kind: str,
+        series: str,
+        value: float,
+        *,
+        alpha: float = 0.3,
+        run_id: Optional[int] = None,
+        now: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Fold one completed-run summary value into its (project, kind,
+        series) baseline row — exponentially weighted mean + variance, so
+        a drifting fleet tracks and a noisy series widens its own band.
+        Returns the *prior* mean/std/count alongside the new ones: the
+        regression comparator judges the run against the baseline as it
+        stood before this run was folded in.
+        """
+        now = now or time.time()
+        alpha = min(1.0, max(0.0, float(alpha)))
+        value = float(value)
+        with self._lock, self._conn() as conn:
+            conn.execute("BEGIN IMMEDIATE")
+            row = conn.execute(
+                "SELECT ewma, ewvar, count FROM metric_baselines"
+                " WHERE project = ? AND kind = ? AND series = ?",
+                (project, kind, series),
+            ).fetchone()
+            if row is None:
+                prior_mean = prior_var = None
+                prior_count = 0
+                mean, var, count = value, 0.0, 1
+            else:
+                prior_mean = float(row["ewma"])
+                prior_var = float(row["ewvar"])
+                prior_count = int(row["count"])
+                # West (1979) EW update: variance first (it uses the old
+                # mean), then the mean.
+                diff = value - prior_mean
+                var = (1.0 - alpha) * (prior_var + alpha * diff * diff)
+                mean = prior_mean + alpha * diff
+                count = prior_count + 1
+            conn.execute(
+                """INSERT INTO metric_baselines
+                   (project, kind, series, ewma, ewvar, count, last_value,
+                    last_run_id, updated_at)
+                   VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)
+                   ON CONFLICT (project, kind, series) DO UPDATE SET
+                     ewma = excluded.ewma, ewvar = excluded.ewvar,
+                     count = excluded.count, last_value = excluded.last_value,
+                     last_run_id = excluded.last_run_id,
+                     updated_at = excluded.updated_at""",
+                (project, kind, series, mean, var, count, value, run_id, now),
+            )
+        return {
+            "project": project,
+            "kind": kind,
+            "series": series,
+            "value": value,
+            "prior_mean": prior_mean,
+            "prior_std": math.sqrt(prior_var) if prior_var is not None else None,
+            "prior_count": prior_count,
+            "mean": mean,
+            "std": math.sqrt(var),
+            "count": count,
+        }
+
+    def get_metric_baselines(
+        self, project: str, kind: Optional[str] = None
+    ) -> List[Dict[str, Any]]:
+        sql = (
+            "SELECT project, kind, series, ewma, ewvar, count, last_value,"
+            " last_run_id, updated_at FROM metric_baselines WHERE project = ?"
+        )
+        params: List[Any] = [project]
+        if kind is not None:
+            sql += " AND kind = ?"
+            params.append(kind)
+        sql += " ORDER BY kind, series"
+        rows = self._conn().execute(sql, params).fetchall()
+        out = []
+        for r in rows:
+            rec = dict(r)
+            rec["std"] = math.sqrt(max(0.0, rec.pop("ewvar")))
+            rec["mean"] = rec.pop("ewma")
             out.append(rec)
         return out
 
@@ -2421,6 +2634,9 @@ class RunRegistry:
         ("captures", "captures", "created_at", True),
         ("alerts", "alerts", "updated_at", True),
         ("remediations", "remediations", "updated_at", True),
+        # Fleet/control-plane series carry no run_id, so the sweep is
+        # unscoped — age alone retires metric history.
+        ("metric_samples", "metric_samples", "created_at", False),
     )
 
     def clean_old_rows(

@@ -45,6 +45,7 @@ from polyaxon_tpu.db.registry import (
 from polyaxon_tpu.events import EventTypes
 from polyaxon_tpu.monitor.watcher import anomaly_status, goodput_status
 from polyaxon_tpu.stats.metrics import labeled_key
+from polyaxon_tpu.stats.tsdb import slo_status
 
 logger = logging.getLogger(__name__)
 
@@ -54,6 +55,7 @@ __all__ = [
     "RuleContext",
     "default_rules",
     "alert_gauge_key",
+    "run_slo_status",
 ]
 
 
@@ -83,11 +85,15 @@ class RuleContext:
         run: Run,
         *,
         stats: Any = None,
+        metrics: Any = None,
         now: Optional[float] = None,
     ) -> None:
         self.registry = registry
         self.run = run
         self.stats = stats
+        #: Metric history (``stats.tsdb.MetricStore``) — windowed rates
+        #: for the burn-rate rules; None on stores without a scrape phase.
+        self.metrics = metrics
         self.now = now if now is not None else time.time()
         self._anomaly: Optional[Dict[str, Any]] = None
         self._goodput: Optional[Dict[str, Any]] = None
@@ -177,6 +183,15 @@ class RuleContext:
         return family_float(
             "POLYAXON_TPU_ALERT_", f"{rule.upper()}_{name.upper()}", default
         )
+
+    def param_str(self, rule: str, name: str, default: str) -> str:
+        """String-valued rule parameter (series names, SLO labels) with
+        the same resolution order as :meth:`param`."""
+        val = self.overrides.get(f"{rule}.{name}")
+        if val is not None:
+            return str(val)
+        val = family_value("POLYAXON_TPU_ALERT_", f"{rule.upper()}_{name.upper()}")
+        return str(val) if val is not None else default
 
     def enabled(self, rule: str) -> bool:
         val = self.overrides.get(f"{rule}.enabled")
@@ -351,6 +366,88 @@ def _check_compile_cache_miss(ctx: RuleContext) -> Optional[Dict[str, Any]]:
     }
 
 
+def run_slo_status(ctx: RuleContext) -> Optional[Dict[str, Any]]:
+    """Resolved burn-rate SLO status for one run, or None when no error
+    budget is declared (``alert.slo_burn_rate.target``), the metric
+    store is absent, or the total series has no history yet.  Shared by
+    the ``slo_burn_rate`` rule and the run-detail API's ``slo`` block —
+    one implementation of the budget math, two consumers."""
+    target = ctx.param("slo_burn_rate", "target", 0.0)
+    if target <= 0 or ctx.metrics is None:
+        return None
+    name = ctx.param_str("slo_burn_rate", "name", "shed")
+    bad = ctx.param_str("slo_burn_rate", "bad_series", "router_sheds_total")
+    total = ctx.param_str(
+        "slo_burn_rate", "total_series", "router_requests_total"
+    )
+    status = slo_status(
+        ctx.metrics,
+        bad=bad,
+        total=total,
+        target=target,
+        fast_s=ctx.param("slo_burn_rate", "fast_window_s", 60.0),
+        slow_s=ctx.param("slo_burn_rate", "slow_window_s", 300.0),
+        now=ctx.now,
+    )
+    if status is None:
+        return None
+    status["name"] = name
+    status["bad_series"] = bad
+    status["total_series"] = total
+    status["burn_threshold"] = ctx.param(
+        "slo_burn_rate", "burn_threshold", 2.0
+    )
+    status["min_total"] = ctx.param("slo_burn_rate", "min_total", 10.0)
+    return status
+
+
+def _check_slo_burn_rate(ctx: RuleContext) -> Optional[Dict[str, Any]]:
+    status = run_slo_status(ctx)
+    if status is None:
+        return None  # off until an error budget is declared
+    # The windows double as the anti-flap mechanism (for_s stays 0): the
+    # fast window makes the alert responsive, the slow window keeps one
+    # spike from firing it — both must burn.
+    if ctx.stats is not None:
+        run_label = str(ctx.run.id)
+        ctx.stats.gauge(
+            labeled_key("slo_burn_fast", run=run_label, slo=status["name"]),
+            status["fast_burn"],
+        )
+        ctx.stats.gauge(
+            labeled_key("slo_burn_slow", run=run_label, slo=status["name"]),
+            status["slow_burn"],
+        )
+        ctx.stats.gauge(
+            labeled_key(
+                "slo_budget_remaining", run=run_label, slo=status["name"]
+            ),
+            status["budget_remaining"],
+        )
+    if status["total_slow"] < status["min_total"]:
+        return None  # not enough traffic to judge a budget
+    threshold = status["burn_threshold"]
+    if status["fast_burn"] <= threshold or status["slow_burn"] <= threshold:
+        return None
+    return {
+        "value": float(status["fast_burn"]),
+        "message": (
+            f"SLO '{status['name']}' burning {status['fast_burn']:.1f}x "
+            f"budget over {status['fast_window_s']:.0f}s and "
+            f"{status['slow_burn']:.1f}x over {status['slow_window_s']:.0f}s "
+            f"(target {status['target']:.3f}, "
+            f"{status['budget_remaining']*100:.0f}% budget left)"
+        ),
+        "slo": status["name"],
+        "target": status["target"],
+        "fast_burn": status["fast_burn"],
+        "slow_burn": status["slow_burn"],
+        "budget_remaining": status["budget_remaining"],
+        "bad_series": status["bad_series"],
+        "total_series": status["total_series"],
+    }
+
+
 def default_rules() -> List[AlertRule]:
     """The built-in catalog; ``for_s`` defaults are starting points — every
     value here is overridable per run (declarations) and per deployment
@@ -412,6 +509,14 @@ def default_rules() -> List[AlertRule]:
             _check_compile_cache_miss,
             "persistent compile cache mostly missing",
         ),
+        AlertRule(
+            "slo_burn_rate",
+            AlertSeverity.CRITICAL,
+            0.0,  # the fast+slow window pair IS the hold-down
+            _check_slo_burn_rate,
+            "error budget burning above threshold on both the fast and "
+            "slow windows",
+        ),
     ]
 
 
@@ -428,12 +533,14 @@ class AlertEngine:
         registry: RunRegistry,
         *,
         stats: Any = None,
+        metrics: Any = None,
         auditor: Any = None,
         rules: Optional[List[AlertRule]] = None,
         interval_s: Optional[float] = None,
     ) -> None:
         self.registry = registry
         self.stats = stats
+        self.metrics = metrics
         self.auditor = auditor
         self.rules = list(rules) if rules is not None else default_rules()
         self.interval_s = (
@@ -465,7 +572,9 @@ class AlertEngine:
         run = self.registry.get_run(run_id)
         if run is None:
             return []
-        ctx = RuleContext(self.registry, run, stats=self.stats, now=now)
+        ctx = RuleContext(
+            self.registry, run, stats=self.stats, metrics=self.metrics, now=now
+        )
         current = {
             row["rule"]: row for row in self.registry.get_alerts(run_id)
         }
@@ -621,6 +730,82 @@ class AlertEngine:
             self._gauge_raw(row["rule"], run_id, row["severity"], GAUGE_OK)
         self._last_eval.pop(run_id, None)
         return out
+
+    def evaluate_regression(
+        self,
+        run: Run,
+        folded: Dict[str, Dict[str, Any]],
+        *,
+        now: Optional[float] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Cross-run regression verdict for a *completed* run.
+
+        Called once from the run-terminal hook, after the run's summary
+        series were folded into their (project, kind) baselines —
+        ``folded`` is :func:`stats.tsdb.fold_run_baselines`'s result,
+        whose per-series entries carry the baseline as it stood *before*
+        this run.  A ``metric_regression`` alert row fires when any
+        series landed beyond k·σ below its baseline (these are all
+        higher-is-better throughput metrics).  The row stays FIRING —
+        terminal runs are never re-evaluated, so the verdict is durable:
+        exactly what the canary promote/rollback comparator reads.
+        """
+        now = now if now is not None else time.time()
+        ctx = RuleContext(
+            self.registry, run, stats=self.stats, metrics=self.metrics, now=now
+        )
+        if not ctx.enabled("metric_regression") or not folded:
+            return None
+        k = ctx.param("metric_regression", "k", 3.0)
+        min_runs = ctx.param("metric_regression", "min_runs", 3.0)
+        # σ floor as a fraction of the mean: identical early runs would
+        # otherwise make any deviation register as infinitely improbable.
+        std_floor_frac = ctx.param("metric_regression", "min_std_frac", 0.05)
+        regressions: List[Dict[str, Any]] = []
+        for series, fold in folded.items():
+            prior_mean = fold.get("prior_mean")
+            if prior_mean is None or fold.get("prior_count", 0) < min_runs:
+                continue
+            std = max(
+                fold.get("prior_std") or 0.0,
+                abs(prior_mean) * std_floor_frac,
+                1e-12,
+            )
+            z = (fold["value"] - prior_mean) / std
+            if z < -k:
+                regressions.append({
+                    "series": series,
+                    "value": fold["value"],
+                    "baseline_mean": prior_mean,
+                    "baseline_std": fold.get("prior_std"),
+                    "baseline_runs": fold.get("prior_count"),
+                    "z": round(z, 3),
+                })
+        if not regressions:
+            return None
+        worst = min(regressions, key=lambda r: r["z"])
+        row = self.registry.upsert_alert(
+            run.id,
+            "metric_regression",
+            state=AlertState.FIRING,
+            severity=AlertSeverity.WARNING,
+            message=(
+                f"{worst['series']} {worst['value']:.4g} is "
+                f"{abs(worst['z']):.1f}σ below its "
+                f"({run.project or 'default'}, {run.kind}) baseline "
+                f"{worst['baseline_mean']:.4g} "
+                f"(k={k:.1f}, {len(regressions)} series regressed)"
+            ),
+            value=float(worst["z"]),
+            for_s=0.0,
+            episodes=1,
+            fired_at=now,
+            resolved_at=None,
+            attrs={"regressions": regressions, "k": k},
+            now=now,
+        )
+        self._notify(EventTypes.ALERT_FIRING, run, row)
+        return row
 
     # -- fan-out ---------------------------------------------------------------
     def _gauge(self, rule: AlertRule, run_id: int, value: float) -> None:

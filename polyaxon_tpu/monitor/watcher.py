@@ -236,6 +236,7 @@ class GangWatcher:
         registry: RunRegistry,
         stats: Any = None,
         *,
+        metrics: Any = None,
         max_poll_bytes: Optional[int] = None,
         stall_after_s: Optional[float] = None,
         straggler_lag_steps: Optional[float] = None,
@@ -243,6 +244,9 @@ class GangWatcher:
     ) -> None:
         self.registry = registry
         self.stats = stats
+        # Optional MetricStore: per-run history series (run_mfu{run=...} etc.)
+        # feeding the query API and the cross-run regression baselines.
+        self.metrics = metrics
         self.max_poll_bytes = (
             max_poll_bytes
             if max_poll_bytes is not None
@@ -590,7 +594,7 @@ class GangWatcher:
 
         No-op until the first ledger row lands — the gauges should show
         the last real measurement, never a synthetic zero."""
-        if self.stats is None:
+        if self.stats is None and self.metrics is None:
             return
         try:
             status = goodput_status(self.registry, handle.run_id)
@@ -601,10 +605,25 @@ class GangWatcher:
             return
         if not status["rows"]:
             return
-        self.stats.gauge("run_goodput_ratio", float(status["goodput_ratio"]))
-        self.stats.gauge("run_mfu", float(status["mfu"]))
-        self.stats.gauge("run_compile_s_total", float(status["compile_s"]))
-        self.stats.gauge("run_hbm_peak_bytes", float(status["hbm_peak_bytes"]))
+        if self.stats is not None:
+            self.stats.gauge("run_goodput_ratio", float(status["goodput_ratio"]))
+            self.stats.gauge("run_mfu", float(status["mfu"]))
+            self.stats.gauge("run_compile_s_total", float(status["compile_s"]))
+            self.stats.gauge("run_hbm_peak_bytes", float(status["hbm_peak_bytes"]))
+        if self.metrics is not None:
+            # Run-labeled history series: these are what the query API serves
+            # per run and what fold_run_baselines summarises at completion.
+            at = time.time()
+            run = handle.run_id
+            for series, field in (
+                ("run_mfu", "mfu"),
+                ("run_goodput_ratio", "goodput_ratio"),
+                ("run_tokens_per_device_s", "tokens_per_device_s"),
+                ("run_spec_accept_rate", "spec_accept_rate"),
+            ):
+                self.metrics.record(
+                    labeled_key(series, run=run), float(status[field]), at
+                )
 
     def _refresh_command_gauges(self, handle: GangHandle) -> None:
         """``profile_capture_active``: profile commands still in flight

@@ -196,15 +196,55 @@ class Orchestrator:
         self.spawner = spawner_from_conf(
             self.layout, conf, heartbeat_interval=heartbeat_interval
         )
+        # Metric history: an in-process TSDB of ring-buffer series with
+        # staged rollups, persisted through the registry's metric_samples
+        # table.  The scraper runs as its own monitor-tick phase; disable
+        # via POLYAXON_TPU_TSDB_ENABLED for minimal-footprint deployments.
+        from polyaxon_tpu.conf.knobs import knob_bool, knob_float, knob_int
+        from polyaxon_tpu.stats.tsdb import MetricScraper, MetricStore
+
+        self.metrics: Optional[MetricStore] = None
+        self.scraper: Optional[MetricScraper] = None
+        if knob_bool("POLYAXON_TPU_TSDB_ENABLED"):
+            self.metrics = MetricStore(
+                raw_points=knob_int("POLYAXON_TPU_TSDB_RAW_POINTS"),
+                rollup_points=knob_int("POLYAXON_TPU_TSDB_ROLLUP_POINTS"),
+                max_series=knob_int("POLYAXON_TPU_TSDB_MAX_SERIES"),
+                pending_max=knob_int("POLYAXON_TPU_TSDB_PENDING_MAX"),
+            )
+            self.scraper = MetricScraper(
+                self.metrics,
+                stats=self.stats,
+                registry=self.registry,
+                fleets=lambda: self.fleets,
+                interval_s=knob_float("POLYAXON_TPU_TSDB_SCRAPE_INTERVAL_S"),
+                flush_rows=knob_int("POLYAXON_TPU_TSDB_FLUSH_ROWS"),
+            )
+            # Warm restart: replay the last hour of persisted raw samples
+            # so rate()/burn windows don't start cold after a reboot.
+            try:
+                self.metrics.hydrate(
+                    self.registry.get_metric_samples(
+                        agg="raw", since=time.time() - 3600.0
+                    )
+                )
+            except Exception:
+                logger.warning("Metric history hydrate failed", exc_info=True)
         # The stats backend lets the watcher's stall/straggler detector
-        # export its alarm gauges on /metrics.
-        self.watcher = GangWatcher(self.registry, stats=self.stats)
+        # export its alarm gauges on /metrics; the metric store collects
+        # the per-run history series behind the query API.
+        self.watcher = GangWatcher(
+            self.registry, stats=self.stats, metrics=self.metrics
+        )
         # The alert engine ticks in the same monitor task as the watcher,
         # turning the signal tables into a pending→firing→resolved feed.
         from polyaxon_tpu.monitor import AlertEngine
 
         self.alerts = AlertEngine(
-            self.registry, stats=self.stats, auditor=self.auditor
+            self.registry,
+            stats=self.stats,
+            auditor=self.auditor,
+            metrics=self.metrics,
         )
         # The remediation engine closes the detection→action loop: alert
         # firing edges trigger checkpoint-now/eviction through the command
@@ -243,6 +283,7 @@ class Orchestrator:
             monitor_failure_streak=conf.get("scheduler.monitor_failure_streak"),
             queued_redispatch_ttl=conf.get("scheduler.queued_redispatch_ttl"),
             artifact_store=self.artifact_store,
+            scraper=self.scraper,
         )
         register_scheduler_tasks(self.ctx)
         from polyaxon_tpu.hpsearch import HPContext, register_hp_tasks

@@ -65,6 +65,9 @@ class SchedulerContext:
     queued_redispatch_ttl: float = 60.0
     #: Durable artifact store (None = off-box sync disabled).
     artifact_store: Optional[object] = None
+    #: Metric-history scraper (``stats.tsdb.MetricScraper``), ticked by
+    #: the monitor task as its own phase; None = metric history off.
+    scraper: Optional[object] = None
 
 
 def _record_done(
@@ -102,6 +105,27 @@ def _record_done(
     if run.service_url:
         # A terminal service must stop advertising its (now dead) URL.
         ctx.registry.update_run(run_id, service_url=None)
+    if status == S.SUCCEEDED:
+        # Fold the run's summary series (MFU, goodput, tokens/s, spec
+        # acceptance) into its (project, kind) regression baseline, then
+        # judge the run against the baseline as it stood *before* the
+        # fold — the metric_regression verdict the canary
+        # promote/rollback comparator reads.
+        try:
+            from polyaxon_tpu.conf.knobs import knob_float
+            from polyaxon_tpu.stats.tsdb import fold_run_baselines
+
+            folded = fold_run_baselines(
+                ctx.registry,
+                run,
+                alpha=knob_float("POLYAXON_TPU_BASELINE_ALPHA"),
+            )
+            if folded and ctx.alerts is not None:
+                ctx.alerts.evaluate_regression(run, folded)
+        except Exception:
+            logger.warning(
+                "Baseline fold failed for run %s", run_id, exc_info=True
+            )
     by_status = {
         S.SUCCEEDED: EventTypes.EXPERIMENT_SUCCEEDED,
         S.FAILED: EventTypes.EXPERIMENT_FAILED,
@@ -131,7 +155,7 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
     stats = getattr(ctx.watcher, "stats", None)
     phase_keys = {
         phase: labeled_key("tick_phase_s", phase=phase)
-        for phase in ("watcher", "alerts", "remediation", "retention")
+        for phase in ("watcher", "alerts", "remediation", "retention", "scrape")
     }
 
     def _observe_phase(phase: str, seconds: float) -> None:
@@ -329,6 +353,18 @@ def register_scheduler_tasks(ctx: SchedulerContext) -> None:
             expected = ctx.monitor_interval * ctx.bus.time_scale
             stats.gauge("monitor_tick_lag_s", max(0.0, (now - last) - expected))
         handle.last_monitor_at = now
+        if ctx.scraper is not None:
+            # Metric-history scrape: runs every tick but internally
+            # throttled to its own cadence, so a not-due pass costs
+            # microseconds and per-run tick fan-out doesn't multiply the
+            # cost.  Never poll-fatal.
+            phase_t0 = time.perf_counter()
+            try:
+                ctx.scraper.tick(time.time())
+            except Exception:
+                logger.warning("Metric scrape failed", exc_info=True)
+            finally:
+                _observe_phase("scrape", time.perf_counter() - phase_t0)
         phase_t0 = time.perf_counter()
         try:
             rollup = ctx.watcher.observe(handle)

@@ -48,11 +48,11 @@ every other control-plane reflex.
 from __future__ import annotations
 
 import time
-from collections import deque
-from typing import Any, Deque, Dict, Optional
+from typing import Any, Dict, Optional
 
 from polyaxon_tpu.conf.knobs import knob_bool, knob_float, knob_int
 from polyaxon_tpu.db.registry import RemediationStatus
+from polyaxon_tpu.stats.tsdb import RatioWindow
 
 __all__ = ["FleetAutoscaler"]
 
@@ -157,10 +157,11 @@ class FleetAutoscaler:
             )
         )
         self.fleet_name = str(getattr(fleet, "name", "local"))
-        #: ``(t, requests, sheds)`` counter snapshots — rates are taken
-        #: over a short smoothing window, not a single tick (sparse
-        #: traffic would otherwise zero the rate on every empty tick).
-        self._samples: Deque[tuple] = deque()
+        #: Windowed sheds/requests counter pair — rates are taken over a
+        #: short smoothing window, not a single tick (sparse traffic
+        #: would otherwise zero the rate on every empty tick).  Shared
+        #: code path with the SLO burn windows (stats.tsdb).
+        self._shed_window = RatioWindow(self.up_hold_s / 2.0)
         self._window_req = 0
         #: When the current overload / idle episode started (None = the
         #: signal is not holding).
@@ -271,18 +272,14 @@ class FleetAutoscaler:
         counters = self.router.counters
         requests = int(counters.get("requests", 0))
         sheds = int(counters.get("sheds", 0))
-        first = not self._samples
-        self._samples.append((now, requests, sheds))
         window_s = self.up_hold_s / 2.0
-        while len(self._samples) > 1 and self._samples[1][0] <= now - window_s:
-            self._samples.popleft()
-        if first:
+        self._shed_window.observe(sheds, requests, now)
+        deltas = self._shed_window.deltas(window_s, now)
+        if deltas is None:
             # First tick: no interval to rate over.
             return
-        _, req0, shed0 = self._samples[0]
-        d_req = requests - req0
-        d_shed = sheds - shed0
-        self._window_req = d_req
+        d_shed, d_req = deltas
+        self._window_req = int(d_req)
         self.last_shed_rate = (d_shed / d_req) if d_req > 0 else 0.0
 
         with self.router._lock:

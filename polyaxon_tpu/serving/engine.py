@@ -64,6 +64,7 @@ from polyaxon_tpu.serving.paging import (
     truncate_table,
 )
 from polyaxon_tpu.stats import MemoryStats
+from polyaxon_tpu.stats.tsdb import RatioWindow
 from polyaxon_tpu.tracking.flightrec import get_progress
 from polyaxon_tpu.tracking.trace import TraceContext, get_tracer
 
@@ -663,6 +664,13 @@ class ServingEngine:
         self._backlog_chunks = 0
         self._prefill_jobs = 0
         self._window: "deque[tuple]" = deque()  # (t, n_tokens)
+        # Windowed variants of the lifetime cumulative ratios exposed by
+        # /v1/stats: dashboards and the router's affinity slack should
+        # see current behavior, not boot-averaged history.  Horizon 2× so
+        # the baseline sample at-or-before the window start survives.
+        self._stats_window_s = knob_float("POLYAXON_TPU_SERVING_STATS_WINDOW_S")
+        self._pc_window = RatioWindow(self._stats_window_s * 2.0)
+        self._spec_window = RatioWindow(self._stats_window_s * 2.0)
         # Request-scoped distributed tracing: master switch plus the
         # slow-request exemplar ring (`/v1/stats` + the serving_ttft_p99
         # alert's attached artifact).
@@ -1307,6 +1315,17 @@ class ServingEngine:
             restored = self._n_restored_blocks
             preloaded = self._kv_preloaded_blocks
             persisted = self._kv_persisted_blocks
+            now = time.time()
+            pc_rate_window = 0.0
+            if pc is not None:
+                self._pc_window.observe(pc.hits, pc.hits + pc.misses, now)
+                windowed = self._pc_window.ratio(self._stats_window_s, now)
+                # Window not yet established (one sample): fall back to
+                # the lifetime ratio instead of reporting a false zero.
+                pc_rate_window = (
+                    round(windowed, 6) if windowed is not None
+                    else round(pc.hit_rate, 6)
+                )
         return {
             "block_size": self.block_size,
             "kv_dtype": self.kv_dtype,
@@ -1320,6 +1339,7 @@ class ServingEngine:
             "prefix_cache_hit_rate": (
                 round(pc.hit_rate, 6) if pc is not None else 0.0
             ),
+            "prefix_cache_hit_rate_window": pc_rate_window,
             "prefix_cache_hits": pc.hits if pc is not None else 0,
             "prefix_cache_misses": pc.misses if pc is not None else 0,
             "prefix_cache_evictions": pc.evictions if pc is not None else 0,
@@ -1351,6 +1371,10 @@ class ServingEngine:
             accepted = self._spec_accepted
             fallbacks = self._spec_fallbacks
             steps = self._spec_steps
+            now = time.time()
+            self._spec_window.observe(accepted, proposed, now)
+            windowed = self._spec_window.ratio(self._stats_window_s, now)
+        lifetime_rate = round(accepted / proposed, 6) if proposed else 0.0
         return {
             "spec_decode": self.spec_decode,
             "spec_k": self.spec_k,
@@ -1358,8 +1382,9 @@ class ServingEngine:
             "spec_proposed_total": proposed,
             "spec_accepted_total": accepted,
             "spec_fallback_total": fallbacks,
-            "spec_accept_rate": (
-                round(accepted / proposed, 6) if proposed else 0.0
+            "spec_accept_rate": lifetime_rate,
+            "spec_accept_rate_window": (
+                round(windowed, 6) if windowed is not None else lifetime_rate
             ),
         }
 

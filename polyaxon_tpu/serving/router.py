@@ -124,6 +124,9 @@ class Replica:
         self.drain_started: Optional[float] = None
         self.last_probe_at = 0.0
         self.last_error: Optional[str] = None
+        #: Full ``/v1/stats`` body from the last successful probe — the
+        #: scrape phase reads history off it instead of re-connecting.
+        self.last_stats: Dict[str, Any] = {}
 
     def load(self) -> float:
         """Occupancy estimate in [0, inf): probed engine load plus the
@@ -369,6 +372,7 @@ class FleetRouter:
             rep.prefix_hit_rate = float(
                 stats.get("prefix_cache_hit_rate") or 0.0
             )
+            rep.last_stats = dict(stats)
             rep.last_error = None
         engine_state = str(health.get("state") or "ready")
         if rep.state == "ejected":
@@ -766,6 +770,17 @@ class FleetRouter:
             ),
             "shed_occupancy": self.shed_occupancy,
         }
+
+    def replica_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Each replica's full ``/v1/stats`` body from its last
+        successful probe — the scrape phase's per-replica series source
+        (no new connections; a never-probed replica is absent)."""
+        with self._lock:
+            return {
+                name: dict(r.last_stats)
+                for name, r in self._replicas.items()
+                if r.last_stats
+            }
 
     def merged_trace(self, trace_id: str) -> Optional[Dict[str, Any]]:
         """All spans of one trace, fleet-wide, as a Perfetto-loadable dict.

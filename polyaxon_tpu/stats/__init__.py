@@ -8,6 +8,16 @@ from polyaxon_tpu.stats.metrics import (
     render_prometheus,
     render_standard_gauges,
 )
+from polyaxon_tpu.stats.tsdb import (
+    CounterWindow,
+    HistogramWindow,
+    MetricScraper,
+    MetricStore,
+    RatioWindow,
+    WindowedView,
+    fold_run_baselines,
+    slo_status,
+)
 
 __all__ = [
     "MemoryStats",
@@ -20,6 +30,14 @@ __all__ = [
     "render_standard_gauges",
     "PROMETHEUS_CONTENT_TYPE",
     "get_stats",
+    "MetricStore",
+    "MetricScraper",
+    "CounterWindow",
+    "RatioWindow",
+    "HistogramWindow",
+    "WindowedView",
+    "slo_status",
+    "fold_run_baselines",
 ]
 
 _default_stats = None

@@ -111,9 +111,25 @@ class Histogram:
         }
 
     def reset(self) -> None:
-        """Zero all buckets in place (bucket layout kept) — the primitive
-        behind rolling-window percentiles: snapshot ``summary()``, reset,
-        accumulate the next window."""
+        """Deprecated: zero all buckets in place (bucket layout kept).
+
+        Resetting a live histogram breaks cumulative-counter semantics
+        for any external scraper that samples it mid-window — count and
+        sum go *backwards*, which Prometheus-style rate math reads as a
+        process restart.  Keep histograms cumulative and compute rolling
+        windows from snapshot deltas instead
+        (``polyaxon_tpu.stats.tsdb.HistogramWindow`` / ``WindowedView``).
+        """
+        import warnings
+
+        warnings.warn(
+            "Histogram.reset() is deprecated: it breaks cumulative-counter "
+            "semantics for concurrent scrapers; use "
+            "polyaxon_tpu.stats.tsdb.HistogramWindow snapshot deltas for "
+            "rolling windows",
+            DeprecationWarning,
+            stacklevel=2,
+        )
         self.counts = [0] * (len(self.edges) + 1)
         self.count = 0
         self.sum = 0.0

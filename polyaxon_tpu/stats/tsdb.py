@@ -1,0 +1,968 @@
+"""In-process metric history: ring-buffer TSDB + windowed deltas.
+
+``/metrics`` and ``MemoryStats.snapshot()`` are point-in-time — cumulative
+since boot, gone on restart.  This module adds the missing axis:
+
+* :class:`MetricStore` — fixed-step ring series with staged downsampling
+  (raw → 10s → 1m rollups carrying min/max/sum/count, so percent-style
+  gauges and counter rates both survive compaction), a bounded pending
+  queue for registry write-behind, and Prometheus-shaped queries
+  (``increase``/``rate`` with counter-reset clamping, aligned
+  aggregation over a time range).
+* :class:`CounterWindow` / :class:`RatioWindow` /
+  :class:`HistogramWindow` / :class:`WindowedView` — the one shared
+  implementation of "rate over the last W seconds" over cumulative
+  counters and histogram bucket snapshots.  Replaces ad-hoc deques and
+  ``Histogram.reset()`` call sites (resetting breaks cumulative-counter
+  semantics for any external scraper mid-window).
+* :class:`MetricScraper` — the monitor tick's scrape phase: samples the
+  control-plane stats backend and every live fleet replica's last probe
+  stats (riding the router's probe results — no new connections) into
+  labeled series, and flushes sealed samples to the registry in batches.
+* :func:`slo_status` / :func:`fold_run_baselines` — multi-window
+  burn-rate math for the ``slo_burn_rate`` alert and the cross-run
+  EWMA baselines behind ``metric_regression``.
+
+Everything here is control-plane-thread friendly: the store takes one
+lock per call and never blocks on I/O (persistence happens in the
+scraper's flush step, against the registry's own batched ingest op).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import (
+    Any,
+    Callable,
+    Deque,
+    Dict,
+    Iterable,
+    List,
+    Mapping,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+from polyaxon_tpu.stats.metrics import (
+    fold_labeled_key,
+    labeled_key,
+    split_labeled_key,
+)
+
+__all__ = [
+    "MetricStore",
+    "CounterWindow",
+    "RatioWindow",
+    "HistogramWindow",
+    "WindowedView",
+    "MetricScraper",
+    "slo_status",
+    "fold_run_baselines",
+    "ROLLUP_STEPS",
+]
+
+#: Downsampling stages, coarsest last.  Queries with ``step >= stage``
+#: read the matching rollup ring instead of raw points.
+ROLLUP_STEPS: Tuple[float, ...] = (10.0, 60.0)
+
+#: Registry ``agg`` column value per stage (raw rows use ``"raw"``).
+_STEP_AGG = {10.0: "10s", 60.0: "1m"}
+
+
+def _suffixed(key: str, suffix: str) -> str:
+    """``registry_op_s{op="write"}`` + ``_count`` →
+    ``registry_op_s_count{op="write"}`` — suffix the base name, keep the
+    label set."""
+    i = key.find("{")
+    if i < 0:
+        return key + suffix
+    return key[:i] + suffix + key[i:]
+
+
+class _Bucket:
+    """One rollup slot: the aggregates a raw window compacts into."""
+
+    __slots__ = ("start", "vmin", "vmax", "vsum", "vcount", "last")
+
+    def __init__(self, start: float, value: float) -> None:
+        self.start = start
+        self.vmin = value
+        self.vmax = value
+        self.vsum = value
+        self.vcount = 1
+        self.last = value
+
+    def merge(self, value: float) -> None:
+        if value < self.vmin:
+            self.vmin = value
+        if value > self.vmax:
+            self.vmax = value
+        self.vsum += value
+        self.vcount += 1
+        self.last = value
+
+    def row(self, key: str, agg: str) -> Dict[str, Any]:
+        return {
+            "name": key,
+            "at": self.start,
+            "value": self.last,
+            "agg": agg,
+            "vmin": self.vmin,
+            "vmax": self.vmax,
+            "vsum": self.vsum,
+            "vcount": self.vcount,
+        }
+
+
+class _Series:
+    """One (name, labels) series: a raw ring plus one rollup ring per
+    stage.  Not locked — :class:`MetricStore` serializes access."""
+
+    __slots__ = ("key", "raw", "rollups", "sealed")
+
+    def __init__(self, key: str, raw_points: int, rollup_points: int) -> None:
+        self.key = key
+        self.raw: Deque[Tuple[float, float]] = deque(maxlen=raw_points)
+        self.rollups: Dict[float, Deque[_Bucket]] = {
+            step: deque(maxlen=rollup_points) for step in ROLLUP_STEPS
+        }
+        #: Rollup buckets closed since the last drain — (agg, bucket)
+        #: pairs handed to the registry write-behind.
+        self.sealed: List[Tuple[str, _Bucket]] = []
+
+    def record(self, at: float, value: float) -> None:
+        if not self.raw or at >= self.raw[-1][0]:
+            self.raw.append((at, value))
+        for step, ring in self.rollups.items():
+            start = (at // step) * step
+            if ring and ring[-1].start == start:
+                ring[-1].merge(value)
+                continue
+            if ring and start < ring[-1].start:
+                # Late sample: merge into the matching earlier bucket if
+                # it is still in the ring, otherwise drop it — rollups
+                # are append-mostly and a sealed bucket may already have
+                # been flushed.
+                for b in reversed(ring):
+                    if b.start == start:
+                        b.merge(value)
+                        break
+                continue
+            if ring:
+                self.sealed.append((_STEP_AGG[step], ring[-1]))
+            ring.append(_Bucket(start, value))
+
+    def points(self, step: Optional[float]) -> List[Tuple[float, float, _Bucket]]:
+        """(at, value, bucket-or-None) triples from the best stage for
+        ``step`` — coarsest rollup whose step fits, else raw."""
+        stage = None
+        if step:
+            for s in sorted(ROLLUP_STEPS, reverse=True):
+                if step >= s:
+                    stage = s
+                    break
+        if stage is None:
+            return [(at, v, None) for at, v in self.raw]
+        return [(b.start, b.last, b) for b in self.rollups[stage]]
+
+
+def _increase(points: Sequence[Tuple[float, float]], since: float) -> Optional[float]:
+    """Counter increase over ``[since, now]`` with reset clamping.
+
+    Baseline = newest sample at-or-before ``since`` (else the oldest in
+    the ring); the increase is the sum of positive deltas between
+    consecutive samples from the baseline on.  A decrease means the
+    counter restarted (replica restart) — the post-reset value counts
+    from ~0, so it is *added*, never subtracted.  Needs ≥ 2 samples.
+    """
+    if len(points) < 2:
+        return None
+    start = 0
+    for i, (at, _v) in enumerate(points):
+        if at <= since:
+            start = i
+        else:
+            break
+    total = 0.0
+    prev = points[start][1]
+    for at, v in points[start + 1:]:
+        if v >= prev:
+            total += v - prev
+        else:
+            total += v
+        prev = v
+    return total
+
+
+class MetricStore:
+    """Bounded in-memory TSDB with staged rollups and write-behind.
+
+    Series are keyed by Prometheus-style labeled keys
+    (``replica_queue_depth{fleet="f",replica="f-r0"}``); the per-base-
+    name cardinality cap folds overflow series through
+    :func:`fold_labeled_key`, same as ``MemoryStats``.
+    """
+
+    def __init__(
+        self,
+        *,
+        raw_points: int = 720,
+        rollup_points: int = 360,
+        max_series: int = 2048,
+        pending_max: int = 8192,
+    ) -> None:
+        self._lock = threading.Lock()
+        self.raw_points = max(2, int(raw_points))
+        self.rollup_points = max(2, int(rollup_points))
+        self.max_series = max(1, int(max_series))
+        self.pending_max = max(0, int(pending_max))
+        self._series: Dict[str, _Series] = {}
+        self._by_base: Dict[str, List[str]] = {}
+        self._pending: Deque[Dict[str, Any]] = deque()
+        self.folded = 0
+        self.dropped = 0
+        self._hydrating = False
+
+    # -- write path ------------------------------------------------------
+
+    def _admit(self, key: str) -> str:
+        if key in self._series:
+            return key  # hot path: known series skip the label parse
+        base, labels = split_labeled_key(key)
+        keys = self._by_base.setdefault(base, [])
+        if labels and len(keys) >= self.max_series:
+            self.folded += 1
+            folded = fold_labeled_key(key)
+            if folded not in self._series and len(keys) >= self.max_series + 1:
+                return keys[0]  # pathological: even the fold won't fit
+            key = folded
+            if key in self._series:
+                return key
+        self._series[key] = _Series(key, self.raw_points, self.rollup_points)
+        keys.append(key)
+        return key
+
+    def record(self, key: str, value: float, at: float) -> None:
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            admitted = self._admit(key)
+            self._series[admitted].record(float(at), v)
+            if not self._hydrating:
+                self._pending_raw(admitted, float(at), v)
+
+    #: Raw rows waiting for the registry flush; bounded — overflow drops
+    #: the oldest (history in memory is unaffected, only durability).
+    def _pending_raw(self, key: str, at: float, value: float) -> None:
+        q = self._pending
+        q.append({"name": key, "at": at, "value": value, "agg": "raw"})
+        while len(q) > self.pending_max:
+            q.popleft()
+            self.dropped += 1
+
+    def record_snapshot(self, snapshot: Mapping[str, Any], at: float) -> None:
+        """Ingest a full ``MemoryStats.snapshot()``: counters and gauges
+        verbatim, histograms as ``<name>_count`` / ``<name>_sum`` series
+        (enough to reconstruct rates and means over any window)."""
+        for key, value in snapshot.get("counters", {}).items():
+            self.record(key, value, at)
+        for key, value in snapshot.get("gauges", {}).items():
+            self.record(key, value, at)
+        for key, state in snapshot.get("histograms", {}).items():
+            self.record(_suffixed(key, "_count"), state.get("count", 0), at)
+            self.record(_suffixed(key, "_sum"), state.get("sum", 0.0), at)
+
+    def hydrate(self, rows: Iterable[Mapping[str, Any]]) -> int:
+        """Replay persisted raw rows (oldest first) without re-queueing
+        them for persistence — warm-restart path."""
+        n = 0
+        with self._lock:
+            self._hydrating = True
+        try:
+            for row in rows:
+                if row.get("agg", "raw") != "raw":
+                    continue
+                name = row.get("name")
+                if not name:
+                    continue
+                self.record(name, row.get("value", 0.0), float(row.get("at", 0.0)))
+                n += 1
+        finally:
+            with self._lock:
+                self._hydrating = False
+        return n
+
+    def drain_pending(self, max_rows: int = 512) -> List[Dict[str, Any]]:
+        """Pop up to ``max_rows`` rows for the registry write-behind:
+        queued raw samples first, then rollup buckets sealed since the
+        last drain."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            q = self._pending
+            while q and len(out) < max_rows:
+                out.append(q.popleft())
+            if len(out) < max_rows:
+                for series in self._series.values():
+                    while series.sealed and len(out) < max_rows:
+                        agg, bucket = series.sealed.pop(0)
+                        out.append(bucket.row(series.key, agg))
+                    if len(out) >= max_rows:
+                        break
+        return out
+
+    # -- read path -------------------------------------------------------
+
+    def series_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._by_base)
+
+    def series_keys(self, base: Optional[str] = None) -> List[str]:
+        """Full labeled keys, optionally restricted to one base name."""
+        with self._lock:
+            if base is None:
+                return sorted(self._series)
+            return list(self._by_base.get(base, ()))
+
+    def has_series(self, name: str) -> bool:
+        base, _labels = split_labeled_key(name)
+        with self._lock:
+            return base in self._by_base
+
+    def _matching(
+        self, name: str, matchers: Optional[Mapping[str, str]]
+    ) -> List[_Series]:
+        base, inline = split_labeled_key(name)
+        want = dict(inline)
+        if matchers:
+            want.update({k: str(v) for k, v in matchers.items()})
+        out: List[_Series] = []
+        for key in self._by_base.get(base, ()):
+            _b, labels = split_labeled_key(key)
+            if all(labels.get(k) == v for k, v in want.items()):
+                out.append(self._series[key])
+        return out
+
+    def query(
+        self,
+        name: str,
+        *,
+        matchers: Optional[Mapping[str, str]] = None,
+        since: Optional[float] = None,
+        until: Optional[float] = None,
+        step: Optional[float] = None,
+        agg: str = "avg",
+        limit: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        """Aligned aggregation over every series matching ``name`` +
+        ``matchers``.  Points are bucketed to ``floor(at/step)*step``
+        (raw cadence when ``step`` is falsy) and reduced per bucket with
+        ``agg`` ∈ {avg, min, max, sum, count, last} — rollup stages keep
+        min/max/sum/count, so compacted history answers the same
+        aggregates raw data would.
+        """
+        if agg not in ("avg", "min", "max", "sum", "count", "last"):
+            raise ValueError(f"unknown agg {agg!r}")
+        with self._lock:
+            buckets: Dict[float, List[Tuple[float, float, Optional[_Bucket]]]] = {}
+            for series in self._matching(name, matchers):
+                for at, value, bucket in series.points(step):
+                    if since is not None and at < since:
+                        continue
+                    if until is not None and at > until:
+                        continue
+                    t = (at // step) * step if step else at
+                    buckets.setdefault(t, []).append((at, value, bucket))
+        out: List[Dict[str, Any]] = []
+        for t in sorted(buckets):
+            pts = buckets[t]
+            vmin = min(p[2].vmin if p[2] else p[1] for p in pts)
+            vmax = max(p[2].vmax if p[2] else p[1] for p in pts)
+            vsum = sum(p[2].vsum if p[2] else p[1] for p in pts)
+            vcount = sum(p[2].vcount if p[2] else 1 for p in pts)
+            if agg == "avg":
+                value = vsum / vcount if vcount else 0.0
+            elif agg == "min":
+                value = vmin
+            elif agg == "max":
+                value = vmax
+            elif agg == "sum":
+                value = vsum
+            elif agg == "count":
+                value = float(vcount)
+            else:  # last
+                value = max(pts, key=lambda p: p[0])[1]
+            out.append(
+                {"at": t, "value": value, "min": vmin, "max": vmax, "count": vcount}
+            )
+        if limit is not None and len(out) > limit:
+            out = out[-limit:]
+        return out
+
+    def increase(
+        self,
+        name: str,
+        window_s: float,
+        now: float,
+        *,
+        matchers: Optional[Mapping[str, str]] = None,
+    ) -> Optional[float]:
+        """Counter increase over the trailing window, summed across all
+        label sets of the base name, counter resets clamped.  ``None``
+        when no matching series has enough history — callers treat that
+        as "signal absent", not zero."""
+        since = now - float(window_s)
+        total: Optional[float] = None
+        with self._lock:
+            for series in self._matching(name, matchers):
+                inc = _increase(list(series.raw), since)
+                if inc is not None:
+                    total = inc if total is None else total + inc
+        return total
+
+    def rate(
+        self,
+        name: str,
+        window_s: float,
+        now: float,
+        *,
+        matchers: Optional[Mapping[str, str]] = None,
+    ) -> Optional[float]:
+        inc = self.increase(name, window_s, now, matchers=matchers)
+        if inc is None or window_s <= 0:
+            return None
+        return inc / float(window_s)
+
+    def latest(
+        self, name: str, *, matchers: Optional[Mapping[str, str]] = None
+    ) -> Optional[float]:
+        with self._lock:
+            best: Optional[Tuple[float, float]] = None
+            for series in self._matching(name, matchers):
+                if series.raw and (best is None or series.raw[-1][0] > best[0]):
+                    best = series.raw[-1]
+        return best[1] if best else None
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "series": len(self._series),
+                "names": len(self._by_base),
+                "folded": self.folded,
+                "dropped": self.dropped,
+                "pending": len(self._pending),
+            }
+
+
+# -- windowed deltas over cumulative counters/histograms ----------------------
+
+
+class CounterWindow:
+    """Trailing window over one cumulative counter: a ring of
+    ``(at, value)`` samples kept for ``horizon_s``, answering
+    ``increase``/``rate`` with reset clamping.  One sample at-or-before
+    the window start is always retained so the baseline is exact."""
+
+    __slots__ = ("horizon_s", "_samples")
+
+    def __init__(self, horizon_s: float = 600.0) -> None:
+        self.horizon_s = float(horizon_s)
+        self._samples: Deque[Tuple[float, float]] = deque()
+
+    def observe(self, value: float, at: float) -> None:
+        self._samples.append((float(at), float(value)))
+        while (
+            len(self._samples) > 1
+            and self._samples[1][0] <= at - self.horizon_s
+        ):
+            self._samples.popleft()
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def increase(self, window_s: float, now: float) -> Optional[float]:
+        return _increase(list(self._samples), now - float(window_s))
+
+    def rate(self, window_s: float, now: float) -> Optional[float]:
+        inc = self.increase(window_s, now)
+        if inc is None or window_s <= 0:
+            return None
+        return inc / float(window_s)
+
+    def latest(self) -> Optional[float]:
+        return self._samples[-1][1] if self._samples else None
+
+
+class RatioWindow:
+    """Windowed numerator/denominator pair — shed fraction, cache hit
+    rate, speculative accept rate: anything of the shape "events over
+    opportunities in the last W seconds" over two cumulative counters."""
+
+    __slots__ = ("num", "den")
+
+    def __init__(self, horizon_s: float = 600.0) -> None:
+        self.num = CounterWindow(horizon_s)
+        self.den = CounterWindow(horizon_s)
+
+    def observe(self, num: float, den: float, at: float) -> None:
+        self.num.observe(num, at)
+        self.den.observe(den, at)
+
+    def deltas(self, window_s: float, now: float) -> Optional[Tuple[float, float]]:
+        d_num = self.num.increase(window_s, now)
+        d_den = self.den.increase(window_s, now)
+        if d_num is None or d_den is None:
+            return None
+        return d_num, d_den
+
+    def ratio(self, window_s: float, now: float) -> Optional[float]:
+        d = self.deltas(window_s, now)
+        if d is None:
+            return None
+        d_num, d_den = d
+        return d_num / d_den if d_den > 0 else 0.0
+
+
+def _quantile_from(
+    edges: Sequence[float], counts: Sequence[int], count: int, q: float
+) -> float:
+    """``Histogram.quantile`` over a detached (edges, counts) pair —
+    the delta buckets a :class:`HistogramWindow` produces."""
+    if count <= 0:
+        return 0.0
+    target = max(1.0, q * count)
+    running = 0
+    for i, n in enumerate(counts):
+        if n and running + n >= target:
+            lo = edges[i - 1] if i > 0 else 0.0
+            hi = edges[i] if i < len(edges) else edges[-1]
+            return lo + (hi - lo) * ((target - running) / n)
+        running += n
+    return edges[-1] if edges else 0.0
+
+
+class HistogramWindow:
+    """Trailing window over cumulative histogram *snapshots* (the
+    ``state()`` dicts a ``MemoryStats.snapshot()`` exports): windowed
+    percentiles come from per-bucket deltas between the baseline and the
+    latest snapshot — the histogram itself stays cumulative, so external
+    scrapers never see counts go backwards (the ``Histogram.reset()``
+    pattern this replaces)."""
+
+    __slots__ = ("horizon_s", "_samples")
+
+    def __init__(self, horizon_s: float = 600.0) -> None:
+        self.horizon_s = float(horizon_s)
+        self._samples: Deque[Tuple[float, Dict[str, Any]]] = deque()
+
+    def observe(self, state: Mapping[str, Any], at: float) -> None:
+        snap = {
+            "edges": list(state.get("edges", ())),
+            "counts": list(state.get("counts", ())),
+            "count": int(state.get("count", 0)),
+            "sum": float(state.get("sum", 0.0)),
+        }
+        self._samples.append((float(at), snap))
+        while (
+            len(self._samples) > 1
+            and self._samples[1][0] <= at - self.horizon_s
+        ):
+            self._samples.popleft()
+
+    def _delta(self, window_s: float, now: float) -> Optional[Dict[str, Any]]:
+        if len(self._samples) < 2:
+            return None
+        since = now - float(window_s)
+        base = self._samples[0][1]
+        for at, snap in self._samples:
+            if at <= since:
+                base = snap
+            else:
+                break
+        head = self._samples[-1][1]
+        if head["count"] < base["count"] or len(head["counts"]) != len(
+            base["counts"]
+        ):
+            # Counter reset (process restart / bucket relayout): the new
+            # cumulative state counts from zero, so it IS the delta.
+            base = {"edges": head["edges"], "counts": [0] * len(head["counts"]),
+                    "count": 0, "sum": 0.0}
+        counts = [
+            max(0, h - b) for h, b in zip(head["counts"], base["counts"])
+        ]
+        return {
+            "edges": head["edges"],
+            "counts": counts,
+            "count": max(0, head["count"] - base["count"]),
+            "sum": max(0.0, head["sum"] - base["sum"]),
+        }
+
+    def quantile(self, q: float, window_s: float, now: float) -> Optional[float]:
+        d = self._delta(window_s, now)
+        if d is None:
+            return None
+        return _quantile_from(d["edges"], d["counts"], d["count"], q)
+
+    def delta_count(self, window_s: float, now: float) -> Optional[int]:
+        d = self._delta(window_s, now)
+        return None if d is None else d["count"]
+
+    def delta_sum(self, window_s: float, now: float) -> Optional[float]:
+        d = self._delta(window_s, now)
+        return None if d is None else d["sum"]
+
+
+class WindowedView:
+    """Keyed container of windows over a stats snapshot stream: feed it
+    ``MemoryStats.snapshot()`` every tick and ask for windowed rates,
+    increases, and percentiles by key."""
+
+    def __init__(self, horizon_s: float = 600.0) -> None:
+        self.horizon_s = float(horizon_s)
+        self._counters: Dict[str, CounterWindow] = {}
+        self._histograms: Dict[str, HistogramWindow] = {}
+
+    def sample(self, snapshot: Mapping[str, Any], at: float) -> None:
+        for key, value in snapshot.get("counters", {}).items():
+            win = self._counters.get(key)
+            if win is None:
+                win = self._counters[key] = CounterWindow(self.horizon_s)
+            win.observe(value, at)
+        for key, state in snapshot.get("histograms", {}).items():
+            hwin = self._histograms.get(key)
+            if hwin is None:
+                hwin = self._histograms[key] = HistogramWindow(self.horizon_s)
+            hwin.observe(state, at)
+
+    def counter(self, key: str) -> Optional[CounterWindow]:
+        return self._counters.get(key)
+
+    def histogram(self, key: str) -> Optional[HistogramWindow]:
+        return self._histograms.get(key)
+
+    def increase(self, key: str, window_s: float, now: float) -> Optional[float]:
+        win = self._counters.get(key)
+        return None if win is None else win.increase(window_s, now)
+
+    def rate(self, key: str, window_s: float, now: float) -> Optional[float]:
+        win = self._counters.get(key)
+        return None if win is None else win.rate(window_s, now)
+
+    def quantile(
+        self, key: str, q: float, window_s: float, now: float
+    ) -> Optional[float]:
+        hwin = self._histograms.get(key)
+        return None if hwin is None else hwin.quantile(q, window_s, now)
+
+
+# -- burn-rate / baseline math ------------------------------------------------
+
+
+def slo_status(
+    store: MetricStore,
+    *,
+    bad: str,
+    total: str,
+    target: float,
+    fast_s: float = 60.0,
+    slow_s: float = 300.0,
+    now: float,
+    matchers: Optional[Mapping[str, str]] = None,
+) -> Optional[Dict[str, Any]]:
+    """Multi-window burn-rate status over an error-budget target.
+
+    ``burn = (bad/total over window) / target`` — burn 1.0 consumes the
+    budget exactly at the rate it refills.  The standard fast+slow pair:
+    the *fast* window makes the alert responsive, the *slow* window
+    keeps one spike from firing it — callers alert only when both burn.
+    ``None`` when the total series has no history yet (signal absent).
+    """
+    d_total_slow = store.increase(total, slow_s, now, matchers=matchers)
+    if d_total_slow is None:
+        return None
+    d_bad_slow = store.increase(bad, slow_s, now, matchers=matchers) or 0.0
+    d_total_fast = store.increase(total, fast_s, now, matchers=matchers) or 0.0
+    d_bad_fast = store.increase(bad, fast_s, now, matchers=matchers) or 0.0
+    target = max(1e-9, float(target))
+
+    def _burn(bad_n: float, total_n: float) -> float:
+        if total_n <= 0:
+            return 0.0
+        return (bad_n / total_n) / target
+
+    slow_frac = d_bad_slow / d_total_slow if d_total_slow > 0 else 0.0
+    return {
+        "target": target,
+        "fast_window_s": float(fast_s),
+        "slow_window_s": float(slow_s),
+        "fast_burn": _burn(d_bad_fast, d_total_fast),
+        "slow_burn": _burn(d_bad_slow, d_total_slow),
+        "bad_fast": d_bad_fast,
+        "total_fast": d_total_fast,
+        "bad_slow": d_bad_slow,
+        "total_slow": d_total_slow,
+        "budget_remaining": max(0.0, 1.0 - slow_frac / target),
+    }
+
+
+#: The run-summary series folded into per-(project, kind) baselines on
+#: completion — the comparator set the canary promote/rollback DAG reads.
+BASELINE_SERIES: Tuple[Tuple[str, str], ...] = (
+    ("run_mfu", "mfu"),
+    ("run_goodput_ratio", "goodput_ratio"),
+    ("run_tokens_per_device_s", "tokens_per_device_s"),
+    ("run_spec_accept_rate", "spec_accept_rate"),
+)
+
+
+def fold_run_baselines(
+    registry: Any,
+    run: Any,
+    *,
+    alpha: float = 0.3,
+    now: Optional[float] = None,
+) -> Dict[str, Dict[str, Any]]:
+    """Fold a completed run's summary series into its (project, kind)
+    baseline rows.  Returns per-series fold results carrying the *prior*
+    mean/std/count next to the new ones — the regression comparator
+    judges the run against the baseline as it stood before the fold.
+    """
+    from polyaxon_tpu.monitor.watcher import goodput_status
+
+    try:
+        status = goodput_status(registry, run.id)
+    except Exception:
+        return {}
+    if not status or not status.get("rows"):
+        return {}
+    project = getattr(run, "project", None) or "default"
+    kind = getattr(run, "kind", None) or "run"
+    out: Dict[str, Dict[str, Any]] = {}
+    for series, field in BASELINE_SERIES:
+        value = status.get(field)
+        if value is None or float(value) <= 0.0:
+            continue
+        folded = registry.fold_metric_baseline(
+            project,
+            kind,
+            series,
+            float(value),
+            alpha=alpha,
+            run_id=run.id,
+            now=now,
+        )
+        if folded:
+            out[series] = folded
+    return out
+
+
+# -- the monitor tick's scrape phase ------------------------------------------
+
+#: Closed vocabulary of numeric ``/v1/stats`` fields scraped per replica
+#: — a bounded allowlist, so a chatty engine can't mint series.
+_REPLICA_FIELDS: Tuple[str, ...] = (
+    "slots",
+    "slots_active",
+    "queue_depth",
+    "blocks_free",
+    "block_occupancy",
+    "prefix_cache_hit_rate",
+    "prefix_cache_hit_rate_window",
+    "spec_accept_rate",
+    "spec_accept_rate_window",
+    "requests_submitted",
+    "requests_finished",
+    "requests_shed",
+    "tokens_generated",
+    "tokens_per_s",
+    "decode_steps",
+)
+
+#: Router counter names re-emitted as per-fleet series (closed set —
+#: mirrors ``FleetRouter.counters``).
+_ROUTER_COUNTERS: Tuple[str, ...] = (
+    "requests",
+    "sheds",
+    "retries",
+    "failovers",
+    "ejections",
+    "readmissions",
+    "drains",
+    "upstream_errors",
+)
+
+
+class MetricScraper:
+    """The scrape phase of the monitor tick.
+
+    Called every tick but internally throttled to ``interval_s`` — a
+    pass that isn't due costs microseconds, so N runs ticking at 50ms
+    don't multiply the scrape cost.  Each due pass samples:
+
+    * the control-plane stats backend (counters + gauges verbatim,
+      histograms as ``_count``/``_sum`` series),
+    * every registered fleet's router counters (``router_*_total``
+      labeled by fleet) and each replica's last probe stats (labeled by
+      fleet + replica — riding the router's existing probe results, no
+      new connections),
+    * a derived ``router_shed_fraction_window`` gauge per fleet from the
+      shared :class:`RatioWindow`,
+
+    then flushes up to ``flush_rows`` sealed samples to the registry's
+    ``metric_samples`` table.  Scrape errors are counted, never raised —
+    a wedged fleet must not take the monitor tick down with it.
+    """
+
+    def __init__(
+        self,
+        store: MetricStore,
+        *,
+        stats: Any = None,
+        registry: Any = None,
+        fleets: Optional[Callable[[], Iterable[Any]]] = None,
+        interval_s: float = 5.0,
+        flush_rows: int = 512,
+        window_s: float = 60.0,
+    ) -> None:
+        self.store = store
+        self.stats = stats
+        self.registry = registry
+        self.fleets = fleets
+        self.interval_s = max(0.05, float(interval_s))
+        self.flush_rows = max(1, int(flush_rows))
+        self.window_s = max(1.0, float(window_s))
+        self.last_scrape = 0.0
+        self.scrapes = 0
+        self.errors = 0
+        self.flushed_rows = 0
+        self._fleet_windows: Dict[str, RatioWindow] = {}
+        #: Label-key strings are pure functions of (name, fleet, replica)
+        #: — cache them so the per-replica fan-out doesn't rebuild
+        #: several hundred sorted/escaped key strings every scrape.
+        self._key_cache: Dict[Tuple[str, ...], str] = {}
+
+    def _fleet_key(self, name: str, fleet: str) -> str:
+        ck = (name, fleet)
+        key = self._key_cache.get(ck)
+        if key is None:
+            key = self._key_cache[ck] = labeled_key(name, fleet=fleet)
+        return key
+
+    def _replica_key(self, name: str, fleet: str, replica: str) -> str:
+        ck = (name, fleet, replica)
+        key = self._key_cache.get(ck)
+        if key is None:
+            key = self._key_cache[ck] = labeled_key(
+                name, fleet=fleet, replica=replica
+            )
+        return key
+
+    def tick(self, now: float) -> bool:
+        """One monitor-tick entry; returns True when a scrape ran."""
+        if now - self.last_scrape < self.interval_s:
+            return False
+        self.last_scrape = now
+        self.scrapes += 1
+        try:
+            self._scrape_control_plane(now)
+        except Exception:
+            self.errors += 1
+        try:
+            self._scrape_fleets(now)
+        except Exception:
+            self.errors += 1
+        try:
+            self._flush()
+        except Exception:
+            self.errors += 1
+        return True
+
+    def _scrape_control_plane(self, now: float) -> None:
+        if self.stats is None:
+            return
+        snap = self.stats.snapshot(include_timings=False)
+        self.store.record_snapshot(snap, now)
+
+    def _scrape_fleets(self, now: float) -> None:
+        if self.fleets is None:
+            return
+        for fleet in list(self.fleets() or ()):
+            router = getattr(fleet, "router", None)
+            if router is None:
+                continue
+            fleet_name = str(getattr(fleet, "name", "") or "fleet")
+            try:
+                rstats = router.stats()
+            except Exception:
+                self.errors += 1
+                continue
+            counters = rstats.get("counters", {})
+            for cname in _ROUTER_COUNTERS:
+                if cname in counters:
+                    key = self._fleet_key("router_" + cname + "_total", fleet_name)
+                    self.store.record(key, counters[cname], now)
+            self.store.record(
+                self._fleet_key("router_ready_replicas", fleet_name),
+                rstats.get("n_ready", 0),
+                now,
+            )
+            win = self._fleet_windows.get(fleet_name)
+            if win is None:
+                win = self._fleet_windows[fleet_name] = RatioWindow(
+                    max(self.window_s * 10.0, 600.0)
+                )
+            win.observe(
+                counters.get("sheds", 0), counters.get("requests", 0), now
+            )
+            shed_frac = win.ratio(self.window_s, now)
+            if shed_frac is not None:
+                self.store.record(
+                    self._fleet_key("router_shed_fraction_window", fleet_name),
+                    shed_frac,
+                    now,
+                )
+            replica_stats = getattr(router, "replica_stats", None)
+            if replica_stats is None:
+                continue
+            try:
+                per_replica = replica_stats()
+            except Exception:
+                self.errors += 1
+                continue
+            for rep_name, rep in per_replica.items():
+                if not isinstance(rep, Mapping):
+                    continue
+                for field in _REPLICA_FIELDS:
+                    value = rep.get(field)
+                    if value is None:
+                        continue
+                    key = self._replica_key(
+                        "replica_" + field, fleet_name, rep_name
+                    )
+                    self.store.record(key, value, now)
+
+    def _flush(self) -> None:
+        if self.registry is None:
+            return
+        rows = self.store.drain_pending(self.flush_rows)
+        if not rows:
+            return
+        try:
+            self.registry.add_metric_samples(rows)
+            self.flushed_rows += len(rows)
+        except Exception:
+            self.errors += 1
+
+    def status(self) -> Dict[str, Any]:
+        out = {
+            "interval_s": self.interval_s,
+            "last_scrape": self.last_scrape,
+            "scrapes": self.scrapes,
+            "errors": self.errors,
+            "flushed_rows": self.flushed_rows,
+        }
+        out.update(self.store.status())
+        return out

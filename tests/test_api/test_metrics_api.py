@@ -1,0 +1,369 @@
+"""The metric-history surface: ``GET /api/v1/metrics/query`` (label
+matchers, aligned aggregation, typed 400s, project ACL), the series /
+baselines listings, per-run persisted history, the ``slo`` roll-up on
+run detail, and the ``/ws/v1/metrics`` live tail.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from polyaxon_tpu.api.app import create_app
+from polyaxon_tpu.orchestrator import Orchestrator
+from polyaxon_tpu.stats.metrics import labeled_key
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+}
+
+ROOT = "root-secret"
+
+
+@pytest.fixture()
+def orch(tmp_path):
+    o = Orchestrator(tmp_path / "plat", monitor_interval=0.05)
+    yield o
+    o.stop()
+
+
+def drive(orch, coro_fn, auth_token=None):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        app = create_app(orch, auth_token=auth_token)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def hdr(token):
+    return {"Authorization": f"Bearer {token}"}
+
+
+def _seed_counters(store, now, *, bad_per_tick=0.0):
+    """600s of 10s-cadence router counters ending at ``now``."""
+    sheds = 0.0
+    for i in range(61):
+        at = now - 600.0 + i * 10.0
+        sheds += bad_per_tick
+        store.record("router_sheds_total", sheds, at)
+        store.record("router_requests_total", float(i * 100), at)
+
+
+class TestMetricsQuery:
+    def test_query_matchers_step_and_agg(self, orch):
+        now = time.time()
+        for i in range(10):
+            at = now - 10.0 + i
+            orch.metrics.record(
+                labeled_key("replica_slots_active", fleet="a", replica="r0"),
+                float(i),
+                at,
+            )
+            orch.metrics.record(
+                labeled_key("replica_slots_active", fleet="b", replica="r0"),
+                100.0,
+                at,
+            )
+
+        async def body(client):
+            doc = await (
+                await client.get(
+                    "/api/v1/metrics/query"
+                    "?series=replica_slots_active&fleet=a&agg=max"
+                )
+            ).json()
+            assert doc["matchers"] == {"fleet": "a"}
+            values = [p["value"] for p in doc["points"]]
+            assert max(values) == 9.0 and 100.0 not in values
+            # Aligned re-bucketing: step=5 over 1s raw cadence.
+            stepped = await (
+                await client.get(
+                    "/api/v1/metrics/query"
+                    "?series=replica_slots_active&fleet=a&step=5&agg=count"
+                )
+            ).json()
+            assert all(p["at"] % 5 == 0 for p in stepped["points"])
+            assert sum(p["value"] for p in stepped["points"]) == 10
+            # limit keeps the newest points.
+            tail = await (
+                await client.get(
+                    "/api/v1/metrics/query"
+                    "?series=replica_slots_active&fleet=a&limit=3"
+                )
+            ).json()
+            assert len(tail["points"]) == 3
+            assert tail["points"][-1]["value"] == 9.0
+            return True
+
+        assert drive(orch, body)
+
+    def test_typed_400_paths(self, orch):
+        orch.metrics.record("router_requests_total", 1.0, time.time())
+
+        async def body(client):
+            missing = await client.get("/api/v1/metrics/query")
+            assert missing.status == 400
+            assert "series" in (await missing.json())["error"]
+            unknown = await client.get("/api/v1/metrics/query?series=nope")
+            assert unknown.status == 400
+            assert "unknown series" in (await unknown.json())["error"]
+            badagg = await client.get(
+                "/api/v1/metrics/query?series=router_requests_total&agg=bogus"
+            )
+            assert badagg.status == 400
+            assert "unknown agg" in (await badagg.json())["error"]
+            badstep = await client.get(
+                "/api/v1/metrics/query?series=router_requests_total&step=x"
+            )
+            assert badstep.status == 400
+            assert "must be a number" in (await badstep.json())["error"]
+            return True
+
+        assert drive(orch, body)
+
+    def test_unknown_run_matcher_404(self, orch):
+        orch.metrics.record("router_requests_total", 1.0, time.time())
+
+        async def body(client):
+            resp = await client.get(
+                "/api/v1/metrics/query?series=router_requests_total&run=9999"
+            )
+            assert resp.status == 404
+            return True
+
+        assert drive(orch, body)
+
+    def test_series_and_store_status(self, orch):
+        orch.metrics.record("router_requests_total", 1.0, time.time())
+
+        async def body(client):
+            doc = await (await client.get("/api/v1/metrics/series")).json()
+            assert "router_requests_total" in doc["results"]
+            assert doc["store"]["series"] >= 1
+            return True
+
+        assert drive(orch, body)
+
+    def test_disabled_store_yields_503(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_TSDB_ENABLED", "0")
+        o = Orchestrator(tmp_path / "plat", monitor_interval=0.05)
+        try:
+            assert o.metrics is None and o.scraper is None
+
+            async def body(client):
+                resp = await client.get(
+                    "/api/v1/metrics/query?series=router_requests_total"
+                )
+                assert resp.status == 503
+                assert "disabled" in (await resp.json())["error"]
+                return True
+
+            assert drive(o, body)
+        finally:
+            o.stop()
+
+
+class TestMetricsACL:
+    def test_run_scoped_query_respects_project(self, orch):
+        reg = orch.registry
+
+        async def body(client):
+            _, alice = reg.create_user("alice")
+            _, bob = reg.create_user("bob")
+            resp = await client.post(
+                "/api/v1/projects",
+                json={"name": "secret"},
+                headers=hdr(alice),
+            )
+            assert resp.status in (200, 201)
+            run = reg.create_run(dict(SPEC), project="secret")
+            orch.metrics.record(
+                labeled_key("run_mfu", run=run.id), 0.4, time.time()
+            )
+            url = f"/api/v1/metrics/query?series=run_mfu&run={run.id}"
+            ok = await client.get(url, headers=hdr(alice))
+            assert ok.status == 200
+            denied = await client.get(url, headers=hdr(bob))
+            assert denied.status == 403
+            return True
+
+        assert drive(orch, body, auth_token=ROOT)
+
+    def test_cross_run_aggregation_is_admin_only(self, orch):
+        reg = orch.registry
+
+        async def body(client):
+            _, alice = reg.create_user("alice")
+            run = reg.create_run(dict(SPEC), project="default")
+            orch.metrics.record(
+                labeled_key("run_mfu", run=run.id), 0.4, time.time()
+            )
+            url = "/api/v1/metrics/query?series=run_mfu"
+            denied = await client.get(url, headers=hdr(alice))
+            assert denied.status == 403
+            assert "admin-only" in (await denied.json())["error"]
+            # The root operator can blend runs; so can a scoped query.
+            admin = await client.get(url, headers=hdr(ROOT))
+            assert admin.status == 200
+            scoped = await client.get(
+                url + f"&run={run.id}", headers=hdr(alice)
+            )
+            assert scoped.status == 200
+            # Cluster series stay visible to any authed caller.
+            orch.metrics.record("router_requests_total", 5.0, time.time())
+            cluster = await client.get(
+                "/api/v1/metrics/query?series=router_requests_total",
+                headers=hdr(alice),
+            )
+            assert cluster.status == 200
+            return True
+
+        assert drive(orch, body, auth_token=ROOT)
+
+    def test_baselines_scoped_by_project(self, orch):
+        reg = orch.registry
+
+        async def body(client):
+            _, alice = reg.create_user("alice")
+            _, bob = reg.create_user("bob")
+            resp = await client.post(
+                "/api/v1/projects",
+                json={"name": "secret"},
+                headers=hdr(alice),
+            )
+            assert resp.status in (200, 201)
+            reg.fold_metric_baseline("secret", "experiment", "run_mfu", 0.5)
+            url = "/api/v1/metrics/baselines?project=secret"
+            ok = await (await client.get(url, headers=hdr(alice))).json()
+            assert ok["results"][0]["series"] == "run_mfu"
+            denied = await client.get(url, headers=hdr(bob))
+            assert denied.status == 403
+            return True
+
+        assert drive(orch, body, auth_token=ROOT)
+
+
+class TestRunHistoryAndDetail:
+    def test_persisted_history_endpoint(self, orch):
+        reg = orch.registry
+
+        async def body(client):
+            run = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            key = labeled_key("run_mfu", run=run["id"])
+            reg.add_metric_samples(
+                [{"name": key, "at": float(i), "value": 0.1 * i}
+                 for i in range(5)]
+                + [{"name": "router_requests_total", "at": 1.0, "value": 9.0}]
+            )
+            doc = await (
+                await client.get(f"/api/v1/runs/{run['id']}/metrics/history")
+            ).json()
+            # Scoped to the run: the cluster sample does not leak in.
+            assert len(doc["results"]) == 5
+            assert {r["name"] for r in doc["results"]} == {key}
+            limited = await (
+                await client.get(
+                    f"/api/v1/runs/{run['id']}/metrics/history"
+                    "?series=run_mfu&limit=2"
+                )
+            ).json()
+            assert len(limited["results"]) == 2
+            return True
+
+        assert drive(orch, body)
+
+    def test_run_detail_carries_slo_block(self, orch):
+        async def body(client):
+            plain = await (
+                await client.post("/api/v1/runs", json={"spec": SPEC})
+            ).json()
+            detail = await (
+                await client.get(f"/api/v1/runs/{plain['id']}")
+            ).json()
+            # No declared budget: the block is present but empty.
+            assert detail["slo"] is None
+
+            spec = dict(SPEC)
+            spec["declarations"] = {"alert.slo_burn_rate.target": 0.01}
+            budgeted = await (
+                await client.post("/api/v1/runs", json={"spec": spec})
+            ).json()
+            _seed_counters(orch.metrics, time.time(), bad_per_tick=10.0)
+            detail = await (
+                await client.get(f"/api/v1/runs/{budgeted['id']}")
+            ).json()
+            assert detail["slo"]["name"] == "shed"
+            assert detail["slo"]["fast_burn"] > 2.0
+            assert detail["slo"]["budget_remaining"] == 0.0
+            return True
+
+        assert drive(orch, body)
+
+
+class TestWsMetricsTail:
+    def test_tail_streams_persisted_samples(self, orch):
+        reg = orch.registry
+
+        async def body(client):
+            ws = await client.ws_connect("/ws/v1/metrics")
+            reg.add_metric_samples(
+                [{"name": "router_requests_total", "at": 1.0, "value": 7.0}]
+            )
+            first = await ws.receive_json(timeout=5)
+            assert first["name"] == "router_requests_total"
+            assert first["value"] == 7.0
+            reg.add_metric_samples(
+                [{"name": "router_requests_total", "at": 2.0, "value": 9.0}]
+            )
+            second = await ws.receive_json(timeout=5)
+            assert second["value"] == 9.0 and second["id"] > first["id"]
+            await ws.close()
+            return True
+
+        assert drive(orch, body)
+
+    def test_tail_hides_foreign_run_samples(self, orch):
+        reg = orch.registry
+
+        async def body(client):
+            _, alice = reg.create_user("alice")
+            _, bob = reg.create_user("bob")
+            resp = await client.post(
+                "/api/v1/projects",
+                json={"name": "secret"},
+                headers=hdr(alice),
+            )
+            assert resp.status in (200, 201)
+            run = reg.create_run(dict(SPEC), project="secret")
+            ws = await client.ws_connect("/ws/v1/metrics", headers=hdr(bob))
+            reg.add_metric_samples(
+                [
+                    {
+                        "name": labeled_key("run_mfu", run=run.id),
+                        "at": 1.0,
+                        "value": 0.4,
+                    },
+                    {"name": "router_requests_total", "at": 1.0, "value": 7.0},
+                ]
+            )
+            # Bob only sees the cluster sample; the secret run's row is
+            # filtered out of his tail.
+            msg = await ws.receive_json(timeout=5)
+            assert msg["name"] == "router_requests_total"
+            await ws.close()
+            return True
+
+        assert drive(orch, body, auth_token=ROOT)

@@ -1,0 +1,153 @@
+"""Registry ``metric_samples`` + ``metric_baselines``: parity with
+spans/utilization.
+
+Batched ingest with run-label denormalization, name/agg/time filtering,
+since-id paging, delete_run cascade, retention sweep under the per-tick
+row budget, and EWMA baseline fold math (prior-vs-new, dispersion).
+"""
+
+import math
+import time
+
+import pytest
+
+from polyaxon_tpu.db.registry import RunRegistry
+from polyaxon_tpu.stats.metrics import labeled_key
+
+SPEC = {"kind": "experiment", "run": {"entrypoint": "x:y"}}
+
+
+@pytest.fixture()
+def reg(tmp_path):
+    registry = RunRegistry(tmp_path / "registry.sqlite")
+    yield registry
+    registry.close()
+
+
+class TestMetricSamples:
+    def test_roundtrip_and_run_label_denormalization(self, reg):
+        run = reg.create_run(dict(SPEC), name="a", project="p")
+        n = reg.add_metric_samples(
+            [
+                {"name": "router_requests_total", "at": 10.0, "value": 5.0},
+                {
+                    "name": labeled_key("run_mfu", run=run.id),
+                    "at": 11.0,
+                    "value": 0.42,
+                },
+            ]
+        )
+        assert n == 2
+        rows = reg.get_metric_samples()
+        assert len(rows) == 2
+        cluster, labeled = rows
+        assert cluster["run_id"] is None
+        # run="<id>" label denormalized into the indexed column.
+        assert labeled["run_id"] == run.id
+        assert reg.get_metric_samples(run_id=run.id)[0]["value"] == 0.42
+
+    def test_name_filter_exact_vs_base(self, reg):
+        reg.add_metric_samples(
+            [
+                {"name": "g", "at": 1.0, "value": 1.0},
+                {"name": 'g{fleet="a"}', "at": 2.0, "value": 2.0},
+                {"name": 'g{fleet="b"}', "at": 3.0, "value": 3.0},
+                {"name": "gauge_other", "at": 4.0, "value": 4.0},
+            ]
+        )
+        # Base name (no braces) matches the bare key and every label set
+        # — but never the merely prefix-similar name.
+        assert len(reg.get_metric_samples(name="g")) == 3
+        # Full labeled key matches exactly one.
+        assert len(reg.get_metric_samples(name='g{fleet="a"}')) == 1
+
+    def test_agg_since_until_and_paging(self, reg):
+        reg.add_metric_samples(
+            [{"name": "g", "at": float(i), "value": float(i), "agg": "raw"}
+             for i in range(10)]
+            + [{"name": "g", "at": 0.0, "value": 4.5, "agg": "10s",
+                "vmin": 0.0, "vmax": 9.0, "vsum": 45.0, "vcount": 10}]
+        )
+        assert len(reg.get_metric_samples(agg="raw")) == 10
+        rollups = reg.get_metric_samples(agg="10s")
+        assert len(rollups) == 1 and rollups[0]["vcount"] == 10
+        assert len(reg.get_metric_samples(agg=None)) == 11
+        assert len(reg.get_metric_samples(since=5.0, until=7.0)) == 3
+        page = reg.get_metric_samples(limit=4)
+        rest = reg.get_metric_samples(since_id=page[-1]["id"], agg=None)
+        assert len(page) == 4 and len(rest) == 7
+
+    def test_delete_run_cascades(self, reg):
+        run = reg.create_run(dict(SPEC), name="a", project="p")
+        reg.add_metric_samples(
+            [
+                {
+                    "name": labeled_key("run_mfu", run=run.id),
+                    "at": 1.0,
+                    "value": 0.4,
+                },
+                {"name": "router_requests_total", "at": 1.0, "value": 9.0},
+            ]
+        )
+        reg.delete_run(run.id)
+        rows = reg.get_metric_samples()
+        # The run's samples are gone; cluster samples survive.
+        assert [r["name"] for r in rows] == ["router_requests_total"]
+
+    def test_retention_sweep_respects_row_budget(self, reg):
+        old = time.time() - 7 * 86400
+        reg.add_metric_samples(
+            [{"name": "g", "at": old, "value": float(i)} for i in range(20)]
+        )
+        # Age the created_at column (add_metric_samples stamps now).
+        with reg._lock, reg._conn() as conn:
+            conn.execute("UPDATE metric_samples SET created_at = ?", (old,))
+        out = reg.clean_old_rows(86400.0, max_rows=8)
+        assert out["metric_samples"] == 8
+        assert out["truncated"] == 1
+        assert len(reg.get_metric_samples()) == 12
+        out = reg.clean_old_rows(86400.0, max_rows=100)
+        assert len(reg.get_metric_samples()) == 0
+
+
+class TestMetricBaselines:
+    def test_first_fold_has_no_prior(self, reg):
+        out = reg.fold_metric_baseline("p", "experiment", "run_mfu", 0.5)
+        assert out["prior_mean"] is None and out["prior_count"] == 0
+        assert out["mean"] == 0.5 and out["count"] == 1
+        (row,) = reg.get_metric_baselines("p")
+        assert row["series"] == "run_mfu" and row["std"] == 0.0
+
+    def test_ewma_update_tracks_and_widens(self, reg):
+        values = [0.50, 0.52, 0.48, 0.51]
+        for v in values:
+            out = reg.fold_metric_baseline(
+                "p", "experiment", "run_mfu", v, alpha=0.3
+            )
+        # West's EW update, replayed by hand.
+        mean, var = values[0], 0.0
+        for v in values[1:]:
+            diff = v - mean
+            var = (1 - 0.3) * (var + 0.3 * diff * diff)
+            mean = mean + 0.3 * diff
+        assert out["mean"] == pytest.approx(mean)
+        (row,) = reg.get_metric_baselines("p", kind="experiment")
+        assert row["std"] == pytest.approx(math.sqrt(var))
+        assert row["count"] == 4
+
+    def test_prior_returned_before_fold(self, reg):
+        reg.fold_metric_baseline("p", "experiment", "run_mfu", 0.5)
+        out = reg.fold_metric_baseline("p", "experiment", "run_mfu", 0.2)
+        # The comparator judges against the baseline as it stood BEFORE
+        # this run was folded in.
+        assert out["prior_mean"] == 0.5 and out["prior_count"] == 1
+        assert out["mean"] < 0.5
+
+    def test_baselines_scoped_by_project_kind_series(self, reg):
+        reg.fold_metric_baseline("p1", "experiment", "run_mfu", 0.5)
+        reg.fold_metric_baseline("p1", "service", "run_mfu", 0.6)
+        reg.fold_metric_baseline("p2", "experiment", "run_mfu", 0.7)
+        reg.fold_metric_baseline("p1", "experiment", "run_goodput_ratio", 0.9)
+        assert len(reg.get_metric_baselines("p1")) == 3
+        assert len(reg.get_metric_baselines("p1", kind="experiment")) == 2
+        assert len(reg.get_metric_baselines("p2")) == 1

@@ -1,0 +1,211 @@
+"""Metric-history e2e: burn → fire → query → recover → resolve, then a
+degraded run against the folded baseline.
+
+A real ``LocalServingFleet`` (subprocess replica, live router) is
+scraped into the registry TSDB while admission control sheds a burst of
+load: ``slo_burn_rate`` must fire on the fast+slow window pair, the
+burn must be visible through ``GET /api/v1/metrics/query`` as a
+windowed series, and the alert must resolve once traffic runs clean
+again.  Then the cross-run comparator: a healthy run folds the
+per-(project, kind) baseline, and a deliberately degraded second run
+lands k·σ below it and trips ``metric_regression``.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from polyaxon_tpu.db.registry import AlertState
+from polyaxon_tpu.orchestrator import Orchestrator
+from polyaxon_tpu.serving.fleet import LocalServingFleet
+from polyaxon_tpu.serving.router import FleetRouter, RouterError
+from polyaxon_tpu.stats.tsdb import fold_run_baselines
+
+MODEL = {
+    "vocab_size": 64,
+    "d_model": 16,
+    "n_layers": 1,
+    "n_heads": 2,
+    "head_dim": 8,
+    "d_ff": 32,
+    "n_kv_heads": 1,
+}
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "polyaxon_tpu.builtins.trainers:noop"},
+    "environment": {
+        "topology": {"accelerator": "cpu-1", "num_devices": 1, "num_hosts": 1}
+    },
+    "declarations": {
+        "alert.slo_burn_rate.target": 0.05,
+        "alert.slo_burn_rate.fast_window_s": 2.0,
+        "alert.slo_burn_rate.slow_window_s": 8.0,
+    },
+}
+
+
+def _util_row(goodput_busy_s: float):
+    return {
+        "seq": 1,
+        "source": "train",
+        "wall_s": 600.0,
+        "buckets": {"step_compute_s": goodput_busy_s},
+        "steps": 100,
+        "tokens": 100_000,
+        "flops": 1e15,
+        "tokens_per_device_s": 25.0,
+        "devices": 4,
+    }
+
+
+def _query(orch, path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from polyaxon_tpu.api.app import create_app
+
+    async def runner():
+        client = TestClient(TestServer(create_app(orch)))
+        await client.start_server()
+        try:
+            resp = await client.get(path)
+            return resp.status, await resp.json()
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+@pytest.mark.e2e
+class TestMetricHistoryFlow:
+    def test_burn_fire_query_recover_and_regression(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("POLYAXON_TPU_SERVING_WARMUP", "0")
+        monkeypatch.setenv("POLYAXON_TPU_TSDB_SCRAPE_INTERVAL_S", "0.05")
+        # Two completed runs are enough history for the comparator here.
+        monkeypatch.setenv("POLYAXON_TPU_ALERT_METRIC_REGRESSION_MIN_RUNS", "1")
+        orch = Orchestrator(tmp_path / "plat", monitor_interval=0.05)
+        orch.alerts.interval_s = 0.0
+        assert orch.metrics is not None and orch.scraper is not None
+        router = FleetRouter(probe_interval_s=0.1, probe_timeout_s=1.0)
+        fleet = LocalServingFleet(
+            tmp_path / "fleet",
+            MODEL,
+            replicas=1,
+            seq=48,
+            slots=2,
+            seed=0,
+            router=router,
+        )
+        fleet.name = "e2e"
+        orch.fleets.append(fleet)
+        run = orch.registry.create_run(dict(SPEC), project="default")
+        try:
+            fleet.start()
+            assert fleet.wait_ready(timeout_s=180), "fleet never reached ready"
+
+            def pump(send_ok: bool, cond, timeout: float, what: str):
+                deadline = time.time() + timeout
+                while time.time() < deadline:
+                    try:
+                        router.generate([[1, 2, 3, 4]], max_new_tokens=2)
+                        assert send_ok, "expected admission control to shed"
+                    except RouterError as e:
+                        assert e.kind == "overloaded" and not send_ok
+                    now = time.time()
+                    orch.scraper.tick(now)
+                    orch.alerts.evaluate(run.id, now=now)
+                    if cond():
+                        return
+                    time.sleep(0.05)
+                pytest.fail(
+                    f"timed out waiting for {what}: "
+                    f"router={router.stats()['counters']} "
+                    f"alerts={orch.registry.get_alerts(run.id)}"
+                )
+
+            def slo_rows(state):
+                return [
+                    r
+                    for r in orch.registry.get_alerts(
+                        run.id, rule="slo_burn_rate"
+                    )
+                    if r["state"] == state
+                ]
+
+            # Healthy traffic: counters move, no budget burns.
+            pump(
+                True,
+                lambda: router.stats()["counters"]["requests"] >= 15,
+                60,
+                "healthy warm-up traffic",
+            )
+            assert not orch.registry.get_alerts(run.id, rule="slo_burn_rate")
+
+            # Burn: shed every request via admission control until the
+            # fast+slow pair both exceed the burn threshold.
+            router.shed_occupancy = 0.0
+            pump(
+                False,
+                lambda: bool(slo_rows(AlertState.FIRING))
+                and router.stats()["counters"]["sheds"] >= 20,
+                60,
+                "slo_burn_rate to fire under sustained sheds",
+            )
+            (alert,) = slo_rows(AlertState.FIRING)
+            assert alert["attrs"]["slo"] == "shed"
+            assert alert["attrs"]["fast_burn"] > 2.0
+            assert alert["attrs"]["slow_burn"] > 2.0
+
+            # The burn is on the query API as a windowed series.
+            status, doc = _query(
+                orch,
+                "/api/v1/metrics/query"
+                "?series=router_shed_fraction_window&fleet=e2e",
+            )
+            assert status == 200 and doc["points"], doc
+            # Nonzero shed fraction over the window — the healthy
+            # warm-up traffic dilutes the ratio, so just "burning".
+            assert max(p["value"] for p in doc["points"]) > 0.05
+            status, doc = _query(
+                orch, "/api/v1/metrics/query?series=router_sheds_total&agg=max"
+            )
+            assert status == 200
+            assert max(p["value"] for p in doc["points"]) >= 10
+
+            # Budget-remaining rides run detail while burning.
+            status, detail = _query(orch, f"/api/v1/runs/{run.id}")
+            assert status == 200 and detail["slo"]["budget_remaining"] == 0.0
+
+            # Recovery: clean traffic drains the fast window first, and
+            # the both-windows gate resolves the alert.
+            router.shed_occupancy = 2.0
+            pump(
+                True,
+                lambda: bool(slo_rows(AlertState.RESOLVED)),
+                60,
+                "slo_burn_rate to resolve",
+            )
+            assert not slo_rows(AlertState.FIRING)
+        finally:
+            fleet.stop()
+            orch.stop()
+
+        # -- cross-run regression against the folded baseline ------------
+        reg = orch.registry
+        good = reg.create_run(dict(SPEC), project="default")
+        reg.add_utilization(good.id, _util_row(480.0))  # goodput 0.8
+        folded = fold_run_baselines(reg, good)
+        assert folded["run_goodput_ratio"]["value"] == pytest.approx(0.8)
+
+        degraded = reg.create_run(dict(SPEC), project="default")
+        reg.add_utilization(degraded.id, _util_row(120.0))  # goodput 0.2
+        folded = fold_run_baselines(reg, degraded)
+        row = orch.alerts.evaluate_regression(degraded, folded)
+        assert row is not None and row["state"] == AlertState.FIRING
+        assert row["rule"] == "metric_regression"
+        assert "run_goodput_ratio" in row["message"]
+        # The healthy run never regressed.
+        assert not reg.get_alerts(good.id, rule="metric_regression")

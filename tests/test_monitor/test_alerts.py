@@ -314,7 +314,9 @@ class TestBuiltinCatalog:
             "serving_ttft_p99",
             "steady_state_compiles",
             "compile_cache_miss",
+            "slo_burn_rate",
         }
+        assert rules["slo_burn_rate"].severity == AlertSeverity.CRITICAL
         assert rules["run_stalled"].severity == AlertSeverity.CRITICAL
         assert rules["heartbeat_stale"].severity == AlertSeverity.CRITICAL
         assert rules["compile_cache_miss"].severity == AlertSeverity.INFO

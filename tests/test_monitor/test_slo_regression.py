@@ -1,0 +1,267 @@
+"""Burn-rate SLO rule + cross-run regression verdicts.
+
+``slo_burn_rate``: off until an error budget is declared, gated on BOTH
+windows burning (a fast spike alone or a decayed slow tail alone must
+not fire), min-traffic guard, gauges exported per evaluation.
+
+``evaluate_regression``: terminal-run comparator over the pre-fold
+baseline — fires a durable ``metric_regression`` row beyond k·σ, skips
+thin baselines, and applies the σ floor so identical early runs don't
+make every wobble "infinitely improbable".
+"""
+
+import pytest
+
+from polyaxon_tpu.db.registry import AlertState, RunRegistry
+from polyaxon_tpu.events import EventTypes
+from polyaxon_tpu.monitor.alerts import (
+    AlertEngine,
+    RuleContext,
+    default_rules,
+    run_slo_status,
+)
+from polyaxon_tpu.stats.backends import MemoryStats
+from polyaxon_tpu.stats.metrics import labeled_key
+from polyaxon_tpu.stats.tsdb import MetricStore
+
+SPEC = {
+    "kind": "experiment",
+    "run": {"entrypoint": "noop:main"},
+    "environment": {"topology": {"accelerator": "cpu", "num_devices": 1}},
+}
+
+T0 = 1_000_000.0
+NOW = T0 + 600.0
+
+
+class FakeAuditor:
+    def __init__(self):
+        self.events = []
+
+    def record(self, event_type, **ctx):
+        self.events.append((event_type, ctx))
+
+
+@pytest.fixture()
+def reg(tmp_path):
+    r = RunRegistry(tmp_path / "reg.db")
+    yield r
+    r.close()
+
+
+@pytest.fixture()
+def run(reg):
+    return reg.create_run(dict(SPEC), project="p")
+
+
+def _rule():
+    return {r.name: r for r in default_rules()}["slo_burn_rate"]
+
+
+def _store(shed_per_tick):
+    """600s of 10s-cadence router counters with a shaped shed stream."""
+    store = MetricStore()
+    sheds = 0.0
+    for i in range(61):
+        at = T0 + i * 10.0
+        sheds += shed_per_tick(at)
+        store.record("router_sheds_total", sheds, at)
+        store.record("router_requests_total", float(i * 100), at)
+    return store
+
+
+class TestSloBurnRate:
+    def test_off_until_target_declared(self, reg, run):
+        store = _store(lambda at: 10.0)  # burning hard, but no budget set
+        ctx = RuleContext(reg, run, metrics=store, now=NOW)
+        assert run_slo_status(ctx) is None
+        assert _rule().check(ctx) is None
+
+    def test_fires_when_both_windows_burn(self, reg, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_ALERT_SLO_BURN_RATE_TARGET", "0.01")
+        run = reg.create_run(dict(SPEC), project="p")
+        store = _store(lambda at: 10.0)  # sustained 10% shed vs 1% budget
+        stats = MemoryStats()
+        ctx = RuleContext(reg, run, stats=stats, metrics=store, now=NOW)
+        out = _rule().check(ctx)
+        assert out is not None
+        assert out["fast_burn"] == pytest.approx(10.0, rel=0.01)
+        assert out["slow_burn"] == pytest.approx(10.0, rel=0.01)
+        assert out["budget_remaining"] == 0.0
+        assert "burning" in out["message"]
+        gauges = stats.snapshot()["gauges"]
+        fast_key = labeled_key("slo_burn_fast", run=str(run.id), slo="shed")
+        assert gauges[fast_key] == pytest.approx(10.0, rel=0.01)
+
+    def test_old_spike_alone_does_not_fire(self, reg, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_ALERT_SLO_BURN_RATE_TARGET", "0.01")
+        run = reg.create_run(dict(SPEC), project="p")
+        # Burst ended 3 minutes before NOW: slow window still poisoned,
+        # fast window clean — recovered, so the pair must stay quiet.
+        store = _store(lambda at: 50.0 if at < NOW - 180.0 else 0.0)
+        ctx = RuleContext(reg, run, metrics=store, now=NOW)
+        assert _rule().check(ctx) is None
+
+    def test_min_total_traffic_guard(self, reg, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_ALERT_SLO_BURN_RATE_TARGET", "0.01")
+        run = reg.create_run(dict(SPEC), project="p")
+        store = MetricStore()
+        # Two requests, both shed: 100% bad but statistically nothing.
+        store.record("router_requests_total", 0.0, NOW - 60.0)
+        store.record("router_requests_total", 2.0, NOW - 30.0)
+        store.record("router_sheds_total", 0.0, NOW - 60.0)
+        store.record("router_sheds_total", 2.0, NOW - 30.0)
+        ctx = RuleContext(reg, run, metrics=store, now=NOW)
+        assert _rule().check(ctx) is None
+
+    def test_declaration_overrides_series_and_windows(self, reg):
+        spec = dict(SPEC)
+        spec["declarations"] = {
+            "alert.slo_burn_rate.target": 0.05,
+            "alert.slo_burn_rate.name": "errors",
+            "alert.slo_burn_rate.bad_series": "upstream_errors_total",
+            "alert.slo_burn_rate.total_series": "reqs_total",
+        }
+        run = reg.create_run(spec, project="p")
+        store = MetricStore()
+        errs = 0.0
+        for i in range(61):
+            at = T0 + i * 10.0
+            errs += 20.0
+            store.record("upstream_errors_total", errs, at)
+            store.record("reqs_total", float(i * 100), at)
+        ctx = RuleContext(reg, run, metrics=store, now=NOW)
+        status = run_slo_status(ctx)
+        assert status["name"] == "errors"
+        assert status["bad_series"] == "upstream_errors_total"
+        assert status["slow_burn"] == pytest.approx(4.0, rel=0.01)
+
+    def test_none_without_metric_store(self, reg, run, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_ALERT_SLO_BURN_RATE_TARGET", "0.01")
+        ctx = RuleContext(reg, run, metrics=None, now=NOW)
+        assert run_slo_status(ctx) is None
+
+
+def _fold(value, prior_mean, prior_std, prior_count):
+    return {
+        "value": value,
+        "prior_mean": prior_mean,
+        "prior_std": prior_std,
+        "prior_count": prior_count,
+        "mean": value,
+        "std": prior_std,
+        "count": (prior_count or 0) + 1,
+    }
+
+
+class TestMetricRegression:
+    def _engine(self, reg):
+        return AlertEngine(
+            reg, stats=MemoryStats(), auditor=FakeAuditor(), interval_s=0
+        )
+
+    def test_fires_beyond_k_sigma(self, reg, run):
+        eng = self._engine(reg)
+        row = eng.evaluate_regression(
+            run,
+            {"run_mfu": _fold(0.10, 0.50, 0.02, 5)},
+            now=NOW,
+        )
+        assert row is not None and row["state"] == AlertState.FIRING
+        assert row["rule"] == "metric_regression"
+        assert "run_mfu" in row["message"]
+        (reg_entry,) = row["attrs"]["regressions"]
+        assert reg_entry["z"] < -3.0
+        # Durable verdict: the registry row persists for the terminal run.
+        rows = reg.get_alerts(run.id, rule="metric_regression")
+        assert rows and rows[0]["state"] == AlertState.FIRING
+        auditor = eng.auditor
+        assert any(e[0] == EventTypes.ALERT_FIRING for e in auditor.events)
+
+    def test_skips_thin_baseline(self, reg, run):
+        eng = self._engine(reg)
+        # prior_count 2 < min_runs 3: not enough history to judge.
+        out = eng.evaluate_regression(
+            run, {"run_mfu": _fold(0.10, 0.50, 0.02, 2)}, now=NOW
+        )
+        assert out is None
+
+    def test_sigma_floor_damps_identical_early_runs(self, reg, run):
+        eng = self._engine(reg)
+        # Degenerate σ=0 with a 2% dip: the 5%-of-mean floor makes
+        # z = -0.02/0.025 = -0.8, nowhere near k=3.
+        out = eng.evaluate_regression(
+            run, {"run_mfu": _fold(0.49, 0.50, 0.0, 5)}, now=NOW
+        )
+        assert out is None
+
+    def test_within_band_run_is_quiet(self, reg, run):
+        eng = self._engine(reg)
+        out = eng.evaluate_regression(
+            run, {"run_mfu": _fold(0.48, 0.50, 0.05, 5)}, now=NOW
+        )
+        assert out is None
+
+    def test_worst_series_leads_the_message(self, reg, run):
+        eng = self._engine(reg)
+        row = eng.evaluate_regression(
+            run,
+            {
+                "run_mfu": _fold(0.30, 0.50, 0.02, 5),
+                "run_tokens_per_device_s": _fold(1.0, 100.0, 1.0, 5),
+            },
+            now=NOW,
+        )
+        assert row["message"].startswith("run_tokens_per_device_s")
+        assert len(row["attrs"]["regressions"]) == 2
+
+    def test_disabled_via_declaration(self, reg):
+        spec = dict(SPEC)
+        spec["declarations"] = {"alert.metric_regression.enabled": False}
+        run = reg.create_run(spec, project="p")
+        eng = self._engine(reg)
+        out = eng.evaluate_regression(
+            run, {"run_mfu": _fold(0.10, 0.50, 0.02, 5)}, now=NOW
+        )
+        assert out is None
+
+
+class TestBaselineFoldPipeline:
+    def test_fold_run_baselines_reads_goodput_rollup(self, reg):
+        from polyaxon_tpu.stats.tsdb import fold_run_baselines
+
+        run = reg.create_run(dict(SPEC), project="p")
+        reg.add_utilization(
+            run.id,
+            {
+                "seq": 1,
+                "source": "train",
+                "wall_s": 600.0,
+                "buckets": {"step_compute_s": 480.0},
+                "steps": 100,
+                "tokens": 100_000,
+                "flops": 1e15,
+                "mfu": 0.42,
+                "goodput": 0.8,
+                "tokens_per_device_s": 25.0,
+                "devices": 4,
+            },
+        )
+        folded = fold_run_baselines(reg, run)
+        # goodput recomputed from the bucket sums: 480/600.
+        assert "run_goodput_ratio" in folded
+        assert folded["run_goodput_ratio"]["value"] == pytest.approx(0.8)
+        assert folded["run_goodput_ratio"]["prior_mean"] is None
+        (row,) = [
+            r
+            for r in reg.get_metric_baselines("p")
+            if r["series"] == "run_goodput_ratio"
+        ]
+        assert row["mean"] == pytest.approx(0.8)
+        assert row["kind"] == "experiment"
+
+    def test_fold_run_baselines_empty_without_rows(self, reg):
+        from polyaxon_tpu.stats.tsdb import fold_run_baselines
+
+        run = reg.create_run(dict(SPEC), project="p")
+        assert fold_run_baselines(reg, run) == {}

@@ -251,18 +251,21 @@ class TestMemoryStatsRegistry:
 
 
 class TestHistogramReset:
-    def test_reset_zeroes_in_place_keeping_edges(self):
+    def test_reset_is_deprecated_but_still_zeroes_in_place(self):
+        # reset() breaks cumulative-counter semantics for concurrent
+        # scrapers; kept for compatibility but it must warn.  Rolling
+        # windows now come from tsdb.HistogramWindow snapshot deltas.
         h = Histogram(edges=[1.0, 2.0])
         for v in (0.5, 1.5, 9.0):
             h.observe(v)
-        h.reset()
+        with pytest.warns(DeprecationWarning, match="HistogramWindow"):
+            h.reset()
         assert h.edges == [1.0, 2.0]
         assert h.counts == [0, 0, 0]
         assert h.count == 0 and h.sum == 0.0
         # Empty-safe after reset: summary and quantiles, no ZeroDivision.
         s = h.summary()
         assert s["count"] == 0.0 and s["mean"] == 0.0
-        # Reusable: the rolling-window pattern.
         h.observe(1.5)
         assert h.counts == [0, 1, 0] and h.count == 1
 

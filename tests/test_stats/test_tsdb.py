@@ -1,0 +1,367 @@
+"""Metric history TSDB: ring series + rollups, windowed deltas, scraper.
+
+Edge cases the pipeline must get right: rollup bucket boundary
+alignment, counter-reset clamping on replica restart, per-base-name
+cardinality folding, pending-queue bounds, burn-rate window gating, and
+the scraper's fleet fan-out over stub routers.
+"""
+
+import pytest
+
+from polyaxon_tpu.stats.metrics import Histogram, fold_labeled_key, labeled_key
+from polyaxon_tpu.stats.tsdb import (
+    ROLLUP_STEPS,
+    CounterWindow,
+    HistogramWindow,
+    MetricScraper,
+    MetricStore,
+    RatioWindow,
+    WindowedView,
+    slo_status,
+)
+
+T0 = 1_000_000.0  # aligned epoch anchor so bucket math is exact
+
+
+class TestRollups:
+    def test_rollup_buckets_align_to_step_boundaries(self):
+        store = MetricStore()
+        # Samples straddling a 10s boundary: 1008 and 1012 must land in
+        # DIFFERENT 10s buckets even though they are 4s apart.
+        store.record("g", 1.0, T0 + 8.0)
+        store.record("g", 3.0, T0 + 12.0)
+        store.record("g", 5.0, T0 + 19.0)
+        pts = store.query("g", step=10.0)
+        assert [p["at"] for p in pts] == [T0, T0 + 10.0]
+        first, second = pts
+        assert first["count"] == 1 and first["min"] == first["max"] == 1.0
+        # Second bucket carries min/max/sum/count of both its samples.
+        assert second["count"] == 2
+        assert second["min"] == 3.0 and second["max"] == 5.0
+
+    def test_query_step_picks_coarsest_fitting_stage(self):
+        store = MetricStore()
+        t0 = 999_960.0  # minute-aligned so the 1m ring fills exactly
+        for i in range(120):
+            store.record("g", float(i), t0 + i)
+        # step=60 reads the 1m ring: two buckets, not 120 raw points.
+        pts = store.query("g", step=60.0)
+        assert len(pts) == 2
+        assert pts[0]["count"] == 60 and pts[1]["count"] == 60
+        # step=5 is finer than every rollup stage: raw points, re-bucketed
+        # to the 5s alignment (5 samples per bucket).
+        fine = store.query("g", step=5.0)
+        assert len(fine) == 24 and all(p["count"] == 5 for p in fine)
+        # No step at all: the raw ring verbatim.
+        assert len(store.query("g")) == 120
+
+    def test_rollup_aggregates_answer_min_max_sum(self):
+        store = MetricStore()
+        for i, v in enumerate([2.0, 8.0, 4.0]):
+            store.record("g", v, T0 + i)
+        (pt,) = store.query("g", step=10.0, agg="max")
+        assert pt["value"] == 8.0
+        (pt,) = store.query("g", step=10.0, agg="sum")
+        assert pt["value"] == 14.0
+        (pt,) = store.query("g", step=10.0, agg="avg")
+        assert pt["value"] == pytest.approx(14.0 / 3.0)
+
+    def test_late_sample_merges_into_open_ring_bucket(self):
+        store = MetricStore()
+        store.record("g", 1.0, T0 + 5.0)
+        store.record("g", 1.0, T0 + 15.0)
+        store.record("g", 9.0, T0 + 6.0)  # late: belongs to the first bucket
+        pts = store.query("g", step=10.0)
+        assert pts[0]["max"] == 9.0 and pts[0]["count"] == 2
+
+    def test_unknown_agg_raises_value_error(self):
+        store = MetricStore()
+        store.record("g", 1.0, T0)
+        with pytest.raises(ValueError):
+            store.query("g", agg="stddev")
+
+
+class TestCounterResetClamping:
+    def test_increase_clamps_replica_restart(self):
+        store = MetricStore()
+        # Counter climbs to 100, restarts near zero, climbs to 40: the
+        # true increase is 100 + 40 (the restart counts from ~0), never
+        # negative.
+        for i, v in enumerate([0.0, 50.0, 100.0, 5.0, 40.0]):
+            store.record("c", v, T0 + i * 10.0)
+        inc = store.increase("c", 100.0, T0 + 40.0)
+        assert inc == pytest.approx(140.0)  # 100 up, +5 post-reset, +35
+
+    def test_increase_needs_two_samples(self):
+        store = MetricStore()
+        store.record("c", 10.0, T0)
+        assert store.increase("c", 60.0, T0 + 1.0) is None
+        assert store.rate("c", 60.0, T0 + 1.0) is None
+
+    def test_increase_sums_across_label_sets(self):
+        store = MetricStore()
+        for rep in ("a", "b"):
+            key = labeled_key("c", replica=rep)
+            store.record(key, 0.0, T0)
+            store.record(key, 10.0, T0 + 10.0)
+        assert store.increase("c", 60.0, T0 + 10.0) == pytest.approx(20.0)
+        assert store.increase(
+            "c", 60.0, T0 + 10.0, matchers={"replica": "a"}
+        ) == pytest.approx(10.0)
+
+
+class TestCardinalityAndBounds:
+    def test_label_overflow_folds_like_fold_labeled_key(self):
+        store = MetricStore(max_series=3)
+        keys = [labeled_key("s", replica=f"r{i}") for i in range(6)]
+        for k in keys:
+            store.record(k, 1.0, T0)
+        status = store.status()
+        assert status["folded"] > 0
+        # Overflow collapsed into the canonical fold of the key shape.
+        assert fold_labeled_key(keys[-1]) in store._series
+        assert len(store._by_base["s"]) <= store.max_series + 1
+
+    def test_pending_queue_bounded_drops_oldest(self):
+        store = MetricStore(pending_max=10)
+        for i in range(25):
+            store.record("g", float(i), T0 + i)
+        assert store.status()["pending"] == 10
+        assert store.status()["dropped"] == 15
+        rows = store.drain_pending(max_rows=100)
+        raw = [r for r in rows if r["agg"] == "raw"]
+        # Oldest dropped: the queue holds the newest 10 raw samples.
+        assert [r["value"] for r in raw] == [float(i) for i in range(15, 25)]
+
+    def test_drain_pending_emits_sealed_rollups(self):
+        store = MetricStore()
+        store.record("g", 1.0, T0 + 1.0)
+        store.record("g", 2.0, T0 + 11.0)  # seals the first 10s bucket
+        store.drain_pending()  # clear raws + the sealed bucket
+        rows = store.drain_pending()
+        assert rows == []
+        store.record("g", 3.0, T0 + 21.0)
+        rows = store.drain_pending()
+        sealed = [r for r in rows if r["agg"] == "10s"]
+        assert len(sealed) == 1 and sealed[0]["at"] == T0 + 10.0
+        assert sealed[0]["vcount"] == 1 and sealed[0]["vsum"] == 2.0
+
+    def test_hydrate_replays_without_requeueing(self):
+        store = MetricStore()
+        n = store.hydrate(
+            [{"name": "g", "at": T0 + i, "value": float(i), "agg": "raw"}
+             for i in range(5)]
+            + [{"name": "g", "at": T0, "value": 9.9, "agg": "10s"}]
+        )
+        assert n == 5  # rollup rows are skipped
+        assert store.status()["pending"] == 0
+        assert store.latest("g") == 4.0
+
+
+class TestWindows:
+    def test_counter_window_keeps_baseline_sample(self):
+        win = CounterWindow(horizon_s=30.0)
+        for i in range(10):
+            win.observe(float(i * 10), T0 + i * 10.0)
+        now = T0 + 90.0
+        # One sample at-or-before the window start survives trimming, so
+        # the 30s increase is exact.
+        assert win.increase(30.0, now) == pytest.approx(30.0)
+        assert win.rate(30.0, now) == pytest.approx(1.0)
+
+    def test_ratio_window_zero_denominator_is_zero_not_none(self):
+        win = RatioWindow(horizon_s=60.0)
+        win.observe(0.0, 100.0, T0)
+        win.observe(0.0, 100.0, T0 + 10.0)  # no new traffic
+        assert win.ratio(60.0, T0 + 10.0) == 0.0
+
+    def test_ratio_window_no_data_is_none(self):
+        win = RatioWindow(horizon_s=60.0)
+        win.observe(1.0, 10.0, T0)
+        assert win.ratio(60.0, T0) is None  # single sample: signal absent
+
+    def test_histogram_window_quantile_from_bucket_deltas(self):
+        h = Histogram(edges=(1.0, 10.0, 100.0))
+        win = HistogramWindow(horizon_s=600.0)
+        for _ in range(100):
+            h.observe(0.5)
+        win.observe(h.state(), T0)
+        for _ in range(100):
+            h.observe(50.0)  # everything in the window lands in (10, 100]
+        win.observe(h.state(), T0 + 30.0)
+        q = win.quantile(0.5, 60.0, T0 + 30.0)
+        assert q is not None and 10.0 < q <= 100.0
+        # Lifetime median would be ~1 — the window isolated the recent shift.
+        assert win.delta_count(60.0, T0 + 30.0) == 100
+
+    def test_histogram_window_reset_treats_head_as_delta(self):
+        h = Histogram(edges=(1.0, 10.0))
+        win = HistogramWindow(horizon_s=600.0)
+        for _ in range(50):
+            h.observe(0.5)
+        win.observe(h.state(), T0)
+        restarted = Histogram(edges=(1.0, 10.0))  # replica restart
+        for _ in range(7):
+            restarted.observe(0.5)
+        win.observe(restarted.state(), T0 + 10.0)
+        assert win.delta_count(60.0, T0 + 10.0) == 7
+
+    def test_windowed_view_over_snapshots(self):
+        view = WindowedView(horizon_s=600.0)
+        h = Histogram()
+        for step in range(5):
+            h.observe(0.1 * (step + 1))
+            view.sample(
+                {
+                    "counters": {"reqs": float(step * 100)},
+                    "histograms": {"lat_s": h.state()},
+                },
+                T0 + step * 10.0,
+            )
+        now = T0 + 40.0
+        assert view.increase("reqs", 20.0, now) == pytest.approx(200.0)
+        assert view.quantile("lat_s", 0.99, 40.0, now) is not None
+        assert view.rate("missing", 20.0, now) is None
+
+
+class TestSloStatus:
+    def _store(self, bad_per_tick, now):
+        store = MetricStore()
+        bad = 0.0
+        for i in range(61):
+            at = now - 600.0 + i * 10.0
+            bad += bad_per_tick(at)
+            store.record("bad_total", bad, at)
+            store.record("ok_total", float(i * 100), at)
+        return store
+
+    def test_burns_on_both_windows_during_sustained_burn(self):
+        now = T0 + 600.0
+        store = self._store(lambda at: 10.0, now)  # 10% bad throughout
+        status = slo_status(
+            store, bad="bad_total", total="ok_total", target=0.01, now=now
+        )
+        assert status is not None
+        assert status["fast_burn"] == pytest.approx(10.0, rel=0.01)
+        assert status["slow_burn"] == pytest.approx(10.0, rel=0.01)
+        assert status["budget_remaining"] == 0.0
+
+    def test_old_spike_burns_slow_window_only(self):
+        now = T0 + 600.0
+        # Burst ended 3 minutes ago: slow window still sees it, fast
+        # window is clean — the pair must NOT both burn.
+        store = self._store(
+            lambda at: 50.0 if at < now - 180.0 else 0.0, now
+        )
+        status = slo_status(
+            store, bad="bad_total", total="ok_total", target=0.01, now=now
+        )
+        assert status["fast_burn"] == 0.0
+        assert status["slow_burn"] > 1.0
+
+    def test_no_history_is_none(self):
+        store = MetricStore()
+        assert (
+            slo_status(store, bad="b", total="t", target=0.01, now=T0) is None
+        )
+
+    def test_budget_remaining_partial(self):
+        now = T0 + 600.0
+        # 0.5% bad against a 1% budget: half the budget left.
+        store = self._store(lambda at: 0.5, now)
+        status = slo_status(
+            store, bad="bad_total", total="ok_total", target=0.01, now=now
+        )
+        assert status["budget_remaining"] == pytest.approx(0.5, rel=0.05)
+
+
+class _Router:
+    def __init__(self):
+        self.n = 0
+
+    def stats(self):
+        self.n += 1
+        return {
+            "n_ready": 2,
+            "counters": {"requests": self.n * 100.0, "sheds": self.n * 5.0},
+        }
+
+    def replica_stats(self):
+        return {
+            "f-r0": {"slots": 4, "queue_depth": self.n, "tokens_per_s": 10.0},
+            "f-r1": {"slots": 4, "queue_depth": 0, "not_in_catalog": 1e9},
+        }
+
+
+class _Fleet:
+    def __init__(self):
+        self.name = "f"
+        self.router = _Router()
+
+
+class TestMetricScraper:
+    def test_scrape_is_throttled_and_labeled(self):
+        store = MetricStore()
+        fleet = _Fleet()
+        scraper = MetricScraper(
+            store, fleets=lambda: [fleet], interval_s=5.0
+        )
+        assert scraper.tick(T0) is True
+        assert scraper.tick(T0 + 1.0) is False  # not due
+        assert scraper.tick(T0 + 6.0) is True
+        key = labeled_key("router_requests_total", fleet="f")
+        assert store.latest(key) == 200.0
+        rep_key = labeled_key("replica_queue_depth", fleet="f", replica="f-r0")
+        assert store.latest(rep_key) == 2.0
+        # Fields outside the closed vocabulary never become series.
+        assert not store.has_series("replica_not_in_catalog")
+
+    def test_shed_fraction_window_appears_after_two_scrapes(self):
+        store = MetricStore()
+        fleet = _Fleet()
+        scraper = MetricScraper(
+            store, fleets=lambda: [fleet], interval_s=1.0, window_s=60.0
+        )
+        scraper.tick(T0)
+        assert not store.has_series("router_shed_fraction_window")
+        scraper.tick(T0 + 10.0)
+        frac = store.latest(
+            labeled_key("router_shed_fraction_window", fleet="f")
+        )
+        assert frac == pytest.approx(0.05)
+
+    def test_scrape_errors_counted_not_raised(self):
+        class _BadFleet:
+            name = "bad"
+
+            @property
+            def router(self):
+                return self
+
+            def stats(self):
+                raise RuntimeError("wedged")
+
+        store = MetricStore()
+        scraper = MetricScraper(
+            store, fleets=lambda: [_BadFleet()], interval_s=1.0
+        )
+        scraper.tick(T0)  # must not raise
+        assert scraper.errors == 1
+
+    def test_flush_persists_through_registry(self, tmp_path):
+        from polyaxon_tpu.db.registry import RunRegistry
+
+        reg = RunRegistry(tmp_path / "r.sqlite")
+        try:
+            store = MetricStore()
+            scraper = MetricScraper(
+                store, registry=reg, fleets=lambda: [_Fleet()], interval_s=1.0
+            )
+            scraper.tick(T0)
+            rows = reg.get_metric_samples()
+            assert rows and scraper.flushed_rows == len(rows)
+            assert any(
+                r["name"].startswith("router_requests_total") for r in rows
+            )
+        finally:
+            reg.close()
